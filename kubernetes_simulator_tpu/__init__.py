@@ -1,0 +1,34 @@
+"""kubernetes_simulator_tpu — a TPU-native Kubernetes cluster/scheduler
+simulator with the capability surface of wangchen615/kubernetes-simulator
+(see SURVEY.md; the reference mount was empty, so the blueprint is the
+[BASELINE]+[K8S] surface documented there).
+
+Layers (SURVEY.md §1): models/ = L0 cluster-state + encodings; framework/ =
+L1 scheduling framework + L3 queue + L6 registry; plugins/ = L2 plugin set;
+sim/ = L4 runtime + L5 trace/what-if drivers; ops/ = the numpy/JAX kernels
+behind Filter/Score; parallel/ = TPU mesh + collectives; utils/ = config,
+metrics, quantities.
+"""
+
+__version__ = "0.1.0"
+
+from .models.core import (  # noqa: F401
+    Cluster,
+    Effect,
+    LabelSelector,
+    MatchExpression,
+    Node,
+    NodeAffinitySpec,
+    NodeSelectorTerm,
+    Operator,
+    Pod,
+    PodAffinitySpec,
+    PodAffinityTerm,
+    PodGroup,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from .models.encode import encode  # noqa: F401

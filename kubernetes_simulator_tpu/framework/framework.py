@@ -1,0 +1,141 @@
+"""SchedulerFramework — layer L1 (SURVEY.md §1, §3.3).
+
+Runs one pod through the [K8S] extension-point order:
+
+    PreFilter → Filter → (PostFilter: preemption) → PreScore → Score →
+    NormalizeScore → weighted sum → select → Reserve → Permit → Bind
+
+Filter and Score are the extension points [BASELINE] names explicitly; the
+rest follow upstream framework ordering. The CPU path evaluates each
+extension point vectorized over all nodes (the `(nodes × pending_pods)`
+tensorization, host edition); the JAX strategy swaps the whole cycle for a
+fused device program selected through the strategy registry (L6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from ..models.state import SchedState, bind, unbind
+from ..plugins.builtin import (
+    DEFAULT_WEIGHTS,
+    Plugin,
+    SchedulingContext,
+    make_plugins,
+)
+
+
+@dataclass
+class ScheduleResult:
+    node: int  # PAD = unschedulable
+    reason: str = ""
+    victims: Tuple[int, ...] = ()  # preempted pods (PostFilter)
+
+
+@dataclass
+class FrameworkConfig:
+    plugins: Optional[List[dict]] = None  # [{"name":..., "args": {...}}]
+    weights: Optional[Dict[str, float]] = None  # Score weights by plugin name
+    enable_preemption: bool = True
+
+
+class SchedulerFramework:
+    def __init__(self, ec: EncodedCluster, pods: EncodedPods, config: Optional[FrameworkConfig] = None):
+        self.config = config or FrameworkConfig()
+        self.ctx = SchedulingContext.build(ec, pods)
+        self.plugins: List[Plugin] = make_plugins(self.ctx, self.config.plugins)
+        weights = dict(DEFAULT_WEIGHTS)
+        weights.update(self.config.weights or {})
+        self.weights = weights
+        self.ec = ec
+        self.pods = pods
+        # Per-extension-point latency accounting (SURVEY.md §5 tracing).
+        self.plugin_time: Dict[str, float] = {}
+
+    # -- Filter + Score over all nodes -------------------------------------
+
+    def feasible_mask(self, st: SchedState, p: int) -> np.ndarray:
+        mask = np.ones(self.ec.num_nodes, dtype=bool)
+        for pl in self.plugins:
+            m = pl.filter(self.ctx, st, p)
+            if m is not None:
+                mask &= m
+                if not mask.any():
+                    break
+        return mask
+
+    def score_nodes(self, st: SchedState, p: int, feasible: np.ndarray) -> np.ndarray:
+        total = np.zeros(self.ec.num_nodes, dtype=np.float32)
+        for pl in self.plugins:
+            raw = pl.score(self.ctx, st, p)
+            if raw is None:
+                continue
+            w = self.weights.get(pl.name, 1.0)
+            if w == 0:
+                continue
+            total += w * pl.normalize(raw, feasible)
+        return total
+
+    def schedule_one(self, st: SchedState, p: int) -> ScheduleResult:
+        """One scheduling cycle (SURVEY.md §3.3). Does NOT bind — the caller
+        (runtime) owns Reserve/Permit/Bind so gang commit stays transactional."""
+        feasible = self.feasible_mask(st, p)
+        if not feasible.any():
+            if self.config.enable_preemption:
+                res = self._post_filter_preempt(st, p)
+                if res is not None:
+                    return res
+            return ScheduleResult(PAD, "Unschedulable")
+        scores = self.score_nodes(st, p, feasible)
+        masked = np.where(feasible, scores, -np.inf)
+        # Deterministic lowest-index tie-break (SURVEY.md §7 hard part #6).
+        return ScheduleResult(int(np.argmax(masked)))
+
+    # -- PostFilter: preemption ([K8S] defaultpreemption) -------------------
+
+    def _post_filter_preempt(self, st: SchedState, p: int) -> Optional[ScheduleResult]:
+        """Find a node where evicting the fewest, lowest-priority pods with
+        priority < pod's makes it fit. Victims are chosen lowest-priority
+        first; candidate nodes ranked by (fewest victims, lowest max victim
+        priority). Gang members are never chosen as victims (their group
+        would be left partial)."""
+        pods, ec = self.pods, self.ec
+        prio = int(pods.priority[p])
+        bound_nodes = st.bound  # [P]
+        candidates: List[Tuple[int, int, int, List[int]]] = []
+        placed = np.nonzero(bound_nodes >= 0)[0]
+        lower = placed[(pods.priority[placed] < prio) & (pods.group_id[placed] == PAD)]
+        if lower.size == 0:
+            return None
+        for n in range(ec.num_nodes):
+            on_n = lower[bound_nodes[lower] == n]
+            if on_n.size == 0:
+                continue
+            # Greedily evict lowest-priority victims until the pod fits.
+            order = on_n[np.lexsort((on_n, pods.priority[on_n]))]
+            trial = st.copy()
+            victims: List[int] = []
+            for v in order:
+                unbind(ec, pods, trial, int(v))
+                victims.append(int(v))
+                if self._fits_after(trial, p, n):
+                    break
+            else:
+                continue
+            if not self._fits_after(trial, p, n):
+                continue
+            max_vprio = int(pods.priority[victims].max()) if victims else -(2**31)
+            candidates.append((len(victims), max_vprio, n, victims))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: (c[0], c[1], c[2]))
+        nvict, _, n, victims = candidates[0]
+        return ScheduleResult(n, "Preempted", tuple(victims))
+
+    def _fits_after(self, st: SchedState, p: int, n: int) -> bool:
+        mask = self.feasible_mask(st, p)
+        return bool(mask[n])

@@ -1,0 +1,87 @@
+"""Scheduling queue (layer L3, SURVEY.md §1).
+
+[K8S] kube-scheduler queue semantics: an active heap ordered by QueueSort
+(priority desc, then FIFO), a backoff queue with exponential per-pod backoff
+(1s → 10s), and an unschedulable set that is flushed back to active when a
+cluster event might make pods schedulable. Time here is the simulator's
+virtual clock.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+INITIAL_BACKOFF = 1.0
+MAX_BACKOFF = 10.0
+
+
+@dataclass
+class _Entry:
+    pod: int
+    priority: int
+    seq: int
+
+    def sort_key(self) -> Tuple[int, int]:
+        return (-self.priority, self.seq)
+
+
+class SchedulingQueue:
+    def __init__(self):
+        self._heap: List[Tuple[Tuple[int, int], _Entry]] = []
+        self._backoff: List[Tuple[float, Tuple[int, int], _Entry]] = []
+        self._unschedulable: Dict[int, _Entry] = {}
+        self._attempts: Dict[int, int] = {}
+        self._seq = 0
+
+    def push(self, pod: int, priority: int) -> None:
+        e = _Entry(pod, priority, self._seq)
+        self._seq += 1
+        heapq.heappush(self._heap, (e.sort_key(), e))
+
+    def pop(self) -> Optional[int]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[1].pod
+
+    def requeue_backoff(self, pod: int, priority: int, now: float) -> None:
+        """Pod failed a scheduling attempt for a transient reason — retry
+        after exponential backoff."""
+        n = self._attempts.get(pod, 0)
+        self._attempts[pod] = n + 1
+        delay = min(INITIAL_BACKOFF * (2**n), MAX_BACKOFF)
+        e = _Entry(pod, priority, self._seq)
+        self._seq += 1
+        heapq.heappush(self._backoff, (now + delay, e.sort_key(), e))
+
+    def mark_unschedulable(self, pod: int, priority: int) -> None:
+        e = _Entry(pod, priority, self._seq)
+        self._seq += 1
+        self._unschedulable[pod] = e
+
+    def flush_unschedulable(self) -> None:
+        """A cluster event occurred (binding freed resources, node change) —
+        move unschedulable pods back to active ([K8S] MoveAllToActiveQueue)."""
+        for e in self._unschedulable.values():
+            heapq.heappush(self._heap, (e.sort_key(), e))
+        self._unschedulable.clear()
+
+    def flush_backoff(self, now: float) -> None:
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, e = heapq.heappop(self._backoff)
+            heapq.heappush(self._heap, (e.sort_key(), e))
+
+    def next_backoff_time(self) -> Optional[float]:
+        return self._backoff[0][0] if self._backoff else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    @property
+    def num_unschedulable(self) -> int:
+        return len(self._unschedulable)
+
+    @property
+    def num_backoff(self) -> int:
+        return len(self._backoff)

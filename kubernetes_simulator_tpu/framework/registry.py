@@ -1,0 +1,44 @@
+"""Pluggable scheduler-strategy registry (layer L6, SURVEY.md §1).
+
+[BASELINE] requires alternate scheduling backends to be selected through a
+registry, with the CPU plugin path as the default and the `jax` backend as
+an opt-in strategy. A strategy factory receives the encoded cluster +
+workload and the framework config and returns a replay engine exposing
+``replay(...)`` (see :mod:`..sim.runtime` for the contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_STRATEGIES: Dict[str, Callable] = {}
+
+
+def register_strategy(name: str):
+    def deco(factory: Callable) -> Callable:
+        if name in _STRATEGIES:
+            raise ValueError(f"strategy {name!r} already registered")
+        _STRATEGIES[name] = factory
+        return factory
+
+    return deco
+
+
+def get_strategy(name: str) -> Callable:
+    if name not in _STRATEGIES:
+        # Import built-in strategies lazily so `cpu` works without jax
+        # installed and `jax` only pays its import cost when selected.
+        if name == "cpu":
+            from ..sim import runtime  # noqa: F401  (registers "cpu")
+        elif name == "jax":
+            from ..sim import jax_runtime  # noqa: F401  (registers "jax")
+    try:
+        return _STRATEGIES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown strategy {name!r}; registered: {sorted(_STRATEGIES)}"
+        ) from None
+
+
+def available_strategies():
+    return sorted(_STRATEGIES)

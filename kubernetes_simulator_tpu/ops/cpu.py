@@ -1,0 +1,329 @@
+"""Vectorized scheduling kernels, numpy host edition.
+
+Each function evaluates one extension-point predicate/score for ONE pending
+pod against ALL nodes at once — the ``(nodes × pending_pods)`` tensorization
+[BASELINE] asks for, here in its host form. :mod:`..ops.tpu` implements the
+same math in jax.numpy for the device path; the two must agree exactly
+(SURVEY.md §4 parity suite).
+
+Semantics are upstream kube-scheduler plugin semantics ([K8S]); the pure
+Python oracle in :mod:`..plugins` unit tests anchors them a third time at
+the object-model level.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.encode import PAD, TOL_PAD, TOL_WILDCARD, EncodedCluster, EncodedPods
+from ..models.core import Effect, Operator
+from ..models.state import SchedState
+
+MAX_NODE_SCORE = 100.0
+
+
+# ---------------------------------------------------------------------------
+# Node-selector expression matching
+# ---------------------------------------------------------------------------
+
+def expr_match_matrix(ec: EncodedCluster) -> np.ndarray:
+    """``M[n, e]`` — does node n satisfy interned expression e.
+
+    Computed once per (scenario) cluster state from the label tensors, so
+    label perturbations re-flow without re-encoding. [K8S] semantics:
+    In/Gt/Lt require the key present; NotIn/DoesNotExist also match when the
+    key is absent.
+    """
+    nk = ec.node_label_key[:, :, None]  # [N, L, 1]
+    nv = ec.node_label_kv[:, :, None]
+    ek = ec.expr_key[None, None, :]  # [1, 1, E]
+    key_present = np.any((nk == ek) & (nk != PAD), axis=1)  # [N, E]
+    # In: node kv ∈ expr value set (kv ids embed the key, so one equality).
+    in_set = np.any(
+        (nv[:, :, :, None] == ec.expr_vals[None, None, :, :]) & (nv[:, :, :, None] != PAD),
+        axis=(1, 3),
+    )  # [N, E]
+    num = ec.node_label_num[:, :, None]  # [N, L, 1]
+    with np.errstate(invalid="ignore"):
+        gt = np.any((nk == ek) & (num > ec.expr_num[None, None, :]), axis=1)
+        lt = np.any((nk == ek) & (num < ec.expr_num[None, None, :]), axis=1)
+    op = ec.expr_op[None, :]
+    return (
+        ((op == Operator.IN) & key_present & in_set)
+        | ((op == Operator.NOT_IN) & ~(key_present & in_set))
+        | ((op == Operator.EXISTS) & key_present)
+        | ((op == Operator.DOES_NOT_EXIST) & ~key_present)
+        | ((op == Operator.GT) & gt)
+        | ((op == Operator.LT) & lt)
+    )
+
+
+def selector_terms_match(M: np.ndarray, terms: np.ndarray) -> np.ndarray:
+    """OR over terms of AND over expressions. ``terms``: [T, E_slots] expr
+    ids (PAD-padded); a term is valid iff its first slot is a real expr.
+    Returns [N] bool."""
+    valid_term = terms[:, 0] >= 0  # [T]
+    safe = np.clip(terms, 0, None)
+    per_expr = M[:, safe] | (terms[None, :, :] < 0)  # padding exprs auto-true
+    per_term = np.all(per_expr, axis=2) & valid_term[None, :]
+    if not valid_term.any():
+        return np.zeros(M.shape[0], dtype=bool)
+    return np.any(per_term, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit ([K8S] noderesources; [BASELINE] LeastAllocated)
+# ---------------------------------------------------------------------------
+
+def fit_mask(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) -> np.ndarray:
+    req = pods.requests[p]  # [R]
+    return np.all(st.used + req[None, :] <= ec.allocatable + 1e-6, axis=1)
+
+
+def least_allocated_score(
+    ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int, weights: np.ndarray
+) -> np.ndarray:
+    """``Σ_r w_r · (alloc_r − used_r − req_r)/alloc_r · 100 / Σw``; rows with
+    alloc==0 contribute 0 ([K8S] leastAllocatedScorer)."""
+    req = pods.requests[p][None, :]
+    alloc = ec.allocatable
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frac = np.where(alloc > 0, (alloc - st.used - req) / np.where(alloc > 0, alloc, 1.0), 0.0)
+    frac = np.clip(frac, 0.0, 1.0)
+    wsum = weights.sum()
+    if wsum == 0:
+        return np.zeros(ec.num_nodes, dtype=np.float32)
+    return (frac * weights[None, :]).sum(axis=1).astype(np.float32) * MAX_NODE_SCORE / wsum
+
+
+def most_allocated_score(
+    ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int, weights: np.ndarray
+) -> np.ndarray:
+    req = pods.requests[p][None, :]
+    alloc = ec.allocatable
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frac = np.where(alloc > 0, (st.used + req) / np.where(alloc > 0, alloc, 1.0), 0.0)
+    frac = np.clip(frac, 0.0, 1.0)
+    wsum = weights.sum()
+    if wsum == 0:
+        return np.zeros(ec.num_nodes, dtype=np.float32)
+    return (frac * weights[None, :]).sum(axis=1).astype(np.float32) * MAX_NODE_SCORE / wsum
+
+
+def requested_to_capacity_ratio_score(
+    ec: EncodedCluster,
+    st: SchedState,
+    pods: EncodedPods,
+    p: int,
+    weights: np.ndarray,
+    shape_x: np.ndarray,
+    shape_y: np.ndarray,
+) -> np.ndarray:
+    """Piecewise-linear function of utilization ([K8S]
+    RequestedToCapacityRatio shape points; x in [0,100] utilization, y in
+    [0,100] score)."""
+    req = pods.requests[p][None, :]
+    alloc = ec.allocatable
+    with np.errstate(divide="ignore", invalid="ignore"):
+        util = np.where(alloc > 0, (st.used + req) / np.where(alloc > 0, alloc, 1.0), 0.0)
+    util = np.clip(util, 0.0, 1.0) * 100.0
+    score_r = np.interp(util, shape_x, shape_y)  # [N, R]
+    wsum = weights.sum()
+    if wsum == 0:
+        return np.zeros(ec.num_nodes, dtype=np.float32)
+    return (score_r * weights[None, :]).sum(axis=1).astype(np.float32) / wsum
+
+
+# ---------------------------------------------------------------------------
+# TaintToleration ([K8S] tainttoleration)
+# ---------------------------------------------------------------------------
+
+def _untolerated(ec: EncodedCluster, pods: EncodedPods, p: int, effects: np.ndarray) -> np.ndarray:
+    """[N, TT] bool — taint slot active with effect ∈ ``effects`` and not
+    tolerated by any of pod p's tolerations."""
+    t_eff = ec.taint_effect  # [N, TT]
+    active = np.isin(t_eff, effects) & (ec.taint_key != PAD)
+    tk = pods.tol_key[p]  # [TO]
+    tv = pods.tol_kv[p]
+    te = pods.tol_effect[p]
+    valid_tol = tk != TOL_PAD  # [TO]
+    key_ok = (tk[None, None, :] == TOL_WILDCARD) | (tk[None, None, :] == ec.taint_key[:, :, None])
+    val_ok = (tv[None, None, :] == PAD) | (tv[None, None, :] == ec.taint_kv[:, :, None])
+    eff_ok = (te[None, None, :] == 0) | (te[None, None, :] == t_eff[:, :, None])
+    tolerated = np.any(key_ok & val_ok & eff_ok & valid_tol[None, None, :], axis=2)
+    return active & ~tolerated
+
+
+def taint_mask(ec: EncodedCluster, pods: EncodedPods, p: int) -> np.ndarray:
+    """Feasible iff no untolerated NoSchedule/NoExecute taint."""
+    bad = _untolerated(
+        ec, pods, p, np.array([int(Effect.NO_SCHEDULE), int(Effect.NO_EXECUTE)])
+    )
+    return ~np.any(bad, axis=1)
+
+
+def taint_prefer_count(ec: EncodedCluster, pods: EncodedPods, p: int) -> np.ndarray:
+    """Count of untolerated PreferNoSchedule taints per node (score input)."""
+    bad = _untolerated(ec, pods, p, np.array([int(Effect.PREFER_NO_SCHEDULE)]))
+    return bad.sum(axis=1).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# NodeAffinity ([K8S] nodeaffinity)
+# ---------------------------------------------------------------------------
+
+def node_affinity_mask(M: np.ndarray, pods: EncodedPods, p: int) -> np.ndarray:
+    if not pods.na_has_req[p]:
+        return np.ones(M.shape[0], dtype=bool)
+    return selector_terms_match(M, pods.na_req[p])
+
+
+def node_affinity_score(M: np.ndarray, pods: EncodedPods, p: int) -> np.ndarray:
+    """Σ weight over matched preferred terms (raw; normalized by caller)."""
+    terms = pods.na_pref[p]  # [TP, TE]
+    w = pods.na_pref_w[p]  # [TP]
+    valid_term = terms[:, 0] >= 0
+    safe = np.clip(terms, 0, None)
+    per_expr = M[:, safe] | (terms[None, :, :] < 0)
+    per_term = np.all(per_expr, axis=2) & valid_term[None, :]
+    return (per_term * w[None, :]).sum(axis=1).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity ([K8S] interpodaffinity) — reads the count tensors
+# ---------------------------------------------------------------------------
+
+def _group_dom_per_node(ec: EncodedCluster) -> np.ndarray:
+    """[G, N] domain id of each node under each group's topology key."""
+    gt = np.clip(ec.group_topo, 0, None)
+    dom = ec.node_domain[gt]  # [G, N]
+    return np.where(ec.group_topo[:, None] >= 0, dom, PAD)
+
+
+def _counts_at_nodes(counts: np.ndarray, gdom: np.ndarray) -> np.ndarray:
+    """Gather ``counts[g, dom(g, n)]`` → [G, N]; 0 where the node lacks the key."""
+    safe = np.clip(gdom, 0, None)
+    vals = np.take_along_axis(counts, safe, axis=1)
+    return np.where(gdom >= 0, vals, 0.0)
+
+
+def interpod_filter_mask(
+    ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int
+) -> np.ndarray:
+    N = ec.num_nodes
+    gdom = _group_dom_per_node(ec)  # [G, N]
+    cnt = _counts_at_nodes(st.match_count, gdom)  # [G, N]
+    total = st.match_count.sum(axis=1)  # [G]
+    ok = np.ones(N, dtype=bool)
+    # Required affinity: ≥1 matching placed pod in the node's domain; the
+    # bootstrap exception ([K8S]): if nothing matches anywhere and the pod
+    # matches its own term, the term is satisfied.
+    for g in pods.aff_req[p]:
+        if g < 0:
+            continue
+        boot = (total[g] == 0) and bool(pods.pod_matches_group[p, g])
+        term_ok = (cnt[g] >= 1) & (gdom[g] >= 0)
+        ok &= term_ok | boot
+    # Required anti-affinity (incoming pod's own terms): no matching placed
+    # pod in the domain. Nodes without the topology key cannot conflict.
+    for g in pods.anti_req[p]:
+        if g < 0:
+            continue
+        ok &= ~((cnt[g] >= 1) & (gdom[g] >= 0))
+    # Symmetric: placed pods' required anti-affinity terms reject this pod.
+    anti_here = _counts_at_nodes(st.anti_active, gdom)  # [G, N]
+    blocked = np.any((anti_here > 0) & pods.pod_matches_group[p][:, None], axis=0)
+    return ok & ~blocked
+
+
+def interpod_score(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) -> np.ndarray:
+    """Raw preferred-affinity score: incoming pod's weighted terms counted
+    over placed pods, plus the symmetric sum of placed pods' preferred
+    weights toward pods matching group g."""
+    gdom = _group_dom_per_node(ec)
+    cnt = _counts_at_nodes(st.match_count, gdom)  # [G, N]
+    raw = np.zeros(ec.num_nodes, dtype=np.float32)
+    for g, w in zip(pods.pref_aff[p], pods.pref_aff_w[p]):
+        if g >= 0:
+            raw += w * cnt[g]
+    wsum = _counts_at_nodes(st.pref_wsum, gdom)  # [G, N]
+    raw += (wsum * pods.pod_matches_group[p][:, None]).sum(axis=0)
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread ([K8S] podtopologyspread)
+# ---------------------------------------------------------------------------
+
+def spread_filter_mask(
+    ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int
+) -> np.ndarray:
+    N = ec.num_nodes
+    gdom = _group_dom_per_node(ec)
+    cnt = _counts_at_nodes(st.match_count, gdom)
+    ok = np.ones(N, dtype=bool)
+    for g, skew, dns in zip(pods.spread_g[p], pods.spread_skew[p], pods.spread_dns[p]):
+        if g < 0 or not dns:
+            continue
+        ti = ec.group_topo[g]
+        nd = int(ec.num_domains[ti])
+        if nd == 0:
+            ok &= False
+            continue
+        min_cnt = st.match_count[g, :nd].min()
+        self_match = float(pods.pod_matches_group[p, g])
+        new = cnt[g] + self_match
+        # Nodes missing the topology key fail DoNotSchedule constraints.
+        ok &= (gdom[g] >= 0) & (new - min_cnt <= skew)
+    return ok
+
+
+def spread_score(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) -> np.ndarray:
+    """Lower resulting match count → better (raw; reverse-normalized by the
+    caller). Simplified vs upstream's two-pass normalization; both paths use
+    the same formula so parity holds."""
+    gdom = _group_dom_per_node(ec)
+    cnt = _counts_at_nodes(st.match_count, gdom)
+    raw = np.zeros(ec.num_nodes, dtype=np.float32)
+    for g, dns in zip(pods.spread_g[p], pods.spread_dns[p]):
+        if g < 0:
+            continue
+        raw += cnt[g] + float(pods.pod_matches_group[p, g])
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# Normalization ([K8S] defaultNormalizeScore) and selection
+# ---------------------------------------------------------------------------
+
+def normalize_max(raw: np.ndarray, feasible: np.ndarray, reverse: bool = False) -> np.ndarray:
+    """Scale to [0, 100] by the max over feasible nodes; reverse flips."""
+    vals = np.where(feasible, raw, 0.0)
+    mx = vals.max() if feasible.any() else 0.0
+    if mx <= 0:
+        out = np.zeros_like(raw, dtype=np.float32)
+        return np.full_like(out, MAX_NODE_SCORE) if reverse else out
+    out = raw.astype(np.float32) * (MAX_NODE_SCORE / mx)
+    return MAX_NODE_SCORE - out if reverse else out
+
+
+def normalize_min_max(raw: np.ndarray, feasible: np.ndarray, reverse: bool = False) -> np.ndarray:
+    """Min-max scale over feasible nodes to [0, 100] (handles negatives —
+    [K8S] interpodaffinity normalization). Constant raw → all zeros."""
+    if not feasible.any():
+        return np.zeros_like(raw, dtype=np.float32)
+    vals = raw[feasible]
+    lo, hi = vals.min(), vals.max()
+    if hi == lo:
+        return np.zeros_like(raw, dtype=np.float32)
+    out = (raw - lo).astype(np.float32) * (MAX_NODE_SCORE / (hi - lo))
+    return MAX_NODE_SCORE - out if reverse else out
+
+
+def select_node(scores: np.ndarray, feasible: np.ndarray) -> int:
+    """Deterministic argmax with lowest-index tie-break (SURVEY.md §7 hard
+    part #6: CPU and device paths must break ties identically)."""
+    if not feasible.any():
+        return PAD
+    masked = np.where(feasible, scores, -np.inf)
+    return int(np.argmax(masked))

@@ -1,0 +1,202 @@
+"""Builtin scheduler plugins — CPU (numpy) default path.
+
+Mirrors the upstream kube-scheduler default plugin set named by [BASELINE]:
+NodeResourcesFit (LeastAllocated/MostAllocated/RequestedToCapacityRatio),
+TaintToleration, NodeAffinity, InterPodAffinity, PodTopologySpread, plus
+device-plugin extended resources (extra rows in the resource tensors) and
+Coscheduling (gang Permit — enforced by the runtime, see
+:mod:`..framework.framework`).
+
+Each plugin exposes vectorized-over-nodes ``filter``/``score``/``normalize``
+against the encoded state. The per-(pod, node) object-model oracle used by
+the unit tests lives in :mod:`.oracle`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models.encode import EncodedCluster, EncodedPods
+from ..models.state import SchedState
+from ..ops import cpu as K
+
+
+@dataclass
+class SchedulingContext:
+    """Per-replay immutable context handed to every plugin call."""
+
+    ec: EncodedCluster
+    pods: EncodedPods
+    expr_match: np.ndarray  # [N, E] — cached expr_match_matrix(ec)
+
+    @classmethod
+    def build(cls, ec: EncodedCluster, pods: EncodedPods) -> "SchedulingContext":
+        return cls(ec=ec, pods=pods, expr_match=K.expr_match_matrix(ec))
+
+
+class Plugin:
+    """Extension-point interface ([K8S] framework.Plugin). ``filter`` returns
+    a feasibility mask over all nodes (None = no opinion); ``score`` returns
+    raw per-node scores which ``normalize`` maps to [0, 100]."""
+
+    name: str = "Plugin"
+
+    def filter(self, ctx: SchedulingContext, st: SchedState, p: int) -> Optional[np.ndarray]:
+        return None
+
+    def score(self, ctx: SchedulingContext, st: SchedState, p: int) -> Optional[np.ndarray]:
+        return None
+
+    def normalize(self, raw: np.ndarray, feasible: np.ndarray) -> np.ndarray:
+        return raw
+
+
+class NodeResourcesFit(Plugin):
+    """[K8S] noderesources/fit. ``strategy`` ∈ {LeastAllocated, MostAllocated,
+    RequestedToCapacityRatio}; ``resources`` maps resource name → weight
+    (default cpu=1, memory=1). Extended resources participate in the Filter
+    unconditionally (they are rows of the tensors)."""
+
+    name = "NodeResourcesFit"
+
+    def __init__(
+        self,
+        ctx: SchedulingContext,
+        strategy: str = "LeastAllocated",
+        resources: Optional[Dict[str, float]] = None,
+        shape: Optional[List[dict]] = None,
+    ):
+        self.strategy = strategy
+        res = resources or {"cpu": 1.0, "memory": 1.0}
+        R = ctx.ec.num_resources
+        self.weights = np.zeros(R, dtype=np.float32)
+        for rname, w in res.items():
+            ri = ctx.ec.vocab._r.get(rname)
+            if ri is not None:
+                self.weights[ri] = w
+        pts = shape or [{"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]
+        self.shape_x = np.array([pt["utilization"] for pt in pts], dtype=np.float32)
+        self.shape_y = np.array([pt["score"] * 10.0 for pt in pts], dtype=np.float32)
+
+    def filter(self, ctx, st, p):
+        return K.fit_mask(ctx.ec, st, ctx.pods, p)
+
+    def score(self, ctx, st, p):
+        if self.strategy == "LeastAllocated":
+            return K.least_allocated_score(ctx.ec, st, ctx.pods, p, self.weights)
+        if self.strategy == "MostAllocated":
+            return K.most_allocated_score(ctx.ec, st, ctx.pods, p, self.weights)
+        return K.requested_to_capacity_ratio_score(
+            ctx.ec, st, ctx.pods, p, self.weights, self.shape_x, self.shape_y
+        )
+
+
+class TaintToleration(Plugin):
+    """[K8S] tainttoleration: Filter on untolerated NoSchedule/NoExecute;
+    Score prefers fewer untolerated PreferNoSchedule taints."""
+
+    name = "TaintToleration"
+
+    def __init__(self, ctx: SchedulingContext):
+        pass
+
+    def filter(self, ctx, st, p):
+        return K.taint_mask(ctx.ec, ctx.pods, p)
+
+    def score(self, ctx, st, p):
+        return K.taint_prefer_count(ctx.ec, ctx.pods, p)
+
+    def normalize(self, raw, feasible):
+        return K.normalize_max(raw, feasible, reverse=True)
+
+
+class NodeAffinity(Plugin):
+    """[K8S] nodeaffinity: required terms filter; preferred terms score."""
+
+    name = "NodeAffinity"
+
+    def __init__(self, ctx: SchedulingContext):
+        pass
+
+    def filter(self, ctx, st, p):
+        return K.node_affinity_mask(ctx.expr_match, ctx.pods, p)
+
+    def score(self, ctx, st, p):
+        return K.node_affinity_score(ctx.expr_match, ctx.pods, p)
+
+    def normalize(self, raw, feasible):
+        return K.normalize_max(raw, feasible)
+
+
+class InterPodAffinity(Plugin):
+    """[K8S] interpodaffinity over the count-group tensors (SURVEY.md §7
+    hard part #2): required (anti-)affinity filter incl. the symmetric
+    existing-pods'-anti-affinity check; preferred terms score both ways."""
+
+    name = "InterPodAffinity"
+
+    def __init__(self, ctx: SchedulingContext):
+        pass
+
+    def filter(self, ctx, st, p):
+        return K.interpod_filter_mask(ctx.ec, st, ctx.pods, p)
+
+    def score(self, ctx, st, p):
+        return K.interpod_score(ctx.ec, st, ctx.pods, p)
+
+    def normalize(self, raw, feasible):
+        return K.normalize_min_max(raw, feasible)
+
+
+class PodTopologySpread(Plugin):
+    """[K8S] podtopologyspread: DoNotSchedule constraints filter on maxSkew;
+    scoring prefers domains with fewer matching pods."""
+
+    name = "PodTopologySpread"
+
+    def __init__(self, ctx: SchedulingContext):
+        pass
+
+    def filter(self, ctx, st, p):
+        return K.spread_filter_mask(ctx.ec, st, ctx.pods, p)
+
+    def score(self, ctx, st, p):
+        return K.spread_score(ctx.ec, st, ctx.pods, p)
+
+    def normalize(self, raw, feasible):
+        return K.normalize_min_max(raw, feasible, reverse=True)
+
+
+PLUGIN_FACTORIES = {
+    "NodeResourcesFit": NodeResourcesFit,
+    "TaintToleration": TaintToleration,
+    "NodeAffinity": NodeAffinity,
+    "InterPodAffinity": InterPodAffinity,
+    "PodTopologySpread": PodTopologySpread,
+}
+
+#: Plugin name → default Score weight ([K8S] default profile weights).
+DEFAULT_WEIGHTS = {
+    "NodeResourcesFit": 1.0,
+    "TaintToleration": 3.0,
+    "NodeAffinity": 2.0,
+    "InterPodAffinity": 2.0,
+    "PodTopologySpread": 2.0,
+}
+
+
+def make_plugins(
+    ctx: SchedulingContext, plugin_config: Optional[List[dict]] = None
+) -> List[Plugin]:
+    """Instantiate a plugin list from config entries
+    ``[{"name": ..., "args": {...}}, ...]`` (default: full default set)."""
+    if plugin_config is None:
+        plugin_config = [{"name": n} for n in PLUGIN_FACTORIES]
+    out = []
+    for entry in plugin_config:
+        factory = PLUGIN_FACTORIES[entry["name"]]
+        out.append(factory(ctx, **entry.get("args", {})))
+    return out

@@ -1,0 +1,224 @@
+"""Pure-Python per-(pod, node) scheduling oracle.
+
+A third, independent implementation of the [K8S] plugin semantics that works
+directly on the object model (strings, dicts, dataclasses) with no encoding
+and no vectorization. It is deliberately slow and simple — it exists so the
+unit/parity tests can anchor the numpy and JAX paths against something whose
+correctness is auditable by eye (SURVEY.md §4 test strategy, tiers 1–2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..models.core import (
+    Cluster,
+    Effect,
+    Node,
+    Pod,
+    PodAffinityTerm,
+)
+
+MAX_NODE_SCORE = 100.0
+
+
+class OracleState:
+    """Placements as plain python: pod name → node name."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.placed: Dict[str, Tuple[Pod, str]] = {}
+        for p in cluster.pods:
+            if p.node_name:
+                self.placed[p.name] = (p, p.node_name)
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self.placed[pod.name] = (pod, node_name)
+
+    def unbind(self, pod: Pod) -> None:
+        self.placed.pop(pod.name, None)
+
+    def used(self, node: Node) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for p, nn in self.placed.values():
+            if nn == node.name:
+                for r, q in p.requests.items():
+                    out[r] = out.get(r, 0.0) + q
+        return out
+
+    def pods_on_domain(self, topology_key: str, domain_value: str) -> List[Pod]:
+        out = []
+        for p, nn in self.placed.values():
+            node = self.cluster.node_by_name(nn)
+            if node.labels.get(topology_key) == domain_value:
+                out.append(p)
+        return out
+
+
+def _term_matches_pod(term: PodAffinityTerm, owner_ns: str, pod: Pod) -> bool:
+    namespaces = term.namespaces or (owner_ns,)
+    return pod.namespace in namespaces and term.label_selector.matches(pod.labels)
+
+
+# -- filters ----------------------------------------------------------------
+
+def fits_resources(st: OracleState, pod: Pod, node: Node) -> bool:
+    used = st.used(node)
+    for r, q in pod.requests.items():
+        if used.get(r, 0.0) + q > node.allocatable.get(r, 110.0 if r == "pods" else 0.0) + 1e-6:
+            return False
+    return True
+
+
+def tolerates_taints(pod: Pod, node: Node) -> bool:
+    for t in node.taints:
+        if t.effect in (Effect.NO_SCHEDULE, Effect.NO_EXECUTE):
+            if not any(tol.tolerates(t) for tol in pod.tolerations):
+                return False
+    return True
+
+
+def prefer_no_schedule_count(pod: Pod, node: Node) -> int:
+    c = 0
+    for t in node.taints:
+        if t.effect == Effect.PREFER_NO_SCHEDULE:
+            if not any(tol.tolerates(t) for tol in pod.tolerations):
+                c += 1
+    return c
+
+
+def node_affinity_ok(pod: Pod, node: Node) -> bool:
+    req = pod.node_affinity.required
+    if not req:
+        return True
+    return any(term.matches(node.labels) for term in req)
+
+
+def interpod_ok(st: OracleState, pod: Pod, node: Node) -> bool:
+    # Required affinity (with the first-pod bootstrap exception).
+    for term in pod.pod_affinity.required:
+        dom = node.labels.get(term.topology_key)
+        anywhere = any(
+            _term_matches_pod(term, pod.namespace, q) for q, _ in st.placed.values()
+        )
+        if not anywhere and _term_matches_pod(term, pod.namespace, pod):
+            continue
+        if dom is None:
+            return False
+        if not any(
+            _term_matches_pod(term, pod.namespace, q)
+            for q in st.pods_on_domain(term.topology_key, dom)
+        ):
+            return False
+    # Incoming pod's required anti-affinity.
+    for term in pod.pod_anti_affinity.required:
+        dom = node.labels.get(term.topology_key)
+        if dom is None:
+            continue
+        if any(
+            _term_matches_pod(term, pod.namespace, q)
+            for q in st.pods_on_domain(term.topology_key, dom)
+        ):
+            return False
+    # Symmetric: placed pods' required anti-affinity vs this pod.
+    for q, nn in st.placed.values():
+        for term in q.pod_anti_affinity.required:
+            qnode = st.cluster.node_by_name(nn)
+            qdom = qnode.labels.get(term.topology_key)
+            dom = node.labels.get(term.topology_key)
+            if qdom is not None and dom == qdom and _term_matches_pod(term, q.namespace, pod):
+                return False
+    return True
+
+
+def spread_ok(st: OracleState, pod: Pod, node: Node) -> bool:
+    for c in pod.topology_spread:
+        if c.when_unsatisfiable != "DoNotSchedule":
+            continue
+        dom = node.labels.get(c.topology_key)
+        if dom is None:
+            return False
+        domains = sorted({n.labels[c.topology_key] for n in st.cluster.nodes if c.topology_key in n.labels})
+        if not domains:
+            return False
+        counts = {
+            d: sum(
+                1
+                for q in st.pods_on_domain(c.topology_key, d)
+                if q.namespace == pod.namespace and c.label_selector.matches(q.labels)
+            )
+            for d in domains
+        }
+        self_match = 1 if c.label_selector.matches(pod.labels) else 0
+        if counts[dom] + self_match - min(counts.values()) > c.max_skew:
+            return False
+    return True
+
+
+# -- scores -----------------------------------------------------------------
+
+def least_allocated(st: OracleState, pod: Pod, node: Node, weights: Dict[str, float]) -> float:
+    used = st.used(node)
+    total, wsum = 0.0, 0.0
+    for r, w in weights.items():
+        alloc = node.allocatable.get(r, 0.0)
+        wsum += w
+        if alloc <= 0:
+            continue
+        frac = (alloc - used.get(r, 0.0) - pod.requests.get(r, 0.0)) / alloc
+        total += w * min(max(frac, 0.0), 1.0)
+    return total * MAX_NODE_SCORE / wsum if wsum else 0.0
+
+
+def node_affinity_score(pod: Pod, node: Node) -> float:
+    return float(
+        sum(pt.weight for pt in pod.node_affinity.preferred if pt.term.matches(node.labels))
+    )
+
+
+def interpod_score(st: OracleState, pod: Pod, node: Node) -> float:
+    raw = 0.0
+    for wt in pod.pod_affinity.preferred:
+        dom = node.labels.get(wt.term.topology_key)
+        if dom is not None:
+            raw += wt.weight * sum(
+                1
+                for q in st.pods_on_domain(wt.term.topology_key, dom)
+                if _term_matches_pod(wt.term, pod.namespace, q)
+            )
+    for wt in pod.pod_anti_affinity.preferred:
+        dom = node.labels.get(wt.term.topology_key)
+        if dom is not None:
+            raw -= wt.weight * sum(
+                1
+                for q in st.pods_on_domain(wt.term.topology_key, dom)
+                if _term_matches_pod(wt.term, pod.namespace, q)
+            )
+    # Symmetric: placed pods' preferred terms toward the incoming pod.
+    for q, nn in st.placed.values():
+        qnode = st.cluster.node_by_name(nn)
+        for wt in q.pod_affinity.preferred:
+            if node.labels.get(wt.term.topology_key) == qnode.labels.get(wt.term.topology_key) \
+               and qnode.labels.get(wt.term.topology_key) is not None \
+               and _term_matches_pod(wt.term, q.namespace, pod):
+                raw += wt.weight
+        for wt in q.pod_anti_affinity.preferred:
+            if node.labels.get(wt.term.topology_key) == qnode.labels.get(wt.term.topology_key) \
+               and qnode.labels.get(wt.term.topology_key) is not None \
+               and _term_matches_pod(wt.term, q.namespace, pod):
+                raw -= wt.weight
+    return raw
+
+
+def spread_score(st: OracleState, pod: Pod, node: Node) -> float:
+    raw = 0.0
+    for c in pod.topology_spread:
+        dom = node.labels.get(c.topology_key)
+        if dom is None:
+            continue
+        raw += sum(
+            1
+            for q in st.pods_on_domain(c.topology_key, dom)
+            if q.namespace == pod.namespace and c.label_selector.matches(q.labels)
+        ) + (1 if c.label_selector.matches(pod.labels) else 0)
+    return raw

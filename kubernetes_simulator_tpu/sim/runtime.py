@@ -1,0 +1,261 @@
+"""Simulation runtime — layers L4/L7 (SURVEY.md §1, §3.1).
+
+Event-driven replay over a virtual clock: pod arrivals come from the trace,
+bindings update the shared state used by subsequent pods, pod completions
+free resources, node events perturb the cluster mid-replay (failure
+injection, SURVEY.md §5). No apiserver/kubelet — the simulator IS the fake
+backend (SURVEY.md §4.4).
+
+This module is the **cpu** strategy (the [BASELINE]-mandated default path).
+The `jax` strategy in :mod:`.jax_runtime` replays the same encoded trace as
+a fused device program and must produce placements this engine agrees with
+on parity workloads.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.framework import FrameworkConfig, SchedulerFramework, ScheduleResult
+from ..framework.queue import SchedulingQueue
+from ..framework.registry import register_strategy
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from ..models.state import SchedState, bind, init_state, unbind
+
+# Event kinds, in tie-break order at equal timestamps: node events first,
+# then completions (free resources), then arrivals, then permit timeouts.
+EV_NODE = 0
+EV_FINISH = 1
+EV_ARRIVAL = 2
+EV_PERMIT_TIMEOUT = 3
+
+DEFAULT_PERMIT_TIMEOUT = 600.0  # virtual seconds a gang may hold reservations
+
+
+@dataclass
+class NodeEvent:
+    """Cluster perturbation at a virtual timestamp (failure injection)."""
+
+    time: float
+    kind: str  # "node_down" | "node_up" | "capacity_scale"
+    node: int
+    scale: float = 1.0
+
+
+@dataclass
+class ReplayResult:
+    assignments: np.ndarray  # [P] i32 node per pod (PAD = never placed)
+    placed: int
+    unschedulable: int
+    preemptions: int
+    attempts: int
+    wall_clock_s: float
+    placements_per_sec: float
+    virtual_makespan: float
+    utilization: Dict[str, float]
+    state: SchedState
+
+    def summary(self) -> dict:
+        return {
+            "placed": self.placed,
+            "unschedulable": self.unschedulable,
+            "preemptions": self.preemptions,
+            "attempts": self.attempts,
+            "wall_clock_s": round(self.wall_clock_s, 4),
+            "placements_per_sec": round(self.placements_per_sec, 1),
+            "virtual_makespan": self.virtual_makespan,
+            "utilization": {k: round(v, 4) for k, v in self.utilization.items()},
+        }
+
+
+class CpuReplayEngine:
+    def __init__(
+        self,
+        ec: EncodedCluster,
+        pods: EncodedPods,
+        config: Optional[FrameworkConfig] = None,
+        permit_timeout: float = DEFAULT_PERMIT_TIMEOUT,
+    ):
+        self.ec = ec
+        self.pods = pods
+        self.fw = SchedulerFramework(ec, pods, config)
+        self.permit_timeout = permit_timeout
+
+    # -- helpers -----------------------------------------------------------
+
+    def _affinity_dependent(self, p: int) -> bool:
+        pods = self.pods
+        return bool(
+            pods.aff_req[p, 0] >= 0
+            or pods.anti_req[p, 0] >= 0
+            or pods.spread_g[p, 0] >= 0
+        )
+
+    # -- main loop ---------------------------------------------------------
+
+    def replay(self, node_events: Optional[List[NodeEvent]] = None) -> ReplayResult:
+        ec, pods = self.ec, self.pods
+        st = init_state(ec, pods)
+        q = SchedulingQueue()
+        events: List[Tuple[float, int, int, int]] = []  # (time, kind, seq, payload)
+        seq = 0
+
+        def push_event(t: float, kind: int, payload: int):
+            nonlocal seq
+            heapq.heappush(events, (t, kind, seq, payload))
+            seq += 1
+
+        to_schedule = np.nonzero(pods.bound_node == PAD)[0]
+        for p in to_schedule:
+            push_event(float(pods.arrival[p]), EV_ARRIVAL, int(p))
+        node_events = node_events or []
+        for i, ev in enumerate(node_events):
+            push_event(ev.time, EV_NODE, i)
+        # Completions of pre-bound pods.
+        for p in np.nonzero(pods.bound_node >= 0)[0]:
+            if np.isfinite(pods.duration[p]):
+                push_event(float(pods.arrival[p] + pods.duration[p]), EV_FINISH, int(p))
+
+        # Gang bookkeeping ([K8S] coscheduling Permit; SURVEY.md §3.3).
+        reserved: Dict[int, List[int]] = {}
+        failed_groups: Dict[int, float] = {}  # group → virtual time of failure
+        gang_timeout_seq: Dict[int, int] = {}
+
+        placed = preemptions = attempts = 0
+        now = 0.0
+        saved_alloc = ec.allocatable.copy()
+        t0 = time.perf_counter()
+
+        def rollback_group(g: int):
+            nonlocal placed
+            for m in reserved.pop(g, []):
+                unbind(ec, pods, st, m)
+                q.mark_unschedulable(m, int(pods.priority[m]))
+            failed_groups[g] = now
+
+        def evict(p: int, requeue: bool = True):
+            unbind(ec, pods, st, int(p))
+            if requeue:
+                q.push(int(p), int(pods.priority[p]))
+
+        while events or len(q):
+            if events:
+                now = max(now, events[0][0])
+                progressed_cluster = False
+                while events and events[0][0] <= now:
+                    _, kind, _, payload = heapq.heappop(events)
+                    if kind == EV_ARRIVAL:
+                        q.push(payload, int(pods.priority[payload]))
+                    elif kind == EV_FINISH:
+                        if st.bound[payload] != PAD:
+                            unbind(ec, pods, st, payload)
+                            progressed_cluster = True
+                    elif kind == EV_NODE:
+                        ev = node_events[payload]
+                        if ev.kind == "node_down":
+                            ec.allocatable[ev.node] = 0.0
+                            # NoExecute semantics: evict and requeue ([K8S]).
+                            for m in np.nonzero(st.bound == ev.node)[0]:
+                                evict(int(m))
+                        elif ev.kind == "node_up":
+                            ec.allocatable[ev.node] = saved_alloc[ev.node]
+                        elif ev.kind == "capacity_scale":
+                            ec.allocatable[ev.node] = saved_alloc[ev.node] * ev.scale
+                        progressed_cluster = True
+                    elif kind == EV_PERMIT_TIMEOUT:
+                        g = payload
+                        if g in reserved and gang_timeout_seq.get(g) is not None:
+                            rollback_group(g)
+                if progressed_cluster:
+                    q.flush_unschedulable()
+            q.flush_backoff(now)
+
+            made_bind = False
+            while True:
+                p = q.pop()
+                if p is None:
+                    break
+                g = int(pods.group_id[p])
+                if g != PAD and g in failed_groups and failed_groups[g] == now:
+                    # Group already failed at this instant; retry later.
+                    q.mark_unschedulable(p, int(pods.priority[p]))
+                    continue
+                attempts += 1
+                res = self.fw.schedule_one(st, p)
+                if res.node == PAD:
+                    if g != PAD and g in reserved:
+                        rollback_group(g)
+                    q.mark_unschedulable(p, int(pods.priority[p]))
+                    continue
+                for v in res.victims:
+                    evict(v)
+                    preemptions += 1
+                bind(ec, pods, st, p, res.node)
+                if g != PAD:
+                    members = reserved.setdefault(g, [])
+                    if not members:
+                        push_event(now + self.permit_timeout, EV_PERMIT_TIMEOUT, g)
+                        gang_timeout_seq[g] = seq
+                    members.append(p)
+                    if len(members) >= int(pods.pg_min_member[g]):
+                        # Permit: whole gang reserved → commit.
+                        for m in reserved.pop(g):
+                            placed += 1
+                            made_bind = True
+                            if np.isfinite(pods.duration[m]):
+                                push_event(now + float(pods.duration[m]), EV_FINISH, m)
+                        gang_timeout_seq.pop(g, None)
+                        failed_groups.pop(g, None)
+                else:
+                    placed += 1
+                    made_bind = True
+                    if np.isfinite(pods.duration[p]):
+                        push_event(now + float(pods.duration[p]), EV_FINISH, p)
+                if made_bind and q.num_unschedulable:
+                    # Binding is a cluster event for affinity/spread waiters.
+                    q.flush_unschedulable()
+            # Idle until the next event (or backoff expiry).
+            nb = q.next_backoff_time()
+            if not events and len(q) == 0 and nb is not None:
+                now = max(now, nb)
+                q.flush_backoff(now)
+                if len(q) == 0:
+                    break
+
+        # Any still-reserved gang at trace end never completed → roll back.
+        for g in list(reserved):
+            rollback_group(g)
+
+        wall = time.perf_counter() - t0
+        ec.allocatable[:] = saved_alloc
+        util = {}
+        for rname in ("cpu", "memory"):
+            ri = ec.vocab._r.get(rname)
+            if ri is not None:
+                alloc = ec.allocatable[:, ri]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    u = np.where(alloc > 0, st.used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
+                util[rname] = float(u.mean())
+        unsched = int((st.bound[to_schedule] == PAD).sum())
+        return ReplayResult(
+            assignments=st.bound.copy(),
+            placed=placed,
+            unschedulable=unsched,
+            preemptions=preemptions,
+            attempts=attempts,
+            wall_clock_s=wall,
+            placements_per_sec=placed / wall if wall > 0 else 0.0,
+            virtual_makespan=now,
+            utilization=util,
+            state=st,
+        )
+
+
+@register_strategy("cpu")
+def _make_cpu(ec: EncodedCluster, pods: EncodedPods, config: Optional[FrameworkConfig] = None, **kw):
+    return CpuReplayEngine(ec, pods, config, **kw)

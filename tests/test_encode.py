@@ -1,0 +1,95 @@
+import numpy as np
+
+from kubernetes_simulator_tpu import (
+    Cluster,
+    LabelSelector,
+    MatchExpression,
+    Node,
+    Pod,
+    PodAffinitySpec,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    encode,
+)
+from kubernetes_simulator_tpu.models.encode import PAD, TOL_WILDCARD
+from kubernetes_simulator_tpu.utils.quantity import parse_quantity
+
+
+def test_parse_quantity():
+    assert parse_quantity("100m") == 0.1
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("1Ki") == 1024.0
+    assert parse_quantity("1.5Gi") == 1.5 * 2**30
+    assert parse_quantity("2k") == 2000.0
+    assert parse_quantity(3) == 3.0
+
+
+def _tiny():
+    nodes = [
+        Node("n0", {"cpu": 4, "memory": "8Gi"}, labels={"zone": "a"},
+             taints=[Taint("dedicated", "gpu", )]),
+        Node("n1", {"cpu": 8, "memory": "16Gi", "google.com/tpu": 4}, labels={"zone": "b"}),
+    ]
+    pods = [
+        Pod("p0", requests={"cpu": 1}, labels={"app": "web"},
+            tolerations=[Toleration(key="dedicated", operator="Exists")]),
+        Pod("p1", requests={"cpu": "500m", "google.com/tpu": 2},
+            pod_affinity=PodAffinitySpec(required=(
+                PodAffinityTerm(LabelSelector.make({"app": "web"}), "zone"),
+            ))),
+    ]
+    return Cluster(nodes=nodes), pods
+
+
+def test_encode_shapes_and_vocab():
+    cluster, pods = _tiny()
+    ec, ep = encode(cluster, pods)
+    assert ec.num_nodes == 2
+    assert ep.num_pods == 2
+    # cpu, memory, pods seeded + extended resource discovered
+    assert "google.com/tpu" in ec.vocab.resources
+    ri = ec.vocab._r["google.com/tpu"]
+    assert ec.allocatable[1, ri] == 4
+    assert ep.requests[1, ri] == 2
+    # pods slot defaults
+    pi = ec.vocab._r["pods"]
+    assert ec.allocatable[0, pi] == 110
+    assert ep.requests[0, pi] == 1
+    # hostname label is implicit
+    assert "kubernetes.io/hostname" in ec.vocab.keys
+
+
+def test_encode_tolerations():
+    cluster, pods = _tiny()
+    ec, ep = encode(cluster, pods)
+    # p0 tolerates key=dedicated with Exists → kv is PAD, key real
+    assert ep.tol_key[0, 0] >= 0
+    assert ep.tol_kv[0, 0] == PAD
+    # p1 has no tolerations → padded row
+    assert (ep.tol_key[1] < TOL_WILDCARD + 1).all() or ep.tol_key.shape[1] == 1
+
+
+def test_encode_count_groups_and_domains():
+    cluster, pods = _tiny()
+    ec, ep = encode(cluster, pods)
+    assert ec.num_groups == 1
+    assert ep.aff_req[1, 0] == 0
+    # zone domains: a→0, b→1 (sorted)
+    ti = ec.vocab._t["zone"]
+    assert ec.num_domains[ti] == 2
+    assert ec.node_domain[ti, 0] == 0 and ec.node_domain[ti, 1] == 1
+    # pod p0 (app=web) matches the group selector; p1 does not
+    assert ep.pod_matches_group[0, 0]
+    assert not ep.pod_matches_group[1, 0]
+
+
+def test_encode_prebound_and_groups():
+    cluster, pods = _tiny()
+    pods[0].node_name = "n1"
+    pods[0].pod_group = "g1"
+    pods[1].pod_group = "g1"
+    ec, ep = encode(cluster, pods)
+    assert ep.bound_node[0] == 1 and ep.bound_node[1] == PAD
+    assert ep.group_id[0] == ep.group_id[1] == 0
+    assert ep.pg_min_member[0] == 2  # inferred from membership

@@ -1,0 +1,207 @@
+"""Randomized oracle ↔ numpy-path parity (SURVEY.md §4 tiers 1-2).
+
+The pure-Python oracle interprets the object model directly; the numpy path
+interprets the encoded tensors. For random clusters/pods every plugin's
+filter mask and selection must agree exactly.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.models.core import (
+    Cluster,
+    LabelSelector,
+    MatchExpression,
+    Node,
+    NodeAffinitySpec,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinitySpec,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.models.state import bind, init_state
+from kubernetes_simulator_tpu.ops import cpu as K
+from kubernetes_simulator_tpu.plugins import oracle as O
+
+
+def random_cluster_pods(seed: int, num_nodes: int = 12, num_pods: int = 30):
+    rng = np.random.default_rng(seed)
+    zones = ["za", "zb", "zc"]
+    nodes = []
+    for i in range(num_nodes):
+        labels = {
+            "zone": zones[int(rng.integers(3))],
+            "disk": rng.choice(["ssd", "hdd"]),
+            "gen": str(int(rng.integers(1, 9))),
+        }
+        taints = []
+        if rng.random() < 0.3:
+            taints.append(
+                Taint(
+                    rng.choice(["dedicated", "special"]),
+                    rng.choice(["a", "b"]),
+                    rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+                )
+            )
+        nodes.append(
+            Node(
+                f"n{i}",
+                {"cpu": float(rng.integers(2, 16)), "memory": float(rng.integers(4, 64)) * 2**30},
+                labels=labels,
+                taints=taints,
+            )
+        )
+    pods = []
+    for j in range(num_pods):
+        labels = {"app": rng.choice(["web", "db", "cache"]), "tier": rng.choice(["fe", "be"])}
+        p = Pod(
+            f"p{j}",
+            labels=labels,
+            requests={"cpu": float(rng.choice([0.5, 1, 2, 4])), "memory": float(rng.choice([1, 2, 8])) * 2**30},
+            priority=int(rng.integers(0, 3)) * 100,
+            arrival_time=float(j),
+        )
+        if rng.random() < 0.4:
+            p.tolerations.append(
+                Toleration(
+                    key=rng.choice(["dedicated", "special"]),
+                    operator=rng.choice(["Equal", "Exists"]),
+                    value=rng.choice(["a", "b"]),
+                )
+            )
+        r = rng.random()
+        if r < 0.25:
+            op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"])
+            vals = {"In": ["ssd"], "NotIn": ["hdd"], "Exists": [], "DoesNotExist": [], "Gt": ["4"], "Lt": ["5"]}[op]
+            key = "gen" if op in ("Gt", "Lt") else "disk"
+            p.node_affinity = NodeAffinitySpec(
+                required=(NodeSelectorTerm((MatchExpression.make(key, op, vals),)),)
+            )
+        elif r < 0.4:
+            p.node_affinity = NodeAffinitySpec(
+                preferred=(
+                    PreferredSchedulingTerm(
+                        weight=int(rng.integers(1, 50)),
+                        term=NodeSelectorTerm((MatchExpression.make("disk", "In", ["ssd"]),)),
+                    ),
+                )
+            )
+        r = rng.random()
+        if r < 0.15:
+            p.pod_affinity = PodAffinitySpec(
+                required=(PodAffinityTerm(LabelSelector.make({"app": str(labels["app"])}), "zone"),)
+            )
+        elif r < 0.3:
+            p.pod_anti_affinity = PodAffinitySpec(
+                required=(
+                    PodAffinityTerm(
+                        LabelSelector.make({"app": str(labels["app"])}), "kubernetes.io/hostname"
+                    ),
+                )
+            )
+        elif r < 0.45:
+            p.pod_affinity = PodAffinitySpec(
+                preferred=(
+                    WeightedPodAffinityTerm(
+                        int(rng.integers(1, 50)),
+                        PodAffinityTerm(LabelSelector.make({"tier": "be"}), "zone"),
+                    ),
+                )
+            )
+        if rng.random() < 0.3:
+            p.topology_spread.append(
+                TopologySpreadConstraint(
+                    max_skew=int(rng.choice([1, 2])),
+                    topology_key="zone",
+                    when_unsatisfiable=rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                    label_selector=LabelSelector.make({"app": str(labels["app"])}),
+                )
+            )
+        pods.append(p)
+    return Cluster(nodes=nodes), pods
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_filter_masks_match_oracle(seed):
+    cluster, pods = random_cluster_pods(seed)
+    ec, ep = encode(cluster, pods)
+    st = init_state(ec, ep)
+    ost = O.OracleState(cluster)
+    M = K.expr_match_matrix(ec)
+    rng = np.random.default_rng(seed + 99)
+
+    for p_idx, pod in enumerate(pods):
+        fit_np = K.fit_mask(ec, st, ep, p_idx)
+        taint_np = K.taint_mask(ec, ep, p_idx)
+        na_np = K.node_affinity_mask(M, ep, p_idx)
+        ipa_np = K.interpod_filter_mask(ec, st, ep, p_idx)
+        spr_np = K.spread_filter_mask(ec, st, ep, p_idx)
+        for n_idx, node in enumerate(cluster.nodes):
+            assert fit_np[n_idx] == O.fits_resources(ost, pod, node), (p_idx, n_idx, "fit")
+            assert taint_np[n_idx] == O.tolerates_taints(pod, node), (p_idx, n_idx, "taint")
+            assert na_np[n_idx] == O.node_affinity_ok(pod, node), (p_idx, n_idx, "nodeaff")
+            assert ipa_np[n_idx] == O.interpod_ok(ost, pod, node), (p_idx, n_idx, "ipa")
+            assert spr_np[n_idx] == O.spread_ok(ost, pod, node), (p_idx, n_idx, "spread")
+        # Place the pod on a random feasible node in BOTH states and go on.
+        mask = fit_np & taint_np & na_np & ipa_np & spr_np
+        if mask.any():
+            n_idx = int(rng.choice(np.nonzero(mask)[0]))
+            bind(ec, ep, st, p_idx, n_idx)
+            ost.bind(pod, cluster.nodes[n_idx].name)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_scores_match_oracle(seed):
+    cluster, pods = random_cluster_pods(seed, num_nodes=10, num_pods=20)
+    ec, ep = encode(cluster, pods)
+    st = init_state(ec, ep)
+    ost = O.OracleState(cluster)
+    M = K.expr_match_matrix(ec)
+    weights = np.zeros(ec.num_resources, dtype=np.float32)
+    weights[ec.vocab._r["cpu"]] = 1.0
+    weights[ec.vocab._r["memory"]] = 1.0
+    rng = np.random.default_rng(seed + 7)
+
+    for p_idx, pod in enumerate(pods):
+        la_np = K.least_allocated_score(ec, st, ep, p_idx, weights)
+        naw_np = K.node_affinity_score(M, ep, p_idx)
+        ipa_np = K.interpod_score(ec, st, ep, p_idx)
+        spr_np = K.spread_score(ec, st, ep, p_idx)
+        tt_np = K.taint_prefer_count(ec, ep, p_idx)
+        for n_idx, node in enumerate(cluster.nodes):
+            assert la_np[n_idx] == pytest.approx(
+                O.least_allocated(ost, pod, node, {"cpu": 1.0, "memory": 1.0}), abs=1e-3
+            )
+            assert naw_np[n_idx] == pytest.approx(O.node_affinity_score(pod, node))
+            assert ipa_np[n_idx] == pytest.approx(O.interpod_score(ost, pod, node)), (p_idx, n_idx)
+            assert spr_np[n_idx] == pytest.approx(O.spread_score(ost, pod, node))
+            assert tt_np[n_idx] == O.prefer_no_schedule_count(pod, node)
+        mask = K.fit_mask(ec, st, ep, p_idx) & K.taint_mask(ec, ep, p_idx)
+        if mask.any():
+            n_idx = int(rng.choice(np.nonzero(mask)[0]))
+            bind(ec, ep, st, p_idx, n_idx)
+            ost.bind(pod, cluster.nodes[n_idx].name)
+
+
+def test_bind_unbind_roundtrip():
+    cluster, pods = random_cluster_pods(3)
+    ec, ep = encode(cluster, pods)
+    st = init_state(ec, ep)
+    snap = st.copy()
+    from kubernetes_simulator_tpu.models.state import unbind
+
+    for p in range(10):
+        bind(ec, ep, st, p, p % ec.num_nodes)
+    for p in range(10):
+        unbind(ec, ep, st, p)
+    assert np.allclose(st.used, snap.used)
+    assert np.allclose(st.match_count, snap.match_count)
+    assert np.allclose(st.anti_active, snap.anti_active)
+    assert np.allclose(st.pref_wsum, snap.pref_wsum)
+    assert (st.bound == snap.bound).all()

@@ -1,0 +1,296 @@
+"""Table-driven plugin unit tests mirroring upstream kube-scheduler plugin
+test tables ([K8S] semantics are the spec — SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.models.core import (
+    Cluster,
+    LabelSelector,
+    MatchExpression,
+    Node,
+    NodeAffinitySpec,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinitySpec,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.models.state import bind, init_state
+from kubernetes_simulator_tpu.ops import cpu as K
+
+
+def masks_for(cluster, pods, p=0, prebind=()):
+    ec, ep = encode(cluster, pods)
+    st = init_state(ec, ep)
+    for pi, ni in prebind:
+        bind(ec, ep, st, pi, ni)
+    M = K.expr_match_matrix(ec)
+    return ec, ep, st, M
+
+
+class TestNodeResourcesFit:
+    def test_over_under_commit_edges(self):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 2, "memory": 4 * 2**30})])
+        pods = [
+            Pod("fits-exact", requests={"cpu": 2}),
+            Pod("over", requests={"cpu": 2.5}),
+            Pod("mem-over", requests={"memory": 5 * 2**30}),
+        ]
+        ec, ep, st, _ = masks_for(cluster, pods)
+        assert K.fit_mask(ec, st, ep, 0)[0]
+        assert not K.fit_mask(ec, st, ep, 1)[0]
+        assert not K.fit_mask(ec, st, ep, 2)[0]
+
+    def test_fit_accounts_existing_usage(self):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 4})])
+        pods = [Pod("a", requests={"cpu": 3}), Pod("b", requests={"cpu": 2})]
+        ec, ep, st, _ = masks_for(cluster, pods, prebind=[(0, 0)])
+        assert not K.fit_mask(ec, st, ep, 1)[0]
+
+    def test_pods_slot_limit(self):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 100, "pods": 1})])
+        pods = [Pod("a", requests={}), Pod("b", requests={})]
+        ec, ep, st, _ = masks_for(cluster, pods, prebind=[(0, 0)])
+        assert not K.fit_mask(ec, st, ep, 1)[0]
+
+    def test_extended_resource(self):
+        cluster = Cluster(
+            nodes=[Node("gpu", {"cpu": 4, "nvidia.com/gpu": 2}), Node("plain", {"cpu": 4})]
+        )
+        pods = [Pod("wants-gpu", requests={"nvidia.com/gpu": 1})]
+        ec, ep, st, _ = masks_for(cluster, pods)
+        m = K.fit_mask(ec, st, ep, 0)
+        assert m[0] and not m[1]
+
+    def test_least_allocated_prefers_empty(self):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 4, "memory": 8 * 2**30}),
+                                 Node("n1", {"cpu": 4, "memory": 8 * 2**30})])
+        pods = [Pod("a", requests={"cpu": 2}), Pod("b", requests={"cpu": 1})]
+        ec, ep, st, _ = masks_for(cluster, pods, prebind=[(0, 0)])
+        w = np.zeros(ec.num_resources, dtype=np.float32)
+        w[ec.vocab._r["cpu"]] = 1
+        w[ec.vocab._r["memory"]] = 1
+        s = K.least_allocated_score(ec, st, ep, 1, w)
+        assert s[1] > s[0]
+
+    def test_most_allocated_prefers_packed(self):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 4}), Node("n1", {"cpu": 4})])
+        pods = [Pod("a", requests={"cpu": 2}), Pod("b", requests={"cpu": 1})]
+        ec, ep, st, _ = masks_for(cluster, pods, prebind=[(0, 0)])
+        w = np.zeros(ec.num_resources, dtype=np.float32)
+        w[ec.vocab._r["cpu"]] = 1
+        s = K.most_allocated_score(ec, st, ep, 1, w)
+        assert s[0] > s[1]
+
+
+class TestTaintToleration:
+    """Toleration operator matrix ([K8S] v1.Toleration)."""
+
+    CASES = [
+        # (taint, toleration, tolerated?)
+        (Taint("k", "v", "NoSchedule"), Toleration(key="k", operator="Equal", value="v"), True),
+        (Taint("k", "v", "NoSchedule"), Toleration(key="k", operator="Equal", value="w"), False),
+        (Taint("k", "v", "NoSchedule"), Toleration(key="k", operator="Exists"), True),
+        (Taint("k", "v", "NoSchedule"), Toleration(key="other", operator="Exists"), False),
+        (Taint("k", "v", "NoSchedule"), Toleration(key=None, operator="Exists"), True),
+        (Taint("k", "v", "NoSchedule"),
+         Toleration(key="k", operator="Equal", value="v", effect="NoExecute"), False),
+        (Taint("k", "v", "NoExecute"),
+         Toleration(key="k", operator="Equal", value="v", effect="NoExecute"), True),
+    ]
+
+    @pytest.mark.parametrize("taint,tol,want", CASES)
+    def test_matrix(self, taint, tol, want):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 1}, taints=[taint])])
+        pods = [Pod("p", tolerations=[tol])]
+        ec, ep, st, _ = masks_for(cluster, pods)
+        assert bool(K.taint_mask(ec, ep, 0)[0]) == want
+
+    def test_prefer_no_schedule_scores_not_filters(self):
+        cluster = Cluster(
+            nodes=[Node("soft", {"cpu": 1}, taints=[Taint("k", "v", "PreferNoSchedule")]),
+                   Node("clean", {"cpu": 1})]
+        )
+        pods = [Pod("p")]
+        ec, ep, st, _ = masks_for(cluster, pods)
+        assert K.taint_mask(ec, ep, 0).all()
+        cnt = K.taint_prefer_count(ec, ep, 0)
+        assert cnt[0] == 1 and cnt[1] == 0
+        norm = K.normalize_max(cnt, np.array([True, True]), reverse=True)
+        assert norm[1] > norm[0]
+
+
+class TestNodeAffinity:
+    """Operator matrix over required nodeSelectorTerms ([K8S] nodeaffinity)."""
+
+    @pytest.mark.parametrize(
+        "op,vals,labels,want",
+        [
+            ("In", ["ssd"], {"disk": "ssd"}, True),
+            ("In", ["ssd"], {"disk": "hdd"}, False),
+            ("In", ["ssd"], {}, False),
+            ("NotIn", ["ssd"], {"disk": "hdd"}, True),
+            ("NotIn", ["ssd"], {"disk": "ssd"}, False),
+            ("NotIn", ["ssd"], {}, True),
+            ("Exists", [], {"disk": "x"}, True),
+            ("Exists", [], {}, False),
+            ("DoesNotExist", [], {}, True),
+            ("DoesNotExist", [], {"disk": "x"}, False),
+            ("Gt", ["4"], {"disk": "9"}, True),
+            ("Gt", ["4"], {"disk": "3"}, False),
+            ("Gt", ["4"], {"disk": "abc"}, False),
+            ("Lt", ["4"], {"disk": "3"}, True),
+            ("Lt", ["4"], {"disk": "9"}, False),
+        ],
+    )
+    def test_operator_matrix(self, op, vals, labels, want):
+        cluster = Cluster(nodes=[Node("n0", {"cpu": 1}, labels=dict(labels))])
+        pod = Pod(
+            "p",
+            node_affinity=NodeAffinitySpec(
+                required=(NodeSelectorTerm((MatchExpression.make("disk", op, vals),)),)
+            ),
+        )
+        ec, ep, st, M = masks_for(cluster, [pod])
+        assert bool(K.node_affinity_mask(M, ep, 0)[0]) == want
+
+    def test_terms_are_ored_expressions_anded(self):
+        cluster = Cluster(
+            nodes=[Node("n0", {"cpu": 1}, labels={"a": "1", "b": "2"}),
+                   Node("n1", {"cpu": 1}, labels={"a": "1"}),
+                   Node("n2", {"cpu": 1}, labels={"c": "3"})]
+        )
+        pod = Pod(
+            "p",
+            node_affinity=NodeAffinitySpec(
+                required=(
+                    NodeSelectorTerm(
+                        (MatchExpression.make("a", "In", ["1"]), MatchExpression.make("b", "In", ["2"]))
+                    ),
+                    NodeSelectorTerm((MatchExpression.make("c", "In", ["3"]),)),
+                )
+            ),
+        )
+        ec, ep, st, M = masks_for(cluster, [pod])
+        m = K.node_affinity_mask(M, ep, 0)
+        assert m[0] and not m[1] and m[2]
+
+
+class TestInterPodAffinity:
+    def _cluster(self):
+        return Cluster(
+            nodes=[
+                Node("a1", {"cpu": 8}, labels={"zone": "za"}),
+                Node("a2", {"cpu": 8}, labels={"zone": "za"}),
+                Node("b1", {"cpu": 8}, labels={"zone": "zb"}),
+            ]
+        )
+
+    def test_required_affinity_needs_matching_pod_in_domain(self):
+        pods = [
+            Pod("web", labels={"app": "web"}),
+            Pod(
+                "follower",
+                pod_affinity=PodAffinitySpec(
+                    required=(PodAffinityTerm(LabelSelector.make({"app": "web"}), "zone"),)
+                ),
+            ),
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods, prebind=[(0, 0)])
+        m = K.interpod_filter_mask(ec, st, ep, 1)
+        assert m[0] and m[1] and not m[2]
+
+    def test_bootstrap_self_match(self):
+        """First pod matching its own affinity term may go anywhere [K8S]."""
+        pods = [
+            Pod(
+                "seed",
+                labels={"app": "web"},
+                pod_affinity=PodAffinitySpec(
+                    required=(PodAffinityTerm(LabelSelector.make({"app": "web"}), "zone"),)
+                ),
+            )
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods)
+        assert K.interpod_filter_mask(ec, st, ep, 0).all()
+
+    def test_anti_affinity_blocks_domain(self):
+        pods = [
+            Pod("lead", labels={"role": "leader"}),
+            Pod(
+                "rival",
+                pod_anti_affinity=PodAffinitySpec(
+                    required=(PodAffinityTerm(LabelSelector.make({"role": "leader"}), "zone"),)
+                ),
+            ),
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods, prebind=[(0, 0)])
+        m = K.interpod_filter_mask(ec, st, ep, 1)
+        assert not m[0] and not m[1] and m[2]
+
+    def test_symmetric_anti_affinity(self):
+        """A placed pod's anti-affinity term rejects matching newcomers."""
+        pods = [
+            Pod(
+                "hermit",
+                labels={"app": "web"},
+                pod_anti_affinity=PodAffinitySpec(
+                    required=(PodAffinityTerm(LabelSelector.make({"app": "web"}), "zone"),)
+                ),
+            ),
+            Pod("web2", labels={"app": "web"}),
+            Pod("other", labels={"app": "db"}),
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods, prebind=[(0, 0)])
+        m_web = K.interpod_filter_mask(ec, st, ep, 1)
+        m_db = K.interpod_filter_mask(ec, st, ep, 2)
+        assert not m_web[0] and not m_web[1] and m_web[2]
+        assert m_db.all()
+
+
+class TestPodTopologySpread:
+    def _cluster(self):
+        return Cluster(
+            nodes=[
+                Node("a1", {"cpu": 8}, labels={"zone": "za"}),
+                Node("b1", {"cpu": 8}, labels={"zone": "zb"}),
+                Node("nolabel", {"cpu": 8}, labels={}),
+            ]
+        )
+
+    def test_max_skew_boundary(self):
+        sel = LabelSelector.make({"app": "web"})
+        pods = [
+            Pod("w1", labels={"app": "web"}),
+            Pod("w2", labels={"app": "web"}),
+            Pod(
+                "w3",
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(1, "zone", "DoNotSchedule", sel)
+                ],
+            ),
+        ]
+        # za has 2 pods, zb has 0 → placing in za gives skew 3 > 1; zb ok.
+        ec, ep, st, _ = masks_for(self._cluster(), pods, prebind=[(0, 0), (1, 0)])
+        m = K.spread_filter_mask(ec, st, ep, 2)
+        assert not m[0] and m[1]
+        # Node without the topology key always fails DoNotSchedule.
+        assert not m[2]
+
+    def test_schedule_anyway_does_not_filter(self):
+        sel = LabelSelector.make({"app": "web"})
+        pods = [
+            Pod("w1", labels={"app": "web"}),
+            Pod("w2", labels={"app": "web"},
+                topology_spread=[TopologySpreadConstraint(1, "zone", "ScheduleAnyway", sel)]),
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods, prebind=[(0, 0)])
+        m = K.spread_filter_mask(ec, st, ep, 1)
+        assert m[0] and m[1]
+        s = K.spread_score(ec, st, ep, 1)
+        assert s[1] < s[0]  # zb less crowded → lower raw (better after reverse)

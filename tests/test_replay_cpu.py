@@ -1,0 +1,128 @@
+"""CPU replay engine: config #1 baseline shape, determinism, gangs,
+preemption, completions, failure injection (SURVEY.md §4.3, §4.6, §5)."""
+
+import numpy as np
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.framework.registry import get_strategy
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine, NodeEvent
+from kubernetes_simulator_tpu.sim.synthetic import config1, make_cluster, make_workload
+
+
+def run(cluster, pods, plugins=None, **kw):
+    ec, ep = encode(cluster, pods)
+    eng = CpuReplayEngine(ec, ep, FrameworkConfig(plugins=plugins), **kw)
+    return eng.replay(), ec, ep
+
+
+def test_config1_places_everything():
+    cluster, pods, plugins = config1(num_nodes=50, num_pods=300)
+    res, ec, ep = run(cluster, pods, plugins)
+    assert res.placed == 300
+    assert res.unschedulable == 0
+    assert res.placements_per_sec > 0
+
+
+def test_determinism_same_seed_same_placements():
+    cluster, pods, plugins = config1(num_nodes=30, num_pods=200)
+    res1, _, _ = run(cluster, pods, plugins)
+    cluster2, pods2, _ = config1(num_nodes=30, num_pods=200)
+    res2, _, _ = run(cluster2, pods2, plugins)
+    assert (res1.assignments == res2.assignments).all()
+
+
+def test_full_plugin_set_runs():
+    cluster = make_cluster(30, seed=1, taint_fraction=0.2)
+    pods, _ = make_workload(150, seed=1, with_affinity=True, with_spread=True,
+                            with_tolerations=True)
+    res, ec, ep = run(cluster, pods)
+    assert res.placed + res.unschedulable == 150
+    assert res.placed > 100
+
+
+def test_registry_selects_cpu():
+    factory = get_strategy("cpu")
+    cluster, pods, plugins = config1(num_nodes=10, num_pods=20)
+    ec, ep = encode(cluster, pods)
+    eng = factory(ec, ep, FrameworkConfig(plugins=plugins))
+    assert eng.replay().placed == 20
+
+
+def test_completions_free_resources():
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 2})])
+    pods = [
+        Pod("a", requests={"cpu": 2}, arrival_time=0.0, duration=10.0),
+        Pod("b", requests={"cpu": 2}, arrival_time=1.0),
+    ]
+    res, _, _ = run(cluster, pods)
+    # b can't fit until a finishes at t=10, then must be placed.
+    assert res.placed == 2
+    assert res.virtual_makespan >= 10.0
+
+
+def test_gang_all_or_nothing():
+    # Gang of 3 needs 3 cpu total but cluster has 2 → nothing placed.
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 2})])
+    pods = [
+        Pod(f"g{i}", requests={"cpu": 1}, arrival_time=float(i), pod_group="gang")
+        for i in range(3)
+    ]
+    res, ec, ep = run(cluster, pods)
+    assert res.placed == 0
+    assert (res.assignments == PAD).all()
+    # State must be fully rolled back (SURVEY.md §7 hard part #3).
+    assert np.allclose(res.state.used, 0.0)
+
+
+def test_gang_commits_when_feasible():
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 4})])
+    pods = [
+        Pod(f"g{i}", requests={"cpu": 1}, arrival_time=float(i), pod_group="gang")
+        for i in range(3)
+    ]
+    res, _, _ = run(cluster, pods)
+    assert res.placed == 3
+
+
+def test_preemption_evicts_lower_priority():
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 2})])
+    pods = [
+        Pod("low", requests={"cpu": 2}, priority=0, arrival_time=0.0),
+        Pod("high", requests={"cpu": 2}, priority=1000, arrival_time=1.0),
+    ]
+    res, _, ep = run(cluster, pods)
+    assert res.preemptions == 1
+    assert res.assignments[1] == 0  # high ends up on the node
+    # low was evicted and can never fit again → unschedulable.
+    assert res.assignments[0] == PAD
+
+
+def test_node_down_evicts_and_requeues():
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 4}), Node("n1", {"cpu": 4})])
+    pods = [Pod("a", requests={"cpu": 2}, arrival_time=0.0)]
+    ec, ep = encode(cluster, pods)
+    eng = CpuReplayEngine(ec, ep, FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}]))
+    first = eng.replay().assignments[0]
+    ev = [NodeEvent(time=5.0, kind="node_down", node=int(first))]
+    eng2 = CpuReplayEngine(ec, ep, FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}]))
+    res = eng2.replay(node_events=ev)
+    # Pod must end up on the surviving node.
+    assert res.assignments[0] == 1 - int(first)
+
+
+def test_priority_order_in_queue():
+    # Two pods arrive simultaneously; capacity 1 → high priority wins.
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod("low", requests={"cpu": 1}, priority=0, arrival_time=0.0),
+        Pod("high", requests={"cpu": 1}, priority=100, arrival_time=0.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    eng = CpuReplayEngine(
+        ec, ep, FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}], enable_preemption=False)
+    )
+    res = eng.replay()
+    assert res.assignments[1] == 0
+    assert res.assignments[0] == PAD
