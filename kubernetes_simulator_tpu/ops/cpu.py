@@ -80,20 +80,39 @@ def fit_mask(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) -> n
     return np.all(st.used + req[None, :] <= ec.allocatable + 1e-6, axis=1)
 
 
+# Scores are INTEGER-valued f32 ([K8S] computes int64 node scores; we floor
+# through single-op chains — sub/div/mul/floor, nothing XLA can FMA-fuse —
+# so the CPU and device paths are bit-identical and argmax ties break the
+# same way; SURVEY.md §7 hard part #6).
+
+
+def _int_resource_score(frac: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    """floor(frac_r·100) per resource, exact weighted mean, floored."""
+    s = np.floor(frac * np.float32(MAX_NODE_SCORE))  # [N, R], integral
+    acc = np.zeros(frac.shape[0], dtype=np.float32)
+    wsum = 0.0
+    for r in range(frac.shape[1]):
+        w = float(weights[r])
+        if w != 0:
+            acc = acc + s[:, r] * np.float32(w)  # exact: small ints
+            wsum += w
+    if wsum == 0:
+        return acc
+    return np.floor(acc / np.float32(wsum))
+
+
 def least_allocated_score(
     ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int, weights: np.ndarray
 ) -> np.ndarray:
-    """``Σ_r w_r · (alloc_r − used_r − req_r)/alloc_r · 100 / Σw``; rows with
-    alloc==0 contribute 0 ([K8S] leastAllocatedScorer)."""
+    """``floor(Σ_r w_r·floor(100·(alloc_r−used_r−req_r)/alloc_r) / Σw)``;
+    rows with alloc==0 contribute 0 ([K8S] leastAllocatedScorer, integer
+    node scores)."""
     req = pods.requests[p][None, :]
     alloc = ec.allocatable
     with np.errstate(divide="ignore", invalid="ignore"):
         frac = np.where(alloc > 0, (alloc - st.used - req) / np.where(alloc > 0, alloc, 1.0), 0.0)
     frac = np.clip(frac, 0.0, 1.0)
-    wsum = weights.sum()
-    if wsum == 0:
-        return np.zeros(ec.num_nodes, dtype=np.float32)
-    return (frac * weights[None, :]).sum(axis=1).astype(np.float32) * MAX_NODE_SCORE / wsum
+    return _int_resource_score(frac, weights)
 
 
 def most_allocated_score(
@@ -104,10 +123,20 @@ def most_allocated_score(
     with np.errstate(divide="ignore", invalid="ignore"):
         frac = np.where(alloc > 0, (st.used + req) / np.where(alloc > 0, alloc, 1.0), 0.0)
     frac = np.clip(frac, 0.0, 1.0)
-    wsum = weights.sum()
-    if wsum == 0:
-        return np.zeros(ec.num_nodes, dtype=np.float32)
-    return (frac * weights[None, :]).sum(axis=1).astype(np.float32) * MAX_NODE_SCORE / wsum
+    return _int_resource_score(frac, weights)
+
+
+def piecewise_interp_int(util: np.ndarray, xs, ys) -> np.ndarray:
+    """Integer-valued piecewise-linear eval: seg = y0 + floor(t·Δy). Shared
+    formula with ops.tpu (single-op chains; no np.interp)."""
+    out = np.full_like(util, np.float32(ys[-1]), dtype=np.float32)
+    for i in range(len(xs) - 2, -1, -1):
+        x0, x1 = np.float32(xs[i]), np.float32(xs[i + 1])
+        y0, y1 = np.float32(ys[i]), np.float32(ys[i + 1])
+        t = (util.astype(np.float32) - x0) * (np.float32(1.0) / (x1 - x0))
+        seg = y0 + np.floor(t * (y1 - y0))
+        out = np.where(util <= x1, seg, out)
+    return np.where(util <= np.float32(xs[0]), np.float32(ys[0]), out).astype(np.float32)
 
 
 def requested_to_capacity_ratio_score(
@@ -125,13 +154,19 @@ def requested_to_capacity_ratio_score(
     req = pods.requests[p][None, :]
     alloc = ec.allocatable
     with np.errstate(divide="ignore", invalid="ignore"):
-        util = np.where(alloc > 0, (st.used + req) / np.where(alloc > 0, alloc, 1.0), 0.0)
-    util = np.clip(util, 0.0, 1.0) * 100.0
-    score_r = np.interp(util, shape_x, shape_y)  # [N, R]
-    wsum = weights.sum()
+        frac = np.where(alloc > 0, (st.used + req) / np.where(alloc > 0, alloc, 1.0), 0.0)
+    util = np.floor(np.clip(frac, 0.0, 1.0) * np.float32(100.0))
+    score_r = piecewise_interp_int(util, list(shape_x), list(shape_y))  # [N, R]
+    acc = np.zeros(ec.num_nodes, dtype=np.float32)
+    wsum = 0.0
+    for r in range(score_r.shape[1]):
+        w = float(weights[r])
+        if w != 0:
+            acc = acc + score_r[:, r] * np.float32(w)
+            wsum += w
     if wsum == 0:
-        return np.zeros(ec.num_nodes, dtype=np.float32)
-    return (score_r * weights[None, :]).sum(axis=1).astype(np.float32) / wsum
+        return acc
+    return np.floor(acc / np.float32(wsum))
 
 
 # ---------------------------------------------------------------------------
@@ -297,27 +332,30 @@ def spread_score(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) 
 # ---------------------------------------------------------------------------
 
 def normalize_max(raw: np.ndarray, feasible: np.ndarray, reverse: bool = False) -> np.ndarray:
-    """Scale to [0, 100] by the max over feasible nodes; reverse flips."""
+    """``floor(raw·100/max)`` over feasible nodes ([K8S] defaultNormalizeScore,
+    integer scores); reverse flips. Raw inputs are small non-negative
+    integers (counts / summed int weights), so the arithmetic is exact."""
     vals = np.where(feasible, raw, 0.0)
     mx = vals.max() if feasible.any() else 0.0
     if mx <= 0:
         out = np.zeros_like(raw, dtype=np.float32)
         return np.full_like(out, MAX_NODE_SCORE) if reverse else out
-    out = raw.astype(np.float32) * (MAX_NODE_SCORE / mx)
-    return MAX_NODE_SCORE - out if reverse else out
+    out = np.floor((raw.astype(np.float32) * np.float32(MAX_NODE_SCORE)) / np.float32(mx))
+    return np.float32(MAX_NODE_SCORE) - out if reverse else out
 
 
 def normalize_min_max(raw: np.ndarray, feasible: np.ndarray, reverse: bool = False) -> np.ndarray:
-    """Min-max scale over feasible nodes to [0, 100] (handles negatives —
-    [K8S] interpodaffinity normalization). Constant raw → all zeros."""
+    """``floor((raw−lo)·(100/span))`` over feasible nodes (handles negatives —
+    [K8S] interpodaffinity normalization). Constant raw → all zeros. The
+    single multiply keeps both backends bit-identical."""
     if not feasible.any():
         return np.zeros_like(raw, dtype=np.float32)
     vals = raw[feasible]
-    lo, hi = vals.min(), vals.max()
+    lo, hi = np.float32(vals.min()), np.float32(vals.max())
     if hi == lo:
         return np.zeros_like(raw, dtype=np.float32)
-    out = (raw - lo).astype(np.float32) * (MAX_NODE_SCORE / (hi - lo))
-    return MAX_NODE_SCORE - out if reverse else out
+    out = np.floor((raw.astype(np.float32) - lo) * (np.float32(MAX_NODE_SCORE) / (hi - lo)))
+    return np.float32(MAX_NODE_SCORE) - out if reverse else out
 
 
 def select_node(scores: np.ndarray, feasible: np.ndarray) -> int:

@@ -1,0 +1,469 @@
+"""Scheduling kernels — JAX device edition (SURVEY.md §3.5).
+
+Same math as :mod:`.cpu`, re-expressed for XLA: everything is static-shape
+jnp over ``[N]``/``[G, D]`` tensors, composable under ``jit``/``vmap``/
+``lax.scan``. One pending pod (a "slot" row pytree) is evaluated against
+all nodes at once; the mutable scheduling state is a small pytree updated
+by scatter-adds so the whole replay runs as one compiled scan on device.
+
+Design notes (TPU-first):
+- masks stay bool, scores f32; the [N]-wide ops map onto VPU lanes and the
+  [N, R] contractions onto the MXU-friendly layouts XLA picks.
+- no data-dependent shapes: padded slots are neutralized with `where`, a
+  `valid` flag multiplies every state update.
+- per-pod term loops (tolerations, affinity terms, spread constraints) are
+  python-unrolled over SMALL static widths — they trace once and fuse.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.encode import PAD, TOL_PAD, TOL_WILDCARD, EncodedCluster, EncodedPods
+from ..models.core import Effect, Operator
+
+MAX_NODE_SCORE = 100.0
+NEG_INF = -jnp.inf
+
+
+class DevCluster(NamedTuple):
+    """Static per-scenario node-side tensors (device copies of
+    EncodedCluster). Leading axes may gain a scenario dimension under vmap."""
+
+    allocatable: jax.Array  # [N, R] f32
+    node_label_key: jax.Array  # [N, L] i32
+    node_label_kv: jax.Array  # [N, L] i32
+    node_label_num: jax.Array  # [N, L] f32
+    taint_key: jax.Array  # [N, TT] i32
+    taint_kv: jax.Array  # [N, TT] i32
+    taint_effect: jax.Array  # [N, TT] i32
+    node_domain: jax.Array  # [T, N] i32
+    num_domains: jax.Array  # [T] i32
+    expr_key: jax.Array  # [E] i32
+    expr_op: jax.Array  # [E] i32
+    expr_vals: jax.Array  # [E, V] i32
+    expr_num: jax.Array  # [E] f32
+    group_topo: jax.Array  # [G] i32
+
+    @classmethod
+    def from_encoded(cls, ec: EncodedCluster) -> "DevCluster":
+        return cls(
+            allocatable=jnp.asarray(ec.allocatable),
+            node_label_key=jnp.asarray(ec.node_label_key),
+            node_label_kv=jnp.asarray(ec.node_label_kv),
+            node_label_num=jnp.asarray(ec.node_label_num),
+            taint_key=jnp.asarray(ec.taint_key),
+            taint_kv=jnp.asarray(ec.taint_kv),
+            taint_effect=jnp.asarray(ec.taint_effect),
+            node_domain=jnp.asarray(ec.node_domain),
+            num_domains=jnp.asarray(ec.num_domains),
+            expr_key=jnp.asarray(ec.expr_key),
+            expr_op=jnp.asarray(ec.expr_op),
+            expr_vals=jnp.asarray(ec.expr_vals),
+            expr_num=jnp.asarray(ec.expr_num),
+            group_topo=jnp.asarray(ec.group_topo),
+        )
+
+
+class DevState(NamedTuple):
+    """Mutable scheduling state carried through lax.scan (device twin of
+    models.state.SchedState)."""
+
+    used: jax.Array  # [N, R] f32
+    match_count: jax.Array  # [G, D] f32
+    anti_active: jax.Array  # [G, D] f32
+    pref_wsum: jax.Array  # [G, D] f32
+
+    @classmethod
+    def init(cls, ec: EncodedCluster) -> "DevState":
+        G = max(ec.num_groups, 1)
+        D = max(ec.max_domains, 1)
+        return cls(
+            used=jnp.zeros((ec.num_nodes, ec.num_resources), jnp.float32),
+            match_count=jnp.zeros((G, D), jnp.float32),
+            anti_active=jnp.zeros((G, D), jnp.float32),
+            pref_wsum=jnp.zeros((G, D), jnp.float32),
+        )
+
+
+class PodSlot(NamedTuple):
+    """One pending pod's row pytree (scan element)."""
+
+    pod_id: jax.Array  # i32 scalar (PAD = padding slot)
+    valid: jax.Array  # bool scalar
+    req: jax.Array  # [R] f32
+    tol_key: jax.Array  # [TO] i32
+    tol_kv: jax.Array  # [TO] i32
+    tol_effect: jax.Array  # [TO] i32
+    na_req: jax.Array  # [TR, TE] i32
+    na_has_req: jax.Array  # bool
+    na_pref: jax.Array  # [TP, TE] i32
+    na_pref_w: jax.Array  # [TP] f32
+    aff_req: jax.Array  # [AR] i32
+    anti_req: jax.Array  # [AA] i32
+    pref_aff: jax.Array  # [PA] i32
+    pref_aff_w: jax.Array  # [PA] f32
+    spread_g: jax.Array  # [SP] i32
+    spread_skew: jax.Array  # [SP] i32
+    spread_dns: jax.Array  # [SP] bool
+    pmg: jax.Array  # [G] bool
+    group: jax.Array  # i32 scalar (wave-local gang handling)
+
+
+def gather_slots(ep: EncodedPods, idx: np.ndarray) -> PodSlot:
+    """Host-side gather of pod rows at ``idx`` (any leading shape); PAD ids
+    become invalid slots."""
+    safe = np.clip(idx, 0, None)
+    take = lambda a: jnp.asarray(a[safe])
+    return PodSlot(
+        pod_id=jnp.asarray(idx.astype(np.int32)),
+        valid=jnp.asarray(idx >= 0),
+        req=take(ep.requests),
+        tol_key=take(ep.tol_key),
+        tol_kv=take(ep.tol_kv),
+        tol_effect=take(ep.tol_effect),
+        na_req=take(ep.na_req),
+        na_has_req=take(ep.na_has_req),
+        na_pref=take(ep.na_pref),
+        na_pref_w=take(ep.na_pref_w),
+        aff_req=take(ep.aff_req),
+        anti_req=take(ep.anti_req),
+        pref_aff=take(ep.pref_aff),
+        pref_aff_w=take(ep.pref_aff_w),
+        spread_g=take(ep.spread_g),
+        spread_skew=take(ep.spread_skew),
+        spread_dns=take(ep.spread_dns),
+        pmg=take(ep.pod_matches_group),
+        group=jnp.asarray(np.where(idx >= 0, ep.group_id[safe], PAD).astype(np.int32)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-replay derived tensors (computed INSIDE jit so scenario perturbations
+# to labels/taints/capacity flow through without host re-encode)
+# ---------------------------------------------------------------------------
+
+def expr_match_matrix(dc: DevCluster) -> jax.Array:
+    """[N, E] bool — jnp twin of ops.cpu.expr_match_matrix."""
+    nk = dc.node_label_key[:, :, None]  # [N, L, 1]
+    nv = dc.node_label_kv[:, :, None]
+    ek = dc.expr_key[None, None, :]
+    key_present = jnp.any((nk == ek) & (nk != PAD), axis=1)  # [N, E]
+    in_set = jnp.any(
+        (nv[:, :, :, None] == dc.expr_vals[None, None, :, :]) & (nv[:, :, :, None] != PAD),
+        axis=(1, 3),
+    )
+    num = dc.node_label_num[:, :, None]
+    gt = jnp.any((nk == ek) & (num > dc.expr_num[None, None, :]), axis=1)
+    lt = jnp.any((nk == ek) & (num < dc.expr_num[None, None, :]), axis=1)
+    op = dc.expr_op[None, :]
+    return (
+        ((op == Operator.IN) & key_present & in_set)
+        | ((op == Operator.NOT_IN) & ~(key_present & in_set))
+        | ((op == Operator.EXISTS) & key_present)
+        | ((op == Operator.DOES_NOT_EXIST) & ~key_present)
+        | ((op == Operator.GT) & gt)
+        | ((op == Operator.LT) & lt)
+    )
+
+
+def group_dom_per_node(dc: DevCluster) -> jax.Array:
+    """[G, N] — domain of each node under each count-group's topology key."""
+    gt = jnp.clip(dc.group_topo, 0, None)
+    dom = dc.node_domain[gt]  # [G, N]
+    return jnp.where(dc.group_topo[:, None] >= 0, dom, PAD)
+
+
+def domain_valid_mask(dc: DevCluster, D: int) -> jax.Array:
+    """[G, D] — which domain slots exist for each group's topology key."""
+    gt = jnp.clip(dc.group_topo, 0, None)
+    nd = dc.num_domains[gt]  # [G]
+    return (jnp.arange(D)[None, :] < nd[:, None]) & (dc.group_topo[:, None] >= 0)
+
+
+class Derived(NamedTuple):
+    M: jax.Array  # [N, E] expr match
+    gdom: jax.Array  # [G, N]
+    dom_valid: jax.Array  # [G, D]
+
+    @classmethod
+    def build(cls, dc: DevCluster, D: int) -> "Derived":
+        return cls(expr_match_matrix(dc), group_dom_per_node(dc), domain_valid_mask(dc, D))
+
+
+# ---------------------------------------------------------------------------
+# Filters
+# ---------------------------------------------------------------------------
+
+def fit_mask(dc: DevCluster, st: DevState, s: PodSlot) -> jax.Array:
+    return jnp.all(st.used + s.req[None, :] <= dc.allocatable + 1e-6, axis=1)
+
+
+def taint_untolerated(dc: DevCluster, s: PodSlot, effects) -> jax.Array:
+    t_eff = dc.taint_effect  # [N, TT]
+    active = (dc.taint_key != PAD)
+    eff_match = jnp.zeros_like(active)
+    for e in effects:
+        eff_match = eff_match | (t_eff == e)
+    active = active & eff_match
+    tk = s.tol_key  # [TO]
+    valid_tol = tk != TOL_PAD
+    key_ok = (tk[None, None, :] == TOL_WILDCARD) | (tk[None, None, :] == dc.taint_key[:, :, None])
+    val_ok = (s.tol_kv[None, None, :] == PAD) | (s.tol_kv[None, None, :] == dc.taint_kv[:, :, None])
+    eff_ok = (s.tol_effect[None, None, :] == 0) | (s.tol_effect[None, None, :] == t_eff[:, :, None])
+    tolerated = jnp.any(key_ok & val_ok & eff_ok & valid_tol[None, None, :], axis=2)
+    return active & ~tolerated
+
+
+def taint_mask(dc: DevCluster, s: PodSlot) -> jax.Array:
+    bad = taint_untolerated(dc, s, (int(Effect.NO_SCHEDULE), int(Effect.NO_EXECUTE)))
+    return ~jnp.any(bad, axis=1)
+
+
+def taint_prefer_count(dc: DevCluster, s: PodSlot) -> jax.Array:
+    bad = taint_untolerated(dc, s, (int(Effect.PREFER_NO_SCHEDULE),))
+    return jnp.sum(bad, axis=1).astype(jnp.float32)
+
+
+def _terms_match(M: jax.Array, terms: jax.Array) -> jax.Array:
+    """[N] — OR over terms of AND over exprs (PAD exprs auto-true; a term is
+    valid iff slot 0 is a real expr)."""
+    valid_term = terms[:, 0] >= 0  # [T]
+    safe = jnp.clip(terms, 0, None)
+    per_expr = M[:, safe] | (terms[None, :, :] < 0)  # [N, T, E]
+    per_term = jnp.all(per_expr, axis=2) & valid_term[None, :]
+    return jnp.any(per_term, axis=1)
+
+
+def node_affinity_mask(d: Derived, s: PodSlot) -> jax.Array:
+    return jnp.where(s.na_has_req, _terms_match(d.M, s.na_req), True)
+
+
+def node_affinity_score(d: Derived, s: PodSlot) -> jax.Array:
+    terms = s.na_pref  # [TP, TE]
+    valid_term = terms[:, 0] >= 0
+    safe = jnp.clip(terms, 0, None)
+    per_expr = d.M[:, safe] | (terms[None, :, :] < 0)
+    per_term = jnp.all(per_expr, axis=2) & valid_term[None, :]
+    return jnp.sum(per_term * s.na_pref_w[None, :], axis=1).astype(jnp.float32)
+
+
+def _counts_at_nodes(counts: jax.Array, gdom: jax.Array) -> jax.Array:
+    """[G, N] gather of counts[g, dom(g, n)]; 0 where node lacks the key."""
+    safe = jnp.clip(gdom, 0, None)
+    vals = jnp.take_along_axis(counts, safe, axis=1)
+    return jnp.where(gdom >= 0, vals, 0.0)
+
+
+def interpod_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
+    cnt = _counts_at_nodes(st.match_count, d.gdom)  # [G, N]
+    total = jnp.sum(st.match_count, axis=1)  # [G]
+    N = d.gdom.shape[1]
+    ok = jnp.ones(N, dtype=bool)
+    AR = s.aff_req.shape[0]
+    for a in range(AR):  # small static unroll
+        g = s.aff_req[a]
+        gs = jnp.clip(g, 0, None)
+        boot = (total[gs] == 0) & s.pmg[gs]
+        term_ok = (cnt[gs] >= 1) & (d.gdom[gs] >= 0)
+        ok = ok & jnp.where(g >= 0, term_ok | boot, True)
+    for a in range(s.anti_req.shape[0]):
+        g = s.anti_req[a]
+        gs = jnp.clip(g, 0, None)
+        viol = (cnt[gs] >= 1) & (d.gdom[gs] >= 0)
+        ok = ok & jnp.where(g >= 0, ~viol, True)
+    anti_here = _counts_at_nodes(st.anti_active, d.gdom)  # [G, N]
+    blocked = jnp.any((anti_here > 0) & s.pmg[:, None], axis=0)
+    return ok & ~blocked
+
+
+def interpod_score(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
+    cnt = _counts_at_nodes(st.match_count, d.gdom)  # [G, N]
+    N = d.gdom.shape[1]
+    raw = jnp.zeros(N, dtype=jnp.float32)
+    for a in range(s.pref_aff.shape[0]):
+        g = s.pref_aff[a]
+        gs = jnp.clip(g, 0, None)
+        raw = raw + jnp.where(g >= 0, s.pref_aff_w[a] * cnt[gs], 0.0)
+    wsum = _counts_at_nodes(st.pref_wsum, d.gdom)
+    raw = raw + jnp.sum(wsum * s.pmg[:, None], axis=0)
+    return raw
+
+
+def spread_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
+    cnt = _counts_at_nodes(st.match_count, d.gdom)  # [G, N]
+    masked = jnp.where(d.dom_valid, st.match_count, jnp.inf)
+    min_cnt = jnp.min(masked, axis=1)  # [G] (inf when group has no domains)
+    N = d.gdom.shape[1]
+    ok = jnp.ones(N, dtype=bool)
+    for a in range(s.spread_g.shape[0]):
+        g = s.spread_g[a]
+        gs = jnp.clip(g, 0, None)
+        self_match = s.pmg[gs].astype(jnp.float32)
+        new = cnt[gs] + self_match
+        has_domains = jnp.isfinite(min_cnt[gs])
+        c_ok = (
+            (d.gdom[gs] >= 0)
+            & has_domains
+            & (new - jnp.where(has_domains, min_cnt[gs], 0.0) <= s.spread_skew[a])
+        )
+        ok = ok & jnp.where((g >= 0) & s.spread_dns[a], c_ok, True)
+    return ok
+
+
+def spread_score(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
+    cnt = _counts_at_nodes(st.match_count, d.gdom)
+    N = d.gdom.shape[1]
+    raw = jnp.zeros(N, dtype=jnp.float32)
+    for a in range(s.spread_g.shape[0]):
+        g = s.spread_g[a]
+        gs = jnp.clip(g, 0, None)
+        raw = raw + jnp.where(g >= 0, cnt[gs] + s.pmg[gs].astype(jnp.float32), 0.0)
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# Resource scores
+# ---------------------------------------------------------------------------
+
+# Scores are INTEGER-valued f32, floored through single-op chains — nothing
+# XLA can FMA-fuse — so device scores are bit-identical to ops.cpu and
+# argmax ties break the same way (SURVEY.md §7 hard part #6). Mirrors
+# upstream's int64 node scores.
+
+
+def _int_resource_score(frac: jax.Array, weights) -> jax.Array:
+    s = jnp.floor(frac * np.float32(MAX_NODE_SCORE))  # [N, R], integral
+    acc = jnp.zeros(frac.shape[0], dtype=jnp.float32)
+    wsum = 0.0
+    for r in range(frac.shape[1]):
+        w = float(weights[r])
+        if w != 0:
+            acc = acc + s[:, r] * np.float32(w)  # exact: small ints
+            wsum += w
+    if wsum == 0:
+        return acc
+    return jnp.floor(acc / np.float32(wsum))
+
+
+def least_allocated_score(dc: DevCluster, st: DevState, s: PodSlot, weights) -> jax.Array:
+    alloc = dc.allocatable
+    denom = jnp.where(alloc > 0, alloc, 1.0)
+    frac = jnp.where(alloc > 0, (alloc - st.used - s.req[None, :]) / denom, 0.0)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    return _int_resource_score(frac, weights)
+
+
+def most_allocated_score(dc: DevCluster, st: DevState, s: PodSlot, weights) -> jax.Array:
+    alloc = dc.allocatable
+    denom = jnp.where(alloc > 0, alloc, 1.0)
+    frac = jnp.where(alloc > 0, (st.used + s.req[None, :]) / denom, 0.0)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    return _int_resource_score(frac, weights)
+
+
+def piecewise_interp_int(util: jax.Array, xs, ys) -> jax.Array:
+    """Mirror of ops.cpu.piecewise_interp_int (seg = y0 + floor(t·Δy))."""
+    out = jnp.full(util.shape, np.float32(ys[-1]), dtype=jnp.float32)
+    for i in range(len(xs) - 2, -1, -1):
+        x0, x1 = np.float32(xs[i]), np.float32(xs[i + 1])
+        y0, y1 = np.float32(ys[i]), np.float32(ys[i + 1])
+        t = (util.astype(jnp.float32) - x0) * (np.float32(1.0) / (x1 - x0))
+        seg = y0 + jnp.floor(t * (y1 - y0))
+        out = jnp.where(util <= x1, seg, out)
+    return jnp.where(util <= np.float32(xs[0]), np.float32(ys[0]), out).astype(jnp.float32)
+
+
+def requested_to_capacity_ratio_score(
+    dc: DevCluster, st: DevState, s: PodSlot, weights, shape_x, shape_y
+) -> jax.Array:
+    alloc = dc.allocatable
+    denom = jnp.where(alloc > 0, alloc, 1.0)
+    frac = jnp.where(alloc > 0, (st.used + s.req[None, :]) / denom, 0.0)
+    util = jnp.floor(jnp.clip(frac, 0.0, 1.0) * np.float32(100.0))
+    score_r = piecewise_interp_int(util, list(shape_x), list(shape_y))
+    acc = jnp.zeros(alloc.shape[0], dtype=jnp.float32)
+    wsum = 0.0
+    for r in range(score_r.shape[1]):
+        w = float(weights[r])
+        if w != 0:
+            acc = acc + score_r[:, r] * np.float32(w)
+            wsum += w
+    if wsum == 0:
+        return acc
+    return jnp.floor(acc / np.float32(wsum))
+
+
+# ---------------------------------------------------------------------------
+# Normalization + selection + state update
+# ---------------------------------------------------------------------------
+
+def normalize_max(raw: jax.Array, feasible: jax.Array, reverse: bool = False) -> jax.Array:
+    """Mirror of ops.cpu.normalize_max: floor(raw·100/max), integer scores."""
+    vals = jnp.where(feasible, raw, 0.0)
+    mx = jnp.max(vals)
+    pos = mx > 0
+    out = jnp.floor((raw * np.float32(MAX_NODE_SCORE)) / jnp.where(pos, mx, 1.0))
+    out = jnp.where(pos, out, 0.0)
+    if reverse:
+        out = jnp.where(pos, np.float32(MAX_NODE_SCORE) - out, np.float32(MAX_NODE_SCORE))
+    return out.astype(jnp.float32)
+
+
+def normalize_min_max(raw: jax.Array, feasible: jax.Array, reverse: bool = False) -> jax.Array:
+    """Mirror of ops.cpu.normalize_min_max: floor((raw−lo)·(100/span))."""
+    any_f = jnp.any(feasible)
+    lo = jnp.min(jnp.where(feasible, raw, jnp.inf)).astype(jnp.float32)
+    hi = jnp.max(jnp.where(feasible, raw, -jnp.inf)).astype(jnp.float32)
+    span = hi - lo
+    ok = any_f & (span > 0)
+    out = jnp.floor(
+        (raw - jnp.where(ok, lo, 0.0)) * (np.float32(MAX_NODE_SCORE) / jnp.where(ok, span, 1.0))
+    )
+    out = jnp.where(ok, out, 0.0)
+    if reverse:
+        out = jnp.where(ok, np.float32(MAX_NODE_SCORE) - out, 0.0)
+    return out.astype(jnp.float32)
+
+
+def select_node(scores: jax.Array, feasible: jax.Array):
+    """(choice i32, placed bool) — lowest-index argmax tie-break, matching
+    numpy argmax (SURVEY.md §7 hard part #6)."""
+    masked = jnp.where(feasible, scores, NEG_INF)
+    choice = jnp.argmax(masked).astype(jnp.int32)
+    placed = jnp.any(feasible)
+    return jnp.where(placed, choice, PAD), placed
+
+
+def apply_binding(
+    dc: DevCluster, d: Derived, st: DevState, s: PodSlot, node: jax.Array, on: jax.Array, sign: float = 1.0
+) -> DevState:
+    """Masked bind (sign=+1) / unbind (sign=-1). ``on`` is a bool scalar;
+    when False the update is a no-op — keeps the scan branch-free."""
+    w = jnp.where(on & s.valid, sign, 0.0).astype(jnp.float32)
+    ns = jnp.clip(node, 0, None)
+    used = st.used.at[ns].add(w * s.req)
+    G = st.match_count.shape[0]
+    dom_g = d.gdom[:, ns]  # [G]
+    dval = dom_g >= 0
+    doms = jnp.clip(dom_g, 0, None)
+    match_count = st.match_count.at[jnp.arange(G), doms].add(
+        w * (s.pmg & dval).astype(jnp.float32)
+    )
+    anti = st.anti_active
+    for a in range(s.anti_req.shape[0]):
+        g = s.anti_req[a]
+        gs = jnp.clip(g, 0, None)
+        ok = (g >= 0) & dval[gs]
+        anti = anti.at[gs, doms[gs]].add(w * ok.astype(jnp.float32))
+    pref = st.pref_wsum
+    for a in range(s.pref_aff.shape[0]):
+        g = s.pref_aff[a]
+        gs = jnp.clip(g, 0, None)
+        ok = (g >= 0) & dval[gs]
+        pref = pref.at[gs, doms[gs]].add(w * s.pref_aff_w[a] * ok.astype(jnp.float32))
+    return DevState(used=used, match_count=match_count, anti_active=anti, pref_wsum=pref)

@@ -158,16 +158,20 @@ def spread_ok(st: OracleState, pod: Pod, node: Node) -> bool:
 # -- scores -----------------------------------------------------------------
 
 def least_allocated(st: OracleState, pod: Pod, node: Node, weights: Dict[str, float]) -> float:
+    """Integer node scores, as upstream ([K8S] computes with int64 division):
+    floor(Σ w_r·floor(100·frac_r) / Σw). Zero-alloc resources score 0."""
+    import math
+
     used = st.used(node)
     total, wsum = 0.0, 0.0
     for r, w in weights.items():
-        alloc = node.allocatable.get(r, 0.0)
         wsum += w
+        alloc = node.allocatable.get(r, 0.0)
         if alloc <= 0:
             continue
         frac = (alloc - used.get(r, 0.0) - pod.requests.get(r, 0.0)) / alloc
-        total += w * min(max(frac, 0.0), 1.0)
-    return total * MAX_NODE_SCORE / wsum if wsum else 0.0
+        total += w * math.floor(min(max(frac, 0.0), 1.0) * MAX_NODE_SCORE)
+    return math.floor(total / wsum) if wsum else 0.0
 
 
 def node_affinity_score(pod: Pod, node: Node) -> float:

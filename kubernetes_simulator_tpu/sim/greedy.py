@@ -1,0 +1,87 @@
+"""Greedy wave replay, numpy host edition.
+
+Implements EXACTLY the algorithm the JAX engine compiles — arrival-order
+waves, sequential slots with speculative binds, wave-boundary gang
+commit/rollback, no queue/backoff/preemption — but on the host, reusing the
+tested CPU plugin path. This is the parity anchor for the device scan
+(SURVEY.md §4.2): for any workload, `greedy_replay` and the `jax` strategy
+must produce identical placements.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.framework import FrameworkConfig, SchedulerFramework
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from ..models.state import bind, init_state, unbind
+from .runtime import ReplayResult
+from .waves import WaveBatch, pack_waves
+
+
+def greedy_replay(
+    ec: EncodedCluster,
+    ep: EncodedPods,
+    config: Optional[FrameworkConfig] = None,
+    waves: Optional[WaveBatch] = None,
+    wave_width: int = 8,
+) -> ReplayResult:
+    config = config or FrameworkConfig()
+    config.enable_preemption = False  # greedy semantics: no PostFilter
+    fw = SchedulerFramework(ec, ep, config)
+    if waves is None:
+        waves = pack_waves(ep, wave_width)
+    st = init_state(ec, ep)
+    assignments = np.full(ep.num_pods, PAD, dtype=np.int32)
+    placed_total = 0
+    t0 = time.perf_counter()
+    for wave in waves.idx:
+        slot_choice: List[int] = []
+        slot_pods: List[int] = []
+        for p in wave:
+            if p < 0:
+                continue
+            p = int(p)
+            res = fw.schedule_one(st, p)
+            if res.node != PAD:
+                bind(ec, ep, st, p, res.node)
+            slot_pods.append(p)
+            slot_choice.append(res.node)
+        # Gang commit: a group fails if ANY member slot went unplaced.
+        failed_groups = {
+            int(ep.group_id[p])
+            for p, c in zip(slot_pods, slot_choice)
+            if c == PAD and ep.group_id[p] != PAD
+        }
+        for p, c in zip(slot_pods, slot_choice):
+            g = int(ep.group_id[p])
+            if c != PAD and g in failed_groups:
+                unbind(ec, ep, st, p)
+            elif c != PAD:
+                assignments[p] = c
+                placed_total += 1
+    wall = time.perf_counter() - t0
+    to_schedule = int((ep.bound_node == PAD).sum())
+    util = {}
+    for rname in ("cpu", "memory"):
+        ri = ec.vocab._r.get(rname)
+        if ri is not None:
+            alloc = ec.allocatable[:, ri]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                u = np.where(alloc > 0, st.used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
+            util[rname] = float(u.mean())
+    return ReplayResult(
+        assignments=assignments,
+        placed=placed_total,
+        unschedulable=to_schedule - placed_total,
+        preemptions=0,
+        attempts=to_schedule,
+        wall_clock_s=wall,
+        placements_per_sec=placed_total / wall if wall > 0 else 0.0,
+        virtual_makespan=float(ep.arrival.max()) if ep.num_pods else 0.0,
+        utilization=util,
+        state=st,
+    )

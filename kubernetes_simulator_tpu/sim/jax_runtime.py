@@ -1,0 +1,279 @@
+"""The `jax` scheduling strategy — the whole replay as one compiled TPU
+program (SURVEY.md §3.1 "device boundary", §3.5).
+
+The host feeds chunks of wave-packed pods; a jitted ``lax.scan`` walks the
+waves, evaluating every enabled plugin's Filter mask and Score over all
+nodes at once, selecting with a deterministic argmax, and updating the
+carried state with scatter-adds. Gang commit/rollback is a masked update at
+each wave boundary. Selected through the strategy registry ([BASELINE]: the
+CPU plugin path stays the default; `jax` is opt-in).
+
+Semantics = :mod:`.greedy` exactly (the parity anchor): arrival-order
+greedy, no queue/backoff/preemption. The event-driven features (completions,
+failure injection, preemption) live in the CPU engine; batched what-if over
+scenarios builds on this module via ``vmap``/``shard_map``
+(:mod:`.whatif`, :mod:`..parallel`).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.framework import FrameworkConfig
+from ..framework.registry import register_strategy
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from ..models.state import SchedState, init_state
+from ..ops import tpu as T
+from ..plugins.builtin import DEFAULT_WEIGHTS
+from .runtime import ReplayResult
+from .waves import WaveBatch, pack_waves
+
+DEFAULT_PLUGINS = (
+    "NodeResourcesFit",
+    "TaintToleration",
+    "NodeAffinity",
+    "InterPodAffinity",
+    "PodTopologySpread",
+)
+
+
+@dataclass(frozen=True)
+class StepSpec:
+    """Static (trace-time) description of the fused Filter+Score step."""
+
+    fit: bool = True
+    taints: bool = True
+    node_affinity: bool = True
+    interpod: bool = True
+    spread: bool = True
+    fit_strategy: str = "LeastAllocated"
+    weights: Tuple[Tuple[str, float], ...] = ()
+    resource_weights: Tuple[float, ...] = ()  # [R]
+    shape_x: Tuple[float, ...] = (0.0, 100.0)
+    shape_y: Tuple[float, ...] = (0.0, 100.0)
+
+    @classmethod
+    def from_config(cls, ec: EncodedCluster, config: Optional[FrameworkConfig]) -> "StepSpec":
+        entries = (config.plugins if config and config.plugins is not None else None)
+        if entries is None:
+            entries = [{"name": n} for n in DEFAULT_PLUGINS]
+        names = {e["name"] for e in entries}
+        weights = dict(DEFAULT_WEIGHTS)
+        if config and config.weights:
+            weights.update(config.weights)
+        fit_strategy = "LeastAllocated"
+        res = {"cpu": 1.0, "memory": 1.0}
+        shape = [{"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]
+        for e in entries:
+            if e["name"] == "NodeResourcesFit":
+                args = e.get("args", {})
+                fit_strategy = args.get("strategy", fit_strategy)
+                res = args.get("resources", res)
+                shape = args.get("shape", shape)
+        rw = np.zeros(ec.num_resources, dtype=np.float32)
+        for rname, w in res.items():
+            ri = ec.vocab._r.get(rname)
+            if ri is not None:
+                rw[ri] = w
+        return cls(
+            fit="NodeResourcesFit" in names,
+            taints="TaintToleration" in names,
+            node_affinity="NodeAffinity" in names,
+            interpod="InterPodAffinity" in names,
+            spread="PodTopologySpread" in names,
+            fit_strategy=fit_strategy,
+            weights=tuple(sorted(weights.items())),
+            resource_weights=tuple(float(x) for x in rw),
+            shape_x=tuple(float(pt["utilization"]) for pt in shape),
+            shape_y=tuple(float(pt["score"]) * 10.0 for pt in shape),
+        )
+
+
+def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec: StepSpec):
+    """Fused Filter + Score for one slot against all nodes → (feasible [N],
+    scores [N]). Mirrors SchedulerFramework.feasible_mask/score_nodes."""
+    N = dc.allocatable.shape[0]
+    feasible = jnp.ones(N, dtype=bool)
+    if spec.fit:
+        feasible = feasible & T.fit_mask(dc, st, s)
+    if spec.taints:
+        feasible = feasible & T.taint_mask(dc, s)
+    if spec.node_affinity:
+        feasible = feasible & T.node_affinity_mask(d, s)
+    if spec.interpod:
+        feasible = feasible & T.interpod_filter_mask(d, st, s)
+    if spec.spread:
+        feasible = feasible & T.spread_filter_mask(d, st, s)
+
+    w = dict(spec.weights)
+    total = jnp.zeros(N, dtype=jnp.float32)
+    if spec.fit and w.get("NodeResourcesFit", 1.0) != 0:
+        rw = np.asarray(spec.resource_weights, dtype=np.float32)  # static
+        if spec.fit_strategy == "LeastAllocated":
+            raw = T.least_allocated_score(dc, st, s, rw)
+        elif spec.fit_strategy == "MostAllocated":
+            raw = T.most_allocated_score(dc, st, s, rw)
+        else:
+            raw = T.requested_to_capacity_ratio_score(
+                dc, st, s, rw, spec.shape_x, spec.shape_y
+            )
+        total = total + w.get("NodeResourcesFit", 1.0) * raw
+    if spec.taints and w.get("TaintToleration", 1.0) != 0:
+        raw = T.taint_prefer_count(dc, s)
+        total = total + w.get("TaintToleration", 1.0) * T.normalize_max(raw, feasible, reverse=True)
+    if spec.node_affinity and w.get("NodeAffinity", 1.0) != 0:
+        raw = T.node_affinity_score(d, s)
+        total = total + w.get("NodeAffinity", 1.0) * T.normalize_max(raw, feasible)
+    if spec.interpod and w.get("InterPodAffinity", 1.0) != 0:
+        raw = T.interpod_score(d, st, s)
+        total = total + w.get("InterPodAffinity", 1.0) * T.normalize_min_max(raw, feasible)
+    if spec.spread and w.get("PodTopologySpread", 1.0) != 0:
+        raw = T.spread_score(d, st, s)
+        total = total + w.get("PodTopologySpread", 1.0) * T.normalize_min_max(
+            raw, feasible, reverse=True
+        )
+    return feasible, total
+
+
+def make_wave_step(dc_D: int, wave_width: int, spec: StepSpec):
+    """Build the scan body: one wave = W sequential slot placements +
+    wave-boundary gang commit (SURVEY.md §3.3 Permit-as-masked-commit)."""
+
+    def wave_step(carry, slot_batch: T.PodSlot):
+        dc, d, st = carry
+        choices, placeds = [], []
+        for wslot in range(wave_width):
+            s = jax.tree.map(lambda a: a[wslot], slot_batch)
+            feasible, scores = eval_pod(dc, d, st, s, spec)
+            node, placed = T.select_node(scores, feasible)
+            placed = placed & s.valid
+            st = T.apply_binding(dc, d, st, s, node, placed)
+            choices.append(node)
+            placeds.append(placed)
+        choice = jnp.stack(choices)  # [W]
+        placed = jnp.stack(placeds)  # [W]
+        groups = slot_batch.group  # [W]
+        same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
+        fail = jnp.any(same & ~placed[None, :], axis=1)  # gang all-or-nothing
+        revert = placed & fail
+        for wslot in range(wave_width):
+            s = jax.tree.map(lambda a: a[wslot], slot_batch)
+            st = T.apply_binding(dc, d, st, s, choice[wslot], revert[wslot], sign=-1.0)
+        final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
+        return (dc, d, st), final
+
+    return wave_step
+
+
+def make_chunk_fn(D: int, wave_width: int, spec: StepSpec):
+    """jit-compiled: (DevCluster, DevState, slots[C, W]) → (DevState,
+    choices[C, W]). Derived tensors are rebuilt inside jit from the cluster
+    tensors, so perturbed clusters reuse the same executable."""
+
+    wave_step = make_wave_step(D, wave_width, spec)
+
+    @jax.jit
+    def chunk_fn(dc: T.DevCluster, state: T.DevState, slots: T.PodSlot):
+        d = T.Derived.build(dc, D)
+        (_, _, state), choices = jax.lax.scan(wave_step, (dc, d, state), slots)
+        return state, choices
+
+    return chunk_fn
+
+
+class JaxReplayEngine:
+    def __init__(
+        self,
+        ec: EncodedCluster,
+        pods: EncodedPods,
+        config: Optional[FrameworkConfig] = None,
+        wave_width: int = 8,
+        chunk_waves: int = 2048,
+    ):
+        self.ec = ec
+        self.pods = pods
+        self.spec = StepSpec.from_config(ec, config)
+        self.wave_width = wave_width
+        self.chunk_waves = chunk_waves
+        self.dc = T.DevCluster.from_encoded(ec)
+        self.waves = pack_waves(pods, wave_width)
+        self.D = max(ec.max_domains, 1)
+        self.chunk_fn = make_chunk_fn(self.D, wave_width, self.spec)
+
+    def _init_dev_state(self) -> T.DevState:
+        host = init_state(self.ec, self.pods)  # applies pre-bound pods
+        return T.DevState(
+            used=jnp.asarray(host.used),
+            match_count=jnp.asarray(host.match_count),
+            anti_active=jnp.asarray(host.anti_active),
+            pref_wsum=jnp.asarray(host.pref_wsum),
+        )
+
+    def replay(self) -> ReplayResult:
+        idx = self.waves.idx
+        C = min(self.chunk_waves, max(idx.shape[0], 1))
+        pad_to = ((idx.shape[0] + C - 1) // C) * C
+        if pad_to != idx.shape[0]:
+            idx = np.concatenate(
+                [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)]
+            )
+        state = self._init_dev_state()
+        all_choices = []
+        t0 = time.perf_counter()
+        for c0 in range(0, idx.shape[0], C):
+            slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
+            state, choices = self.chunk_fn(self.dc, state, slots)
+            all_choices.append(choices)
+        choices = jax.block_until_ready(jnp.concatenate(all_choices, axis=0))
+        wall = time.perf_counter() - t0
+
+        choices_np = np.asarray(choices)
+        assignments = np.where(self.pods.bound_node >= 0, self.pods.bound_node, PAD).astype(
+            np.int32
+        )
+        flat_idx = idx.reshape(-1)
+        flat_choice = choices_np.reshape(-1)
+        valid = flat_idx >= 0
+        assignments[flat_idx[valid]] = flat_choice[valid]
+        placed = int((flat_choice[valid] >= 0).sum())
+        to_schedule = int(valid.sum())
+
+        used = np.asarray(state.used)
+        util = {}
+        for rname in ("cpu", "memory"):
+            ri = self.ec.vocab._r.get(rname)
+            if ri is not None:
+                alloc = self.ec.allocatable[:, ri]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    u = np.where(alloc > 0, used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
+                util[rname] = float(u.mean())
+        host_state = SchedState(
+            used=used,
+            match_count=np.asarray(state.match_count),
+            anti_active=np.asarray(state.anti_active),
+            pref_wsum=np.asarray(state.pref_wsum),
+            bound=assignments.copy(),
+        )
+        return ReplayResult(
+            assignments=assignments,
+            placed=placed,
+            unschedulable=to_schedule - placed,
+            preemptions=0,
+            attempts=to_schedule,
+            wall_clock_s=wall,
+            placements_per_sec=placed / wall if wall > 0 else 0.0,
+            virtual_makespan=float(self.pods.arrival.max()) if self.pods.num_pods else 0.0,
+            utilization=util,
+            state=host_state,
+        )
+
+
+@register_strategy("jax")
+def _make_jax(ec: EncodedCluster, pods: EncodedPods, config: Optional[FrameworkConfig] = None, **kw):
+    return JaxReplayEngine(ec, pods, config, **kw)
