@@ -1,0 +1,89 @@
+"""Benchmark entry point — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric ([BASELINE]): pod-placements/sec. The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` is the speedup of the JAX what-if
+path over this framework's own CPU default plugin path on the same
+workload shape (per-placement rate ratio) — the honest available baseline.
+
+Workload: batched what-if (config #3 shape) — S scenarios × full default
+plugin set, measured on the real device; CPU rate measured on a pod
+subsample (it is orders of magnitude slower).
+
+Env knobs: BENCH_NODES, BENCH_PODS, BENCH_SCENARIOS, BENCH_CPU_PODS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    nodes = int(os.environ.get("BENCH_NODES", 2000))
+    pods_n = int(os.environ.get("BENCH_PODS", 20_000))
+    S = int(os.environ.get("BENCH_SCENARIOS", 32))
+    cpu_pods = int(os.environ.get("BENCH_CPU_PODS", 2000))
+
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.greedy import greedy_replay
+    from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+    from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+    cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
+    pods, _ = make_workload(
+        pods_n, seed=0, with_affinity=True, with_spread=True, with_tolerations=True,
+        gang_fraction=0.02, gang_size=4,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+
+    # CPU default-path baseline on a subsample (same cluster).
+    pods_small = pods[:cpu_pods]
+    ec_s, ep_s = encode(cluster, pods_small)
+    cpu_res = greedy_replay(ec_s, ep_s, FrameworkConfig())
+    cpu_pps = cpu_res.placements_per_sec
+
+    # JAX what-if batch: compile once (first run), then measure.
+    scenarios = uniform_scenarios(ec, S, seed=0)
+    eng = WhatIfEngine(ec, ep, scenarios, cfg, chunk_waves=512)
+    eng.run()  # warmup: compile + first execution
+    res = eng.run()  # measured
+
+    value = res.placements_per_sec
+    vs = value / cpu_pps if cpu_pps > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set)"
+                % (S, nodes, pods_n),
+                "value": round(value, 1),
+                "unit": "placements/sec",
+                "vs_baseline": round(vs, 2),
+                "detail": {
+                    "jax_wall_s": round(res.wall_clock_s, 3),
+                    "jax_total_placed": res.total_placed,
+                    "cpu_default_path_pps": round(cpu_pps, 1),
+                    "scenario0_placed": int(res.placed[0]),
+                    "device": _device_kind(),
+                },
+            }
+        )
+    )
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return str(jax.devices()[0])
+    except Exception as e:  # pragma: no cover
+        return f"unavailable: {e}"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""TPU mesh + scenario sharding (SURVEY.md §2 parallelism mapping).
+
+The what-if scenario axis is the framework's data-parallel axis: S perturbed
+cluster states shard over a ``jax.sharding.Mesh`` of TPU devices
+(`scenarios` axis), each device scanning the same pod stream against its
+local scenarios. Collectives (the XLA-compiled equivalents of the
+reference-world's NCCL) appear only at metric-gather time — one ``psum`` /
+``all_gather`` over ICI per replay, exactly as SURVEY.md §5 prescribes.
+
+Multi-host (DCN) scaling uses the same code path: ``init_distributed()``
+brings up ``jax.distributed`` and the mesh simply spans all processes'
+devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SCENARIO_AXIS = "scenarios"
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up over DCN ([K8S]-world has no equivalent; this is
+    the TPU-native answer to a distributed communication backend). No-op for
+    single-process runs."""
+    if num_processes and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def make_mesh(num_devices: Optional[int] = None, axis: str = SCENARIO_AXIS) -> Mesh:
+    """1-D device mesh over the scenario axis. ``num_devices`` defaults to
+    all visible devices (TPU slice, or the CPU virtual devices in tests)."""
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def scenario_sharding(mesh: Mesh, axis: str = SCENARIO_AXIS) -> NamedSharding:
+    """Shard the leading (scenario) dimension; replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_scenario_tree(mesh: Mesh, tree, axis: str = SCENARIO_AXIS):
+    """device_put every leaf with its leading dim sharded over the mesh."""
+    sh = scenario_sharding(mesh, axis)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+
+
+def replicate_tree(mesh: Mesh, tree):
+    sh = replicated(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), tree)

@@ -1,0 +1,358 @@
+"""What-if scenario engine (SURVEY.md §3.2): S perturbed cluster states
+evaluated as ONE SPMD program.
+
+The reference evaluates scenarios with its per-pod loop, one scenario at a
+time ([BASELINE]); here the scenario axis is a ``vmap`` dimension sharded
+over the TPU mesh, so ``whatIf(1024 scenarios)`` is a single jitted scan
+whose every step evaluates ``[S_local, N]`` masks/scores per pod.
+
+Perturbation DSL (cluster-state perturbations, per [BASELINE]):
+- ``scale_capacity(nodes, resource, factor)``
+- ``node_down(nodes)`` (allocatable → 0)
+- ``add_taint(nodes, key, value, effect)`` (spare taint slots are added)
+- ``set_label(nodes, key, value)`` (topology domains are re-derived)
+
+Pod-side tensors are shared across scenarios (the trace is common); only
+node-side tensors are stacked ``[S, ...]``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.framework import FrameworkConfig
+from ..models.core import Effect
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from ..models.state import init_state
+from ..ops import tpu as T
+from ..parallel.mesh import SCENARIO_AXIS, make_mesh, replicate_tree, shard_scenario_tree
+from .jax_runtime import StepSpec, make_wave_step
+from .waves import pack_waves
+
+
+@dataclass
+class Perturbation:
+    """One mutation of the base cluster. ``nodes`` is a boolean mask or
+    index array over nodes."""
+
+    op: str  # "scale_capacity" | "node_down" | "add_taint" | "set_label"
+    nodes: np.ndarray
+    resource: Optional[str] = None
+    factor: float = 1.0
+    key: Optional[str] = None
+    value: Optional[str] = None
+    effect: str = "NoSchedule"
+
+
+@dataclass
+class Scenario:
+    perturbations: List[Perturbation] = field(default_factory=list)
+
+
+class ScenarioSet:
+    """Stacked [S, ...] node-side tensors for a batch of scenarios."""
+
+    def __init__(self, ec: EncodedCluster, scenarios: Sequence[Scenario], spare_taint_slots: int = 2):
+        self.ec = ec
+        self.num_scenarios = len(scenarios)
+        S = self.num_scenarios
+        vocab = ec.vocab
+
+        # Spare taint slots so add_taint has room (shared shape across S).
+        TT = ec.taint_key.shape[1] + spare_taint_slots
+        base_tk = np.full((ec.num_nodes, TT), PAD, np.int32)
+        base_tv = np.full((ec.num_nodes, TT), PAD, np.int32)
+        base_te = np.zeros((ec.num_nodes, TT), np.int32)
+        base_tk[:, : ec.taint_key.shape[1]] = ec.taint_key
+        base_tv[:, : ec.taint_key.shape[1]] = ec.taint_kv
+        base_te[:, : ec.taint_key.shape[1]] = ec.taint_effect
+
+        alloc = np.repeat(ec.allocatable[None], S, axis=0).copy()
+        tk = np.repeat(base_tk[None], S, axis=0).copy()
+        tv = np.repeat(base_tv[None], S, axis=0).copy()
+        te = np.repeat(base_te[None], S, axis=0).copy()
+        lk = np.repeat(ec.node_label_key[None], S, axis=0).copy()
+        lv = np.repeat(ec.node_label_kv[None], S, axis=0).copy()
+        ln = np.repeat(ec.node_label_num[None], S, axis=0).copy()
+        labels_dirty = np.zeros(S, dtype=bool)
+
+        for si, sc in enumerate(scenarios):
+            for pt in sc.perturbations:
+                mask = np.zeros(ec.num_nodes, dtype=bool)
+                mask[pt.nodes] = True
+                if pt.op == "scale_capacity":
+                    ri = vocab._r.get(pt.resource)
+                    if ri is None:
+                        continue
+                    alloc[si, mask, ri] = alloc[si, mask, ri] * pt.factor
+                elif pt.op == "node_down":
+                    alloc[si, mask, :] = 0.0
+                elif pt.op == "add_taint":
+                    kid = vocab.key(pt.key)
+                    kvid = vocab.kv(pt.key, pt.value or "")
+                    eff = int(Effect.parse(pt.effect))
+                    for n in np.nonzero(mask)[0]:
+                        free = np.nonzero(tk[si, n] == PAD)[0]
+                        if free.size == 0:
+                            raise ValueError("no spare taint slot; raise spare_taint_slots")
+                        tk[si, n, free[0]] = kid
+                        tv[si, n, free[0]] = kvid
+                        te[si, n, free[0]] = eff
+                elif pt.op == "set_label":
+                    kid = vocab.key(pt.key)
+                    kvid = vocab.kv(pt.key, pt.value or "")
+                    try:
+                        num = float(pt.value)
+                    except (TypeError, ValueError):
+                        num = np.nan
+                    for n in np.nonzero(mask)[0]:
+                        slots = np.nonzero(lk[si, n] == kid)[0]
+                        slot = slots[0] if slots.size else np.nonzero(lk[si, n] == PAD)[0][0]
+                        lk[si, n, slot] = kid
+                        lv[si, n, slot] = kvid
+                        ln[si, n, slot] = num
+                    labels_dirty[si] = True
+                else:
+                    raise ValueError(f"unknown perturbation op {pt.op!r}")
+
+        # Re-derive topology domains where labels changed (domain ids are
+        # ranks of kv ids among values present — matches the encoder's
+        # sorted-unique ordering because kv ids were interned in vocab order;
+        # we rank by label VALUE string to stay consistent).
+        T_keys = len(vocab.topo_keys)
+        nd = np.repeat(ec.node_domain[None], S, axis=0).copy()
+        ndom = np.repeat(ec.num_domains[None], S, axis=0).copy()
+        for si in range(S):
+            if not labels_dirty[si]:
+                continue
+            for ti, tkey in enumerate(vocab.topo_keys):
+                kid = vocab._k.get(tkey)
+                if kid is None:
+                    continue
+                vals = np.full(ec.num_nodes, -1, np.int64)
+                for n in range(ec.num_nodes):
+                    slots = np.nonzero(lk[si, n] == kid)[0]
+                    vals[n] = lv[si, n, slots[0]] if slots.size else -1
+                present = vals >= 0
+                # rank by value string for determinism
+                uniq = sorted({int(v) for v in vals[present]}, key=lambda kv: vocab.kvs[kv][1])
+                rank = {v: i for i, v in enumerate(uniq)}
+                nd[si, ti] = np.array([rank.get(int(v), PAD) if p else PAD for v, p in zip(vals, present)], np.int32)
+                ndom[si, ti] = len(uniq)
+        self.max_domains = max(int(ndom.max()) if ndom.size else 1, ec.max_domains, 1)
+
+        self.dc = T.DevCluster(
+            allocatable=jnp.asarray(alloc),
+            node_label_key=jnp.asarray(lk),
+            node_label_kv=jnp.asarray(lv),
+            node_label_num=jnp.asarray(ln),
+            taint_key=jnp.asarray(tk),
+            taint_kv=jnp.asarray(tv),
+            taint_effect=jnp.asarray(te),
+            node_domain=jnp.asarray(nd),
+            num_domains=jnp.asarray(ndom),
+            expr_key=jnp.asarray(np.repeat(ec.expr_key[None], S, 0)),
+            expr_op=jnp.asarray(np.repeat(ec.expr_op[None], S, 0)),
+            expr_vals=jnp.asarray(np.repeat(ec.expr_vals[None], S, 0)),
+            expr_num=jnp.asarray(np.repeat(ec.expr_num[None], S, 0)),
+            group_topo=jnp.asarray(np.repeat(ec.group_topo[None], S, 0)),
+        )
+
+
+@dataclass
+class WhatIfResult:
+    placed: np.ndarray  # [S] i32
+    unschedulable: np.ndarray  # [S] i32
+    total_placed: int
+    wall_clock_s: float
+    placements_per_sec: float  # aggregate over all scenarios
+    assignments: Optional[np.ndarray] = None  # [S, P] when collected
+    utilization_cpu: Optional[np.ndarray] = None  # [S]
+
+
+class WhatIfEngine:
+    """Batched scenario evaluation: ``vmap`` over local scenarios, optional
+    mesh sharding over devices (config #3 / #5 shapes)."""
+
+    def __init__(
+        self,
+        ec: EncodedCluster,
+        pods: EncodedPods,
+        scenarios: Sequence[Scenario],
+        config: Optional[FrameworkConfig] = None,
+        wave_width: int = 8,
+        chunk_waves: int = 1024,
+        mesh=None,
+        collect_assignments: bool = False,
+    ):
+        self.ec = ec
+        self.pods = pods
+        self.spec = StepSpec.from_config(ec, config)
+        self.wave_width = wave_width
+        self.chunk_waves = chunk_waves
+        self.mesh = mesh
+        self.collect_assignments = collect_assignments
+        self.sset = ScenarioSet(ec, scenarios)
+        self.S = self.sset.num_scenarios
+        if mesh is not None:
+            ndev = mesh.devices.size
+            if self.S % ndev != 0:
+                raise ValueError(f"num scenarios {self.S} must divide over {ndev} devices")
+        self.waves = pack_waves(pods, wave_width)
+        self.D = max(self.sset.max_domains, 1)
+        self._chunk_fn = self._build_chunk_fn()
+
+    def _build_chunk_fn(self):
+        wave_step = make_wave_step(self.D, self.wave_width, self.spec)
+        collect = self.collect_assignments
+
+        def per_scenario(dc, state, slots):
+            d = T.Derived.build(dc, self.D)
+
+            def step(carry, slot_batch):
+                (dc_, d_, st_), choices = wave_step(carry, slot_batch)
+                placed_w = jnp.sum((choices >= 0) & slot_batch.valid).astype(jnp.int32)
+                out = choices if collect else placed_w
+                return (dc_, d_, st_), out
+
+            (_, _, state), outs = jax.lax.scan(step, (dc, d, state), slots)
+            return state, outs
+
+        vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None))
+
+        if self.mesh is None:
+            return jax.jit(vmapped)
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = NamedSharding(self.mesh, P(SCENARIO_AXIS))
+        repl = NamedSharding(self.mesh, P())
+        dc_sh = jax.tree.map(lambda _: shard, self.sset.dc)
+        return jax.jit(
+            vmapped,
+            in_shardings=(dc_sh, jax.tree.map(lambda _: shard, T.DevState.init(self.ec)),
+                          jax.tree.map(lambda _: repl, T.gather_slots(self.pods, self.waves.idx[:1]))),
+            out_shardings=(shard, shard),
+        )
+
+    def _init_states(self) -> T.DevState:
+        host = init_state(self.ec, self.pods)  # pre-bound pods
+        G, D = host.match_count.shape[0], self.D
+        # Domain dim may have grown (label perturbations) → pad.
+        mc = np.zeros((G, D), np.float32)
+        mc[:, : host.match_count.shape[1]] = host.match_count
+        aa = np.zeros((G, D), np.float32)
+        aa[:, : host.anti_active.shape[1]] = host.anti_active
+        pw = np.zeros((G, D), np.float32)
+        pw[:, : host.pref_wsum.shape[1]] = host.pref_wsum
+        rep = lambda a: jnp.asarray(np.repeat(a[None], self.S, axis=0))
+        return T.DevState(
+            used=rep(host.used), match_count=rep(mc), anti_active=rep(aa), pref_wsum=rep(pw)
+        )
+
+    def run(self) -> WhatIfResult:
+        idx = self.waves.idx
+        C = min(self.chunk_waves, max(idx.shape[0], 1))
+        pad_to = ((idx.shape[0] + C - 1) // C) * C
+        if pad_to != idx.shape[0]:
+            idx = np.concatenate([idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)])
+        states = self._init_states()
+        dc = self.sset.dc
+        if self.mesh is not None:
+            dc = shard_scenario_tree(self.mesh, dc)
+            states = shard_scenario_tree(self.mesh, states)
+        outs = []
+        t0 = time.perf_counter()
+        for c0 in range(0, idx.shape[0], C):
+            slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
+            if self.mesh is not None:
+                slots = replicate_tree(self.mesh, slots)
+            states, out = self._chunk_fn(dc, states, slots)
+            outs.append(out)
+        jax.block_until_ready(states)
+        wall = time.perf_counter() - t0
+
+        to_schedule = int((idx >= 0).sum())
+        if self.collect_assignments:
+            choices = np.concatenate([np.asarray(o) for o in outs], axis=1)  # [S, Cw, W]
+            flat_idx = idx.reshape(-1)
+            valid = flat_idx >= 0
+            assignments = np.full((self.S, self.pods.num_pods), PAD, np.int32)
+            assignments[:, self.pods.bound_node >= 0] = self.pods.bound_node[
+                self.pods.bound_node >= 0
+            ]
+            flat_choice = choices.reshape(self.S, -1)
+            assignments[:, flat_idx[valid]] = flat_choice[:, valid]
+            placed = (flat_choice[:, valid] >= 0).sum(axis=1).astype(np.int32)
+        else:
+            assignments = None
+            placed = np.concatenate([np.asarray(o) for o in outs], axis=1).sum(axis=1).astype(np.int32)
+
+        used = np.asarray(states.used)  # [S, N, R]
+        util = None
+        ri = self.ec.vocab._r.get("cpu")
+        if ri is not None:
+            alloc = np.asarray(self.sset.dc.allocatable)[:, :, ri]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                u = np.where(alloc > 0, used[:, :, ri] / np.where(alloc > 0, alloc, 1), 0)
+            util = u.mean(axis=1)
+        total = int(placed.sum())
+        return WhatIfResult(
+            placed=placed,
+            unschedulable=(to_schedule - placed).astype(np.int32),
+            total_placed=total,
+            wall_clock_s=wall,
+            placements_per_sec=total / wall if wall > 0 else 0.0,
+            assignments=assignments,
+            utilization_cpu=util,
+        )
+
+
+def uniform_scenarios(
+    ec: EncodedCluster,
+    num_scenarios: int,
+    seed: int = 0,
+    p_node_down: float = 0.02,
+    p_capacity: float = 0.3,
+    p_taint: float = 0.1,
+) -> List[Scenario]:
+    """Random cluster-state perturbation sampler (the [BASELINE] eval shape:
+    vmap over cluster-state perturbations). Scenario 0 is always the
+    unperturbed base for reference."""
+    rng = np.random.default_rng(seed)
+    out = [Scenario()]
+    N = ec.num_nodes
+    for _ in range(num_scenarios - 1):
+        pts: List[Perturbation] = []
+        if rng.random() < p_node_down:
+            k = int(rng.integers(1, max(2, N // 50)))
+            pts.append(Perturbation("node_down", nodes=rng.choice(N, size=k, replace=False)))
+        if rng.random() < p_capacity:
+            k = int(rng.integers(1, max(2, N // 10)))
+            pts.append(
+                Perturbation(
+                    "scale_capacity",
+                    nodes=rng.choice(N, size=k, replace=False),
+                    resource="cpu",
+                    factor=float(rng.choice([0.5, 0.75, 1.25, 1.5])),
+                )
+            )
+        if rng.random() < p_taint:
+            k = int(rng.integers(1, max(2, N // 20)))
+            pts.append(
+                Perturbation(
+                    "add_taint",
+                    nodes=rng.choice(N, size=k, replace=False),
+                    key="whatif/injected",
+                    value="true",
+                    effect="NoSchedule",
+                )
+            )
+        out.append(Scenario(pts))
+    return out

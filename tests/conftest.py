@@ -1,9 +1,20 @@
-"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax imports,
-so mesh/sharding tests run without TPUs (SURVEY.md §4.4)."""
+"""Test env: force JAX onto CPU with 8 virtual devices, so mesh/sharding
+tests run without TPUs (SURVEY.md §4.4).
+
+The axon sitecustomize pre-imports jax with JAX_PLATFORMS=axon before
+pytest starts, so setting env vars here is too late for the platform choice
+— use jax.config.update instead (the backend is created lazily at first
+use, which happens after conftest import). XLA_FLAGS is still read at
+backend-creation time, so setting it here works.
+"""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,136 @@
+"""What-if scenario engine: vmap correctness vs looped evaluation, mesh
+sharding on the 8-device CPU mesh, perturbation semantics (SURVEY.md §4.4-5)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.synthetic import config1, make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.whatif import (
+    Perturbation,
+    Scenario,
+    WhatIfEngine,
+    uniform_scenarios,
+)
+
+
+def small_case(seed=0, n=15, p=80):
+    cluster = make_cluster(n, seed=seed, taint_fraction=0.1)
+    pods, _ = make_workload(p, seed=seed, with_affinity=True, with_spread=True,
+                            with_tolerations=True)
+    return encode(cluster, pods)
+
+
+def test_base_scenario_matches_single_replay():
+    """Scenario 0 (unperturbed) must equal the plain jax engine exactly."""
+    ec, ep = small_case()
+    cfg = FrameworkConfig()
+    single = JaxReplayEngine(ec, ep, cfg).replay()
+    eng = WhatIfEngine(ec, ep, [Scenario(), Scenario()], cfg, collect_assignments=True)
+    res = eng.run()
+    assert (res.assignments[0] == single.assignments).all()
+    assert res.placed[0] == single.placed
+
+
+def test_vmap_matches_looped_perturbed_scenarios():
+    """Each perturbed scenario must equal a from-scratch single replay on
+    the equivalently perturbed cluster (SURVEY.md §4.5)."""
+    from kubernetes_simulator_tpu.models.core import Taint
+
+    cluster = make_cluster(12, seed=3)
+    pods, _ = make_workload(60, seed=3, with_tolerations=True)
+    ec, ep = encode(cluster, pods)
+
+    down = np.array([0, 1])
+    scen = [
+        Scenario(),
+        Scenario([Perturbation("node_down", nodes=down)]),
+        Scenario([Perturbation("scale_capacity", nodes=np.arange(6), resource="cpu", factor=0.5)]),
+        Scenario([Perturbation("add_taint", nodes=np.arange(4), key="k", value="v",
+                               effect="NoSchedule")]),
+    ]
+    res = WhatIfEngine(ec, ep, scen, FrameworkConfig(), collect_assignments=True).run()
+
+    # Reference replays with the perturbation applied to the object model.
+    cluster_down = make_cluster(12, seed=3)
+    for i in down:
+        cluster_down.nodes[i].allocatable = {k: 0.0 for k in cluster_down.nodes[i].allocatable}
+    ec2, ep2 = encode(cluster_down, pods)
+    ref = JaxReplayEngine(ec2, ep2, FrameworkConfig()).replay()
+    assert (res.assignments[1] == ref.assignments).all()
+
+    cluster_half = make_cluster(12, seed=3)
+    for i in range(6):
+        cluster_half.nodes[i].allocatable = {
+            k: (v * 0.5 if k == "cpu" else v) for k, v in cluster_half.nodes[i].allocatable.items()
+        }
+    ec3, ep3 = encode(cluster_half, pods)
+    ref3 = JaxReplayEngine(ec3, ep3, FrameworkConfig()).replay()
+    assert (res.assignments[2] == ref3.assignments).all()
+
+    cluster_taint = make_cluster(12, seed=3)
+    for i in range(4):
+        cluster_taint.nodes[i].taints.append(Taint("k", "v", "NoSchedule"))
+    ec4, ep4 = encode(cluster_taint, pods)
+    ref4 = JaxReplayEngine(ec4, ep4, FrameworkConfig()).replay()
+    assert (res.assignments[3] == ref4.assignments).all()
+
+
+def test_mesh_sharded_matches_unsharded():
+    """shard_map-equivalent sharded run over 8 virtual devices must equal
+    the single-device vmap bit-for-bit."""
+    assert len(jax.devices()) == 8
+    ec, ep = small_case(seed=7)
+    scen = uniform_scenarios(ec, 16, seed=7)
+    cfg = FrameworkConfig()
+    plain = WhatIfEngine(ec, ep, scen, cfg, collect_assignments=True).run()
+    mesh = make_mesh()
+    sharded = WhatIfEngine(ec, ep, scen, cfg, mesh=mesh, collect_assignments=True).run()
+    assert (plain.assignments == sharded.assignments).all()
+    assert (plain.placed == sharded.placed).all()
+
+
+def test_node_down_reduces_capacity():
+    ec, ep = small_case(seed=1, n=6, p=60)
+    scen = [Scenario(), Scenario([Perturbation("node_down", nodes=np.arange(3))])]
+    res = WhatIfEngine(ec, ep, scen, FrameworkConfig()).run()
+    assert res.placed[1] <= res.placed[0]
+
+
+def test_set_label_rederives_domains():
+    """Moving nodes between zones must change spread domain counts."""
+    from kubernetes_simulator_tpu.models.core import (
+        Cluster, LabelSelector, Node, Pod, TopologySpreadConstraint,
+    )
+
+    nodes = [Node(f"n{i}", {"cpu": 100}, labels={"zone": "za" if i < 3 else "zb"})
+             for i in range(4)]
+    sel = LabelSelector.make({"app": "w"})
+    pods = [
+        Pod(f"p{i}", labels={"app": "w"},
+            topology_spread=[TopologySpreadConstraint(1, "zone", "DoNotSchedule", sel)],
+            arrival_time=float(i), requests={"cpu": 1})
+        for i in range(8)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    # Scenario 1 moves n3 into za → single domain → skew constraint trivial.
+    scen = [
+        Scenario(),
+        Scenario([Perturbation("set_label", nodes=np.array([3]), key="zone", value="za")]),
+    ]
+    res = WhatIfEngine(ec, ep, scen, FrameworkConfig(), collect_assignments=True).run()
+    assert res.placed[0] == 8 and res.placed[1] == 8
+    # In the base, placements must spread between za and zb nodes.
+    a0 = res.assignments[0]
+    assert (a0 < 3).any() and (a0 >= 3).any()
+
+
+def test_scenario_count_must_divide_devices():
+    ec, ep = small_case(seed=2, n=5, p=10)
+    with pytest.raises(ValueError):
+        WhatIfEngine(ec, ep, [Scenario()] * 3, mesh=make_mesh())
