@@ -1,0 +1,115 @@
+"""CLI (SURVEY.md §2 "CLI / API"): run replays and what-if sweeps from a
+YAML config.
+
+    python -m kubernetes_simulator_tpu run config.yaml [--strategy jax]
+    python -m kubernetes_simulator_tpu what-if config.yaml
+    python -m kubernetes_simulator_tpu validate config.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .framework.registry import get_strategy
+from .models.encode import encode
+from .utils.config import SimConfig, build_case
+from .utils.metrics import JsonlWriter, log, replay_row, whatif_rows
+from .utils.profiling import device_trace
+
+
+def cmd_run(args) -> int:
+    cfg = SimConfig.load(args.config)
+    if args.strategy:
+        cfg.strategy = args.strategy
+    cluster, pods = build_case(cfg)
+    log.info("encoding %d nodes / %d pods", len(cluster.nodes), len(pods))
+    ec, ep = encode(cluster, pods)
+    factory = get_strategy(cfg.strategy)
+    kw = {}
+    if cfg.strategy == "jax":
+        kw = {"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves}
+    engine = factory(ec, ep, cfg.framework, **kw)
+    with device_trace(args.profile_dir):
+        res = engine.replay()
+    out = JsonlWriter(cfg.output)
+    out.write(replay_row(f"replay-{cfg.strategy}", res, {"config": args.config}))
+    out.close()
+    log.info(
+        "placed %d/%d pods in %.3fs (%.0f placements/sec)",
+        res.placed,
+        res.placed + res.unschedulable,
+        res.wall_clock_s,
+        res.placements_per_sec,
+    )
+    return 0
+
+
+def cmd_whatif(args) -> int:
+    from .parallel.mesh import make_mesh
+    from .sim.whatif import WhatIfEngine, uniform_scenarios
+
+    cfg = SimConfig.load(args.config)
+    if cfg.whatif.scenarios <= 0:
+        log.error("config has no whatIf.scenarios")
+        return 2
+    cluster, pods = build_case(cfg)
+    ec, ep = encode(cluster, pods)
+    scen = uniform_scenarios(
+        ec,
+        cfg.whatif.scenarios,
+        seed=cfg.whatif.seed,
+        p_node_down=cfg.whatif.node_down_p,
+        p_capacity=cfg.whatif.capacity_p,
+        p_taint=cfg.whatif.taint_p,
+    )
+    mesh = make_mesh() if cfg.whatif.mesh else None
+    eng = WhatIfEngine(
+        ec,
+        ep,
+        scen,
+        cfg.framework,
+        wave_width=cfg.wave_width,
+        chunk_waves=cfg.chunk_waves,
+        mesh=mesh,
+    )
+    with device_trace(args.profile_dir):
+        res = eng.run()
+    out = JsonlWriter(cfg.output)
+    for row in whatif_rows(res, {"config": args.config, "mesh": bool(mesh)}):
+        out.write(row)
+    out.close()
+    log.info(
+        "what-if: %d scenarios, %d placements in %.3fs (%.0f placements/sec aggregate)",
+        len(scen),
+        res.total_placed,
+        res.wall_clock_s,
+        res.placements_per_sec,
+    )
+    return 0
+
+
+def cmd_validate(args) -> int:
+    cfg = SimConfig.load(args.config)
+    print(json.dumps({"strategy": cfg.strategy, "nodes": cfg.cluster.nodes,
+                      "workload": "borg" if cfg.borg else "synthetic",
+                      "whatif_scenarios": cfg.whatif.scenarios}, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_simulator_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("run", cmd_run), ("what-if", cmd_whatif), ("validate", cmd_validate)):
+        p = sub.add_parser(name)
+        p.add_argument("config")
+        p.add_argument("--strategy", choices=["cpu", "jax"])
+        p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
+        p.set_defaults(fn=fn)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

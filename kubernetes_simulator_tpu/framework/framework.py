@@ -41,6 +41,7 @@ class FrameworkConfig:
     plugins: Optional[List[dict]] = None  # [{"name":..., "args": {...}}]
     weights: Optional[Dict[str, float]] = None  # Score weights by plugin name
     enable_preemption: bool = True
+    profile: bool = False  # per-extension-point latency accounting
 
 
 class SchedulerFramework:
@@ -59,9 +60,15 @@ class SchedulerFramework:
     # -- Filter + Score over all nodes -------------------------------------
 
     def feasible_mask(self, st: SchedState, p: int) -> np.ndarray:
+        import time as _time
+
         mask = np.ones(self.ec.num_nodes, dtype=bool)
         for pl in self.plugins:
+            t0 = _time.perf_counter() if self.config.profile else 0.0
             m = pl.filter(self.ctx, st, p)
+            if self.config.profile:
+                key = f"Filter/{pl.name}"
+                self.plugin_time[key] = self.plugin_time.get(key, 0.0) + _time.perf_counter() - t0
             if m is not None:
                 mask &= m
                 if not mask.any():
@@ -69,15 +76,20 @@ class SchedulerFramework:
         return mask
 
     def score_nodes(self, st: SchedState, p: int, feasible: np.ndarray) -> np.ndarray:
+        import time as _time
+
         total = np.zeros(self.ec.num_nodes, dtype=np.float32)
         for pl in self.plugins:
-            raw = pl.score(self.ctx, st, p)
-            if raw is None:
-                continue
             w = self.weights.get(pl.name, 1.0)
             if w == 0:
                 continue
-            total += w * pl.normalize(raw, feasible)
+            t0 = _time.perf_counter() if self.config.profile else 0.0
+            raw = pl.score(self.ctx, st, p)
+            if raw is not None:
+                total += w * pl.normalize(raw, feasible)
+            if self.config.profile:
+                key = f"Score/{pl.name}"
+                self.plugin_time[key] = self.plugin_time.get(key, 0.0) + _time.perf_counter() - t0
         return total
 
     def schedule_one(self, st: SchedState, p: int) -> ScheduleResult:

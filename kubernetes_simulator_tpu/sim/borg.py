@@ -1,0 +1,232 @@
+"""Borg-2019-like trace generation at config #4 scale (SURVEY.md §2 trace
+driver; [BASELINE]: 10k nodes / 1M tasks, gang-scheduling predicates).
+
+The real Google cluster trace ships as BigQuery tables (collection_events /
+instance_events) that cannot be fetched from this environment (zero
+egress), so this module generates a statistically Borg-shaped workload:
+
+- heterogeneous machines (a few platform shapes, zone/rack topology)
+- tasks with bucketed normalized cpu/memory requests (log-uniform-ish mix)
+- priority tiers (free ≈ 0, batch ≈ 100, mid ≈ 200, prod ≈ 360,
+  monitoring ≈ 450 — the 2019 trace's tiering)
+- alloc sets → pod-groups (gangs) with contiguous members
+- diurnal-bursty arrivals
+- a slice of prod pods with zone topology-spread; batch pods tolerate a
+  ``dedicated=batch`` taint on a fraction of machines
+
+For 1M tasks, building Python Pod objects is too slow, so the generator
+expands a few hundred *template pods* (run through the normal Encoder so
+vocab/expr/count-group tables are exact) into vectorized EncodedPods
+arrays — every per-pod row is a fancy-index of its template row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ..models.core import (
+    Cluster,
+    LabelSelector,
+    Pod,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from ..models.encode import PAD, EncodedCluster, EncodedPods, Encoder
+from .synthetic import make_cluster
+
+PRIORITY_TIERS = np.array([0, 100, 200, 360, 450], dtype=np.int32)
+TIER_PROBS = np.array([0.25, 0.35, 0.15, 0.2, 0.05])
+CPU_BUCKETS = np.array([0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0], dtype=np.float32)
+CPU_PROBS = np.array([0.2, 0.25, 0.2, 0.15, 0.1, 0.07, 0.03])
+MEM_BUCKETS = (np.array([0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0], dtype=np.float32) * 2**30)
+MEM_PROBS = np.array([0.15, 0.2, 0.25, 0.15, 0.12, 0.08, 0.05])
+
+
+@dataclass
+class BorgSpec:
+    nodes: int = 10_000
+    tasks: int = 1_000_000
+    seed: int = 0
+    gang_fraction: float = 0.08  # fraction of tasks that arrive in alloc sets
+    max_gang: int = 8
+    num_apps: int = 48  # apps with interpod/spread terms (bounds count groups)
+    spread_app_fraction: float = 0.25
+    toleration_fraction: float = 0.3
+    mean_duration: float = 3600.0
+
+
+def _make_templates(spec: BorgSpec) -> List[Pod]:
+    """One template per (app-term-class, cpu bucket, mem bucket, tier) cell
+    actually used; kept small (~hundreds)."""
+    out: List[Pod] = []
+    for app in range(spec.num_apps):
+        labels = {"app": f"borg-app-{app}"}
+        spread = []
+        if app < int(spec.num_apps * spec.spread_app_fraction):
+            spread = [
+                TopologySpreadConstraint(
+                    max_skew=5,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=LabelSelector.make({"app": f"borg-app-{app}"}),
+                )
+            ]
+        for tol in (False, True):
+            p = Pod(
+                name=f"tmpl-{app}-{int(tol)}",
+                labels=dict(labels),
+                requests={"cpu": 1.0, "memory": 2**30},
+                topology_spread=list(spread),
+                tolerations=(
+                    [Toleration(key="dedicated", operator="Equal", value="batch")] if tol else []
+                ),
+            )
+            out.append(p)
+    return out
+
+
+def make_borg_encoded(spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict]:
+    """Vectorized trace build → (EncodedCluster, EncodedPods, meta)."""
+    rng = np.random.default_rng(spec.seed)
+    cluster = make_cluster(spec.nodes, seed=spec.seed, taint_fraction=0.15)
+    templates = _make_templates(spec)
+    enc = Encoder()
+    ec, tmpl_ep = enc.encode(cluster, templates)
+
+    P = spec.tasks
+    T = len(templates)
+    # Template choice: app ~ zipf-ish, toleration per tier.
+    app_probs = 1.0 / (np.arange(spec.num_apps) + 2.0)
+    app_probs /= app_probs.sum()
+    app = rng.choice(spec.num_apps, size=P, p=app_probs)
+    tier = rng.choice(len(PRIORITY_TIERS), size=P, p=TIER_PROBS)
+    tol = (tier <= 1) & (rng.random(P) < spec.toleration_fraction)
+    tidx = (app * 2 + tol.astype(np.int64)).astype(np.int64)
+
+    cpu = rng.choice(CPU_BUCKETS, size=P, p=CPU_PROBS).astype(np.float32)
+    mem = rng.choice(MEM_BUCKETS, size=P, p=MEM_PROBS).astype(np.float32)
+    requests = tmpl_ep.requests[tidx].copy()
+    ci, mi, pi = enc.vocab._r["cpu"], enc.vocab._r["memory"], enc.vocab._r["pods"]
+    requests[:, ci] = cpu
+    requests[:, mi] = mem
+    requests[:, pi] = 1.0
+
+    # Diurnal-bursty arrivals over a virtual day.
+    base_rate = P / 86400.0
+    phase = rng.random() * 86400
+    gaps = rng.exponential(1.0 / base_rate, size=P)
+    arrival = np.cumsum(gaps)
+    arrival *= 1.0 + 0.5 * np.sin((arrival + phase) * (2 * np.pi / 86400.0))
+    arrival = np.sort(arrival).astype(np.float64)
+
+    # Alloc sets: contiguous gangs.
+    group_id = np.full(P, PAD, dtype=np.int32)
+    gang_sizes: List[int] = []
+    i = 0
+    g = 0
+    while i < P:
+        if rng.random() < spec.gang_fraction / max(spec.max_gang / 2, 1):
+            size = int(rng.integers(2, spec.max_gang + 1))
+            size = min(size, P - i)
+            group_id[i : i + size] = g
+            gang_sizes.append(size)
+            g += 1
+            i += size
+        else:
+            i += 1
+    pg_min = np.array(gang_sizes or [1], dtype=np.int32)
+
+    duration = rng.exponential(spec.mean_duration, size=P).astype(np.float32)
+
+    ep = EncodedPods(
+        num_pods=P,
+        names=[f"task-{j}" for j in range(P)],
+        requests=requests,
+        priority=PRIORITY_TIERS[tier].astype(np.int32),
+        arrival=arrival,
+        duration=duration,
+        ns=tmpl_ep.ns[tidx],
+        bound_node=np.full(P, PAD, dtype=np.int32),
+        tol_key=tmpl_ep.tol_key[tidx],
+        tol_kv=tmpl_ep.tol_kv[tidx],
+        tol_effect=tmpl_ep.tol_effect[tidx],
+        na_req=tmpl_ep.na_req[tidx],
+        na_has_req=tmpl_ep.na_has_req[tidx],
+        na_pref=tmpl_ep.na_pref[tidx],
+        na_pref_w=tmpl_ep.na_pref_w[tidx],
+        aff_req=tmpl_ep.aff_req[tidx],
+        anti_req=tmpl_ep.anti_req[tidx],
+        pref_aff=tmpl_ep.pref_aff[tidx],
+        pref_aff_w=tmpl_ep.pref_aff_w[tidx],
+        spread_g=tmpl_ep.spread_g[tidx],
+        spread_skew=tmpl_ep.spread_skew[tidx],
+        spread_dns=tmpl_ep.spread_dns[tidx],
+        pod_matches_group=tmpl_ep.pod_matches_group[tidx],
+        group_id=group_id,
+        pg_min_member=pg_min,
+        pg_names=[f"alloc-set-{j}" for j in range(len(gang_sizes))] or ["none"],
+    )
+    meta = {
+        "num_gangs": len(gang_sizes),
+        "gang_pods": int((group_id >= 0).sum()),
+        "num_groups": ec.num_groups,
+        "makespan": float(arrival[-1]) if P else 0.0,
+    }
+    return ec, ep, meta
+
+
+def make_borg_trace(spec) -> Tuple[Cluster, List[Pod]]:
+    """Object-model variant for SMALL task counts (CPU-engine tests).
+    ``spec`` may be a BorgSpec or utils.config.BorgWorkloadSpec."""
+    bspec = BorgSpec(
+        nodes=spec.nodes,
+        tasks=spec.tasks,
+        seed=spec.seed,
+        gang_fraction=spec.gang_fraction,
+        max_gang=spec.max_gang,
+    )
+    if bspec.tasks > 200_000:
+        raise ValueError("object-model borg trace capped at 200k tasks; use make_borg_encoded")
+    rng = np.random.default_rng(bspec.seed)
+    cluster = make_cluster(bspec.nodes, seed=bspec.seed, taint_fraction=0.15)
+    templates = _make_templates(bspec)
+    app_probs = 1.0 / (np.arange(bspec.num_apps) + 2.0)
+    app_probs /= app_probs.sum()
+    pods: List[Pod] = []
+    t = 0.0
+    g = 0
+    i = 0
+    while i < bspec.tasks:
+        gang = rng.random() < bspec.gang_fraction / max(bspec.max_gang / 2, 1)
+        size = int(rng.integers(2, bspec.max_gang + 1)) if gang else 1
+        size = min(size, bspec.tasks - i)
+        gname = f"alloc-set-{g}" if gang else None
+        if gang:
+            g += 1
+        for _ in range(size):
+            t += float(rng.exponential(86400.0 / bspec.tasks))
+            app = int(rng.choice(bspec.num_apps, p=app_probs))
+            tier = int(rng.choice(len(PRIORITY_TIERS), p=TIER_PROBS))
+            tol = tier <= 1 and rng.random() < bspec.toleration_fraction
+            tmpl = templates[app * 2 + int(tol)]
+            pods.append(
+                Pod(
+                    name=f"task-{i}",
+                    labels=dict(tmpl.labels),
+                    requests={
+                        "cpu": float(rng.choice(CPU_BUCKETS, p=CPU_PROBS)),
+                        "memory": float(rng.choice(MEM_BUCKETS, p=MEM_PROBS)),
+                    },
+                    priority=int(PRIORITY_TIERS[tier]),
+                    arrival_time=t,
+                    duration=float(rng.exponential(bspec.mean_duration)),
+                    tolerations=list(tmpl.tolerations),
+                    topology_spread=list(tmpl.topology_spread),
+                    pod_group=gname,
+                )
+            )
+            i += 1
+    return cluster, pods

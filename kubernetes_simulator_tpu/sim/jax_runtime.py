@@ -215,7 +215,16 @@ class JaxReplayEngine:
             pref_wsum=jnp.asarray(host.pref_wsum),
         )
 
-    def replay(self) -> ReplayResult:
+    def replay(
+        self,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
+    ) -> ReplayResult:
+        """Run the replay; optionally snapshot the carry every K chunks to
+        ``checkpoint_path`` and/or resume from it (SURVEY.md §5)."""
+        from .checkpoint import ReplayCheckpoint, checkpoint_to_state, state_to_checkpoint
+
         idx = self.waves.idx
         C = min(self.chunk_waves, max(idx.shape[0], 1))
         pad_to = ((idx.shape[0] + C - 1) // C) * C
@@ -225,11 +234,21 @@ class JaxReplayEngine:
             )
         state = self._init_dev_state()
         all_choices = []
+        start_chunk = 0
+        if resume and checkpoint_path:
+            ck = ReplayCheckpoint.load(checkpoint_path)
+            state = checkpoint_to_state(ck)
+            all_choices = [jnp.asarray(o) for o in ck.outs]
+            start_chunk = ck.chunk_cursor
         t0 = time.perf_counter()
-        for c0 in range(0, idx.shape[0], C):
+        for ci, c0 in enumerate(range(0, idx.shape[0], C)):
+            if ci < start_chunk:
+                continue
             slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
             state, choices = self.chunk_fn(self.dc, state, slots)
             all_choices.append(choices)
+            if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
+                state_to_checkpoint(state, ci + 1, all_choices).save(checkpoint_path)
         choices = jax.block_until_ready(jnp.concatenate(all_choices, axis=0))
         wall = time.perf_counter() - t0
 

@@ -1,0 +1,193 @@
+"""YAML configuration (SURVEY.md §5 config/flag system).
+
+One schema with the upstream ``KubeSchedulerConfiguration`` vocabulary
+(profiles → plugins → args, per-plugin Score weights) plus simulator
+sections (cluster, workload, what-if, strategy). ``strategy`` selects the
+backend through the L6 registry — ``cpu`` is the default path, ``jax`` the
+TPU backend ([BASELINE] requirement).
+
+Example::
+
+    strategy: jax
+    cluster:
+      synthetic: {nodes: 5000, seed: 0, taintFraction: 0.1}
+    workload:
+      synthetic: {pods: 50000, seed: 0, affinity: true, spread: true,
+                  tolerations: true, gangFraction: 0.02, gangSize: 4}
+    profile:
+      plugins:
+        - name: NodeResourcesFit
+          args: {strategy: LeastAllocated, resources: {cpu: 1, memory: 1}}
+        - name: TaintToleration
+        - name: NodeAffinity
+        - name: InterPodAffinity
+        - name: PodTopologySpread
+      weights: {NodeResourcesFit: 1, TaintToleration: 3}
+    whatIf:
+      scenarios: 256
+      seed: 0
+      mesh: true
+    output: results.jsonl
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from ..framework.framework import FrameworkConfig
+
+
+@dataclass
+class SyntheticClusterSpec:
+    nodes: int = 100
+    seed: int = 0
+    taint_fraction: float = 0.0
+    zones: int = 8
+    extended_resources: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class SyntheticWorkloadSpec:
+    pods: int = 1000
+    seed: int = 0
+    affinity: bool = False
+    spread: bool = False
+    tolerations: bool = False
+    gang_fraction: float = 0.0
+    gang_size: int = 4
+    arrival_rate: float = 100.0
+    duration_mean: Optional[float] = None
+    num_apps: int = 20
+
+
+@dataclass
+class BorgWorkloadSpec:
+    nodes: int = 10_000
+    tasks: int = 1_000_000
+    seed: int = 0
+    gang_fraction: float = 0.08
+    max_gang: int = 8
+
+
+@dataclass
+class WhatIfSpec:
+    scenarios: int = 0
+    seed: int = 0
+    mesh: bool = False
+    node_down_p: float = 0.02
+    capacity_p: float = 0.3
+    taint_p: float = 0.1
+
+
+@dataclass
+class SimConfig:
+    strategy: str = "cpu"
+    cluster: SyntheticClusterSpec = field(default_factory=SyntheticClusterSpec)
+    workload: Optional[SyntheticWorkloadSpec] = None
+    borg: Optional[BorgWorkloadSpec] = None
+    framework: FrameworkConfig = field(default_factory=FrameworkConfig)
+    whatif: WhatIfSpec = field(default_factory=WhatIfSpec)
+    output: Optional[str] = None
+    wave_width: int = 8
+    chunk_waves: int = 1024
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimConfig":
+        cfg = cls()
+        cfg.strategy = d.get("strategy", "cpu")
+        cl = d.get("cluster", {})
+        syn = cl.get("synthetic", cl) or {}
+        cfg.cluster = SyntheticClusterSpec(
+            nodes=int(syn.get("nodes", 100)),
+            seed=int(syn.get("seed", 0)),
+            taint_fraction=float(syn.get("taintFraction", 0.0)),
+            zones=int(syn.get("zones", 8)),
+            extended_resources=syn.get("extendedResources"),
+        )
+        wl = d.get("workload", {})
+        if "borg" in wl:
+            b = wl["borg"]
+            cfg.borg = BorgWorkloadSpec(
+                nodes=int(b.get("nodes", 10_000)),
+                tasks=int(b.get("tasks", 1_000_000)),
+                seed=int(b.get("seed", 0)),
+                gang_fraction=float(b.get("gangFraction", 0.08)),
+                max_gang=int(b.get("maxGang", 8)),
+            )
+        else:
+            syn = wl.get("synthetic", wl) or {}
+            cfg.workload = SyntheticWorkloadSpec(
+                pods=int(syn.get("pods", 1000)),
+                seed=int(syn.get("seed", 0)),
+                affinity=bool(syn.get("affinity", False)),
+                spread=bool(syn.get("spread", False)),
+                tolerations=bool(syn.get("tolerations", False)),
+                gang_fraction=float(syn.get("gangFraction", 0.0)),
+                gang_size=int(syn.get("gangSize", 4)),
+                arrival_rate=float(syn.get("arrivalRate", 100.0)),
+                duration_mean=syn.get("durationMean"),
+                num_apps=int(syn.get("numApps", 20)),
+            )
+        prof = d.get("profile", {})
+        plugins = prof.get("plugins")
+        cfg.framework = FrameworkConfig(
+            plugins=plugins,
+            weights=prof.get("weights"),
+            enable_preemption=bool(prof.get("preemption", True)),
+        )
+        wi = d.get("whatIf", {})
+        cfg.whatif = WhatIfSpec(
+            scenarios=int(wi.get("scenarios", 0)),
+            seed=int(wi.get("seed", 0)),
+            mesh=bool(wi.get("mesh", False)),
+            node_down_p=float(wi.get("nodeDownP", 0.02)),
+            capacity_p=float(wi.get("capacityP", 0.3)),
+            taint_p=float(wi.get("taintP", 0.1)),
+        )
+        cfg.output = d.get("output")
+        cfg.wave_width = int(d.get("waveWidth", 8))
+        cfg.chunk_waves = int(d.get("chunkWaves", 1024))
+        return cfg
+
+    @classmethod
+    def load(cls, path: str) -> "SimConfig":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+
+def build_case(cfg: SimConfig):
+    """Materialize (cluster, pods) from a SimConfig."""
+    from ..sim.synthetic import make_cluster, make_workload
+
+    ext = None
+    if cfg.cluster.extended_resources:
+        ext = {k: tuple(v) for k, v in cfg.cluster.extended_resources.items()}
+    cluster = make_cluster(
+        cfg.cluster.nodes,
+        seed=cfg.cluster.seed,
+        num_zones=cfg.cluster.zones,
+        taint_fraction=cfg.cluster.taint_fraction,
+        extended_resources=ext,
+    )
+    if cfg.borg is not None:
+        from ..sim.borg import make_borg_trace
+
+        cluster, pods = make_borg_trace(cfg.borg)
+        return cluster, pods
+    wl = cfg.workload or SyntheticWorkloadSpec()
+    pods, _ = make_workload(
+        wl.pods,
+        seed=wl.seed,
+        arrival_rate=wl.arrival_rate,
+        duration_mean=wl.duration_mean,
+        with_affinity=wl.affinity,
+        with_spread=wl.spread,
+        with_tolerations=wl.tolerations,
+        num_apps=wl.num_apps,
+        gang_fraction=wl.gang_fraction,
+        gang_size=wl.gang_size,
+    )
+    return cluster, pods

@@ -1,0 +1,80 @@
+"""Metrics & observability (layer L7; SURVEY.md §5).
+
+Structured JSONL results (per-run and per-scenario rows), plain-text
+progress logging, and a BASELINE.md-compatible table emitter. The headline
+metric is pod-placements/sec ([BASELINE])."""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import IO, Iterable, Optional
+
+log = logging.getLogger("k8sim")
+if not log.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
+    log.addHandler(_h)
+    log.setLevel(logging.INFO)
+
+
+class JsonlWriter:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._f: Optional[IO] = open(path, "a") if path else None
+
+    def write(self, row: dict) -> None:
+        row = {"ts": time.time(), **row}
+        line = json.dumps(row)
+        if self._f:
+            self._f.write(line + "\n")
+            self._f.flush()
+        else:
+            print(line)
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+
+
+def replay_row(kind: str, res, extra: Optional[dict] = None) -> dict:
+    row = {"kind": kind, **res.summary()} if hasattr(res, "summary") else {"kind": kind}
+    if extra:
+        row.update(extra)
+    return row
+
+
+def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
+    base = extra or {}
+    yield {
+        "kind": "whatif-aggregate",
+        "scenarios": int(res.placed.shape[0]),
+        "total_placed": res.total_placed,
+        "wall_clock_s": round(res.wall_clock_s, 4),
+        "placements_per_sec": round(res.placements_per_sec, 1),
+        **base,
+    }
+    for s in range(res.placed.shape[0]):
+        yield {
+            "kind": "whatif-scenario",
+            "scenario": s,
+            "placed": int(res.placed[s]),
+            "unschedulable": int(res.unschedulable[s]),
+            "utilization_cpu": (
+                round(float(res.utilization_cpu[s]), 4) if res.utilization_cpu is not None else None
+            ),
+            **base,
+        }
+
+
+def baseline_table(rows: Iterable[dict]) -> str:
+    """Markdown table in the BASELINE.md format."""
+    out = ["| Metric | Value | Hardware | Source |", "|---|---|---|---|"]
+    for r in rows:
+        out.append(
+            f"| {r.get('metric', r.get('kind'))} | {r.get('value', r.get('placements_per_sec'))} "
+            f"| {r.get('hardware', '-')} | {r.get('source', 'this run')} |"
+        )
+    return "\n".join(out)

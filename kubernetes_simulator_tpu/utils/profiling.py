@@ -1,0 +1,45 @@
+"""Profiling hooks (SURVEY.md §5 tracing/profiling).
+
+- ``device_trace(dir)``: jax.profiler trace (TensorBoard/Perfetto) around a
+  replay.
+- ``timed(fn)``: block-until-ready wall-clock timing harness.
+- ``cost_analysis(jitted, *args)``: XLA cost analysis of a compiled step
+  (the ``--profile`` flag's payload).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Optional
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]):
+    import jax
+
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def timed(fn: Callable, *args, **kw):
+    """(result, seconds) with device completion awaited."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def cost_analysis(jitted: Callable, *args) -> dict:
+    """FLOP/byte estimates for one compiled step (flattened keys only)."""
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return {k: v for k, v in (ca or {}).items() if isinstance(v, (int, float))}

@@ -1,0 +1,132 @@
+"""Borg-like trace generator, checkpoint/resume, config/CLI, metrics
+(SURVEY.md §4.5, §5)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded, make_borg_trace
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.utils.config import SimConfig, build_case
+
+
+class TestBorg:
+    def test_encoded_fast_path_structure(self):
+        spec = BorgSpec(nodes=100, tasks=5000, seed=1)
+        ec, ep, meta = make_borg_encoded(spec)
+        assert ep.num_pods == 5000
+        assert ec.num_nodes == 100
+        assert meta["num_gangs"] > 0
+        # Gang members are contiguous (wave packing requirement).
+        gid = ep.group_id
+        for g in np.unique(gid[gid >= 0]):
+            idxs = np.nonzero(gid == g)[0]
+            assert (np.diff(idxs) == 1).all()
+            assert ep.pg_min_member[g] == idxs.size
+        # Priorities are tiered.
+        assert set(np.unique(ep.priority)) <= {0, 100, 200, 360, 450}
+        # Arrivals sorted.
+        assert (np.diff(ep.arrival) >= 0).all()
+
+    def test_encoded_trace_replays_on_jax(self):
+        spec = BorgSpec(nodes=60, tasks=2000, seed=2, max_gang=6)
+        ec, ep, meta = make_borg_encoded(spec)
+        res = JaxReplayEngine(ec, ep, FrameworkConfig(), wave_width=8).replay()
+        assert res.placed > 1500
+        assert res.placed + res.unschedulable == 2000
+
+    def test_object_model_variant_matches_shape(self):
+        class S:
+            nodes, tasks, seed, gang_fraction, max_gang = 30, 300, 3, 0.1, 4
+
+        cluster, pods = make_borg_trace(S)
+        assert len(pods) == 300
+        gangs = {p.pod_group for p in pods if p.pod_group}
+        assert gangs
+        ec, ep = encode(cluster, pods)
+        res = JaxReplayEngine(ec, ep, FrameworkConfig()).replay()
+        assert res.placed > 200
+
+
+class TestCheckpoint:
+    def test_resume_identical(self, tmp_path):
+        from kubernetes_simulator_tpu.sim.synthetic import config1
+
+        cluster, pods, plugins = config1(num_nodes=20, num_pods=300)
+        ec, ep = encode(cluster, pods)
+        cfg = FrameworkConfig(plugins=plugins)
+        full = JaxReplayEngine(ec, ep, cfg, chunk_waves=8).replay()
+
+        ck = str(tmp_path / "ck.npz")
+        eng = JaxReplayEngine(ec, ep, cfg, chunk_waves=8)
+        eng.replay(checkpoint_path=ck, checkpoint_every=2)
+        assert os.path.exists(ck)
+        # Resume from the mid-run snapshot and finish.
+        resumed = JaxReplayEngine(ec, ep, cfg, chunk_waves=8).replay(
+            checkpoint_path=ck, resume=True
+        )
+        assert (resumed.assignments == full.assignments).all()
+        assert resumed.placed == full.placed
+
+
+class TestConfigCli:
+    CFG = """
+strategy: cpu
+cluster:
+  synthetic: {nodes: 20, seed: 0}
+workload:
+  synthetic: {pods: 50, seed: 0, affinity: true}
+profile:
+  plugins:
+    - name: NodeResourcesFit
+      args: {strategy: LeastAllocated}
+    - name: TaintToleration
+  weights: {NodeResourcesFit: 1, TaintToleration: 3}
+whatIf:
+  scenarios: 4
+  seed: 1
+"""
+
+    def test_config_roundtrip(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text(self.CFG)
+        cfg = SimConfig.load(str(p))
+        assert cfg.strategy == "cpu"
+        assert cfg.cluster.nodes == 20
+        assert cfg.workload.pods == 50
+        assert cfg.framework.plugins[0]["name"] == "NodeResourcesFit"
+        assert cfg.whatif.scenarios == 4
+        cluster, pods = build_case(cfg)
+        assert len(cluster.nodes) == 20 and len(pods) == 50
+
+    def test_cli_run_and_whatif(self, tmp_path, capsys):
+        from kubernetes_simulator_tpu.cli import main
+
+        out = tmp_path / "res.jsonl"
+        p = tmp_path / "cfg.yaml"
+        p.write_text(self.CFG + f"output: {out}\n")
+        assert main(["run", str(p)]) == 0
+        assert main(["run", str(p), "--strategy", "jax"]) == 0
+        assert main(["what-if", str(p)]) == 0
+        rows = [json.loads(l) for l in out.read_text().splitlines()]
+        kinds = {r["kind"] for r in rows}
+        assert "replay-cpu" in kinds and "replay-jax" in kinds
+        assert "whatif-aggregate" in kinds and "whatif-scenario" in kinds
+        agg = [r for r in rows if r["kind"] == "whatif-aggregate"][0]
+        assert agg["total_placed"] > 0
+
+    def test_profile_mode_collects_plugin_latency(self):
+        from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
+        from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+        cluster = make_cluster(10, seed=0)
+        pods, _ = make_workload(30, seed=0, with_affinity=True)
+        ec, ep = encode(cluster, pods)
+        eng = CpuReplayEngine(ec, ep, FrameworkConfig(profile=True))
+        eng.replay()
+        assert any(k.startswith("Filter/") for k in eng.fw.plugin_time)
+        assert any(k.startswith("Score/") for k in eng.fw.plugin_time)
