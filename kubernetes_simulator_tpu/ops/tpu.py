@@ -69,14 +69,43 @@ class DevCluster(NamedTuple):
         )
 
 
+def num_bit_words(num_groups: int) -> int:
+    return max((max(num_groups, 1) + 31) // 32, 1)
+
+
+def pack_group_bits(mat: np.ndarray) -> np.ndarray:
+    """[..., G] bool → [..., W32] uint32 little-endian bit words."""
+    G = mat.shape[-1]
+    W = num_bit_words(G)
+    out = np.zeros(mat.shape[:-1] + (W,), dtype=np.uint32)
+    for g in range(G):
+        out[..., g // 32] |= mat[..., g].astype(np.uint32) << np.uint32(g % 32)
+    return out
+
+
+def anti_bits_from_counts(anti_active: np.ndarray, gdom: np.ndarray) -> np.ndarray:
+    """Host build of the [N, W32] symmetric-anti bit tensor: bit g of node n
+    is set iff a placed pod with required anti-affinity term g sits in n's
+    domain under g's topology key."""
+    G, N = gdom.shape
+    at_nodes = np.where(
+        gdom >= 0, np.take_along_axis(anti_active, np.clip(gdom, 0, None), axis=1), 0.0
+    )  # [G, N]
+    return pack_group_bits((at_nodes > 0).T)  # [N, W32]
+
+
 class DevState(NamedTuple):
     """Mutable scheduling state carried through lax.scan (device twin of
-    models.state.SchedState)."""
+    models.state.SchedState). ``anti_bits`` is a packed accelerator for the
+    symmetric anti-affinity check: bit g of node n ⇔
+    anti_active[g, dom(g, n)] > 0 — it turns a per-slot [G, N] sweep into a
+    [N, G/32] AND."""
 
     used: jax.Array  # [N, R] f32
     match_count: jax.Array  # [G, D] f32
     anti_active: jax.Array  # [G, D] f32
     pref_wsum: jax.Array  # [G, D] f32
+    anti_bits: jax.Array  # [N, W32] uint32
 
     @classmethod
     def init(cls, ec: EncodedCluster) -> "DevState":
@@ -87,6 +116,7 @@ class DevState(NamedTuple):
             match_count=jnp.zeros((G, D), jnp.float32),
             anti_active=jnp.zeros((G, D), jnp.float32),
             pref_wsum=jnp.zeros((G, D), jnp.float32),
+            anti_bits=jnp.zeros((ec.num_nodes, num_bit_words(G)), jnp.uint32),
         )
 
 
@@ -111,6 +141,7 @@ class PodSlot(NamedTuple):
     spread_skew: jax.Array  # [SP] i32
     spread_dns: jax.Array  # [SP] bool
     pmg: jax.Array  # [G] bool
+    pmg_bits: jax.Array  # [W32] uint32 (packed pmg)
     group: jax.Array  # i32 scalar (wave-local gang handling)
 
 
@@ -138,6 +169,7 @@ def gather_slots(ep: EncodedPods, idx: np.ndarray) -> PodSlot:
         spread_skew=take(ep.spread_skew),
         spread_dns=take(ep.spread_dns),
         pmg=take(ep.pod_matches_group),
+        pmg_bits=jnp.asarray(pack_group_bits(ep.pod_matches_group[safe])),
         group=jnp.asarray(np.where(idx >= 0, ep.group_id[safe], PAD).astype(np.int32)),
     )
 
@@ -252,77 +284,89 @@ def node_affinity_score(d: Derived, s: PodSlot) -> jax.Array:
     return jnp.sum(per_term * s.na_pref_w[None, :], axis=1).astype(jnp.float32)
 
 
-def _counts_at_nodes(counts: jax.Array, gdom: jax.Array) -> jax.Array:
-    """[G, N] gather of counts[g, dom(g, n)]; 0 where node lacks the key."""
-    safe = jnp.clip(gdom, 0, None)
-    vals = jnp.take_along_axis(counts, safe, axis=1)
-    return jnp.where(gdom >= 0, vals, 0.0)
+def _term_counts(counts: jax.Array, d: Derived, gs: jax.Array) -> jax.Array:
+    """[N] — counts[gs, dom(gs, n)] for ONE term group (a [D] row gather
+    then a [N] map through the node→domain table; no [G, N] sweep)."""
+    row = jnp.take(counts, gs, axis=0)  # [D]
+    gdom_g = jnp.take(d.gdom, gs, axis=0)  # [N]
+    vals = jnp.take(row, jnp.clip(gdom_g, 0, None))
+    return jnp.where(gdom_g >= 0, vals, 0.0)
 
 
 def interpod_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    cnt = _counts_at_nodes(st.match_count, d.gdom)  # [G, N]
-    total = jnp.sum(st.match_count, axis=1)  # [G]
+    """Per-term [N] row ops; the symmetric existing-pods'-anti-affinity
+    check is one packed-bit AND over [N, G/32] (see DevState.anti_bits)."""
     N = d.gdom.shape[1]
     ok = jnp.ones(N, dtype=bool)
-    AR = s.aff_req.shape[0]
-    for a in range(AR):  # small static unroll
+    for a in range(s.aff_req.shape[0]):  # small static unroll
         g = s.aff_req[a]
         gs = jnp.clip(g, 0, None)
-        boot = (total[gs] == 0) & s.pmg[gs]
-        term_ok = (cnt[gs] >= 1) & (d.gdom[gs] >= 0)
+        cnt_n = _term_counts(st.match_count, d, gs)
+        total = jnp.sum(jnp.take(st.match_count, gs, axis=0))
+        boot = (total == 0) & s.pmg[gs]
+        gdom_g = jnp.take(d.gdom, gs, axis=0)
+        term_ok = (cnt_n >= 1) & (gdom_g >= 0)
         ok = ok & jnp.where(g >= 0, term_ok | boot, True)
     for a in range(s.anti_req.shape[0]):
         g = s.anti_req[a]
         gs = jnp.clip(g, 0, None)
-        viol = (cnt[gs] >= 1) & (d.gdom[gs] >= 0)
+        cnt_n = _term_counts(st.match_count, d, gs)
+        gdom_g = jnp.take(d.gdom, gs, axis=0)
+        viol = (cnt_n >= 1) & (gdom_g >= 0)
         ok = ok & jnp.where(g >= 0, ~viol, True)
-    anti_here = _counts_at_nodes(st.anti_active, d.gdom)  # [G, N]
-    blocked = jnp.any((anti_here > 0) & s.pmg[:, None], axis=0)
+    blocked = jnp.zeros(N, dtype=bool)
+    for w in range(st.anti_bits.shape[1]):
+        blocked = blocked | ((st.anti_bits[:, w] & s.pmg_bits[w]) != 0)
     return ok & ~blocked
 
 
-def interpod_score(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    cnt = _counts_at_nodes(st.match_count, d.gdom)  # [G, N]
+def interpod_score(d: Derived, st: DevState, s: PodSlot, has_symmetric_pref: bool = True) -> jax.Array:
     N = d.gdom.shape[1]
     raw = jnp.zeros(N, dtype=jnp.float32)
     for a in range(s.pref_aff.shape[0]):
         g = s.pref_aff[a]
         gs = jnp.clip(g, 0, None)
-        raw = raw + jnp.where(g >= 0, s.pref_aff_w[a] * cnt[gs], 0.0)
-    wsum = _counts_at_nodes(st.pref_wsum, d.gdom)
-    raw = raw + jnp.sum(wsum * s.pmg[:, None], axis=0)
+        cnt_n = _term_counts(st.match_count, d, gs)
+        raw = raw + jnp.where(g >= 0, s.pref_aff_w[a] * cnt_n, 0.0)
+    if has_symmetric_pref:
+        # Needs every group's weight sum — the one remaining [G, N] sweep;
+        # statically skipped when the trace has no preferred terms.
+        safe = jnp.clip(d.gdom, 0, None)
+        wsum = jnp.where(d.gdom >= 0, jnp.take_along_axis(st.pref_wsum, safe, axis=1), 0.0)
+        raw = raw + jnp.sum(wsum * s.pmg[:, None], axis=0)
     return raw
 
 
 def spread_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    cnt = _counts_at_nodes(st.match_count, d.gdom)  # [G, N]
-    masked = jnp.where(d.dom_valid, st.match_count, jnp.inf)
-    min_cnt = jnp.min(masked, axis=1)  # [G] (inf when group has no domains)
     N = d.gdom.shape[1]
     ok = jnp.ones(N, dtype=bool)
     for a in range(s.spread_g.shape[0]):
         g = s.spread_g[a]
         gs = jnp.clip(g, 0, None)
+        row = jnp.take(st.match_count, gs, axis=0)  # [D]
+        valid_row = jnp.take(d.dom_valid, gs, axis=0)  # [D]
+        min_cnt = jnp.min(jnp.where(valid_row, row, jnp.inf))
+        cnt_n = _term_counts(st.match_count, d, gs)
+        gdom_g = jnp.take(d.gdom, gs, axis=0)
         self_match = s.pmg[gs].astype(jnp.float32)
-        new = cnt[gs] + self_match
-        has_domains = jnp.isfinite(min_cnt[gs])
+        has_domains = jnp.isfinite(min_cnt)
         c_ok = (
-            (d.gdom[gs] >= 0)
+            (gdom_g >= 0)
             & has_domains
-            & (new - jnp.where(has_domains, min_cnt[gs], 0.0) <= s.spread_skew[a])
+            & (cnt_n + self_match - jnp.where(has_domains, min_cnt, 0.0) <= s.spread_skew[a])
         )
         ok = ok & jnp.where((g >= 0) & s.spread_dns[a], c_ok, True)
     return ok
 
 
 def spread_score(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    cnt = _counts_at_nodes(st.match_count, d.gdom)
     N = d.gdom.shape[1]
     raw = jnp.zeros(N, dtype=jnp.float32)
     for a in range(s.spread_g.shape[0]):
         g = s.spread_g[a]
         gs = jnp.clip(g, 0, None)
-        raw = raw + jnp.where(g >= 0, cnt[gs] + s.pmg[gs].astype(jnp.float32), 0.0)
+        cnt_n = _term_counts(st.match_count, d, gs)
+        raw = raw + jnp.where(g >= 0, cnt_n + s.pmg[gs].astype(jnp.float32), 0.0)
     return raw
 
 
@@ -455,15 +499,31 @@ def apply_binding(
         w * (s.pmg & dval).astype(jnp.float32)
     )
     anti = st.anti_active
+    bits = st.anti_bits
     for a in range(s.anti_req.shape[0]):
         g = s.anti_req[a]
         gs = jnp.clip(g, 0, None)
         ok = (g >= 0) & dval[gs]
         anti = anti.at[gs, doms[gs]].add(w * ok.astype(jnp.float32))
+        # Refresh bit plane g of anti_bits from the updated count row: bit
+        # set ⇔ count > 0 in the node's domain. Only term groups of the
+        # bound pod can change, so this is a few [N] ops per bind.
+        row = jnp.take(anti, gs, axis=0)  # [D]
+        gdom_g = jnp.take(d.gdom, gs, axis=0)  # [N]
+        on_nodes = (jnp.take(row, jnp.clip(gdom_g, 0, None)) > 0) & (gdom_g >= 0)
+        bit = jnp.left_shift(jnp.uint32(1), (gs % 32).astype(jnp.uint32))
+        apply_g = ok & (on & s.valid)
+        for wd in range(bits.shape[1]):
+            in_word = apply_g & (gs // 32 == wd)
+            old = bits[:, wd]
+            new = jnp.where(on_nodes, old | bit, old & ~bit)
+            bits = bits.at[:, wd].set(jnp.where(in_word, new, old))
     pref = st.pref_wsum
     for a in range(s.pref_aff.shape[0]):
         g = s.pref_aff[a]
         gs = jnp.clip(g, 0, None)
         ok = (g >= 0) & dval[gs]
         pref = pref.at[gs, doms[gs]].add(w * s.pref_aff_w[a] * ok.astype(jnp.float32))
-    return DevState(used=used, match_count=match_count, anti_active=anti, pref_wsum=pref)
+    return DevState(
+        used=used, match_count=match_count, anti_active=anti, pref_wsum=pref, anti_bits=bits
+    )

@@ -22,6 +22,7 @@ class ReplayCheckpoint:
     match_count: np.ndarray
     anti_active: np.ndarray
     pref_wsum: np.ndarray
+    anti_bits: np.ndarray
     outs: List[np.ndarray]  # per-chunk collected outputs so far
 
     def save(self, path: str) -> None:
@@ -33,6 +34,7 @@ class ReplayCheckpoint:
             match_count=self.match_count,
             anti_active=self.anti_active,
             pref_wsum=self.pref_wsum,
+            anti_bits=self.anti_bits,
             num_outs=np.int64(len(self.outs)),
             **{f"out_{i}": o for i, o in enumerate(self.outs)},
         )
@@ -48,6 +50,7 @@ class ReplayCheckpoint:
                 match_count=z["match_count"],
                 anti_active=z["anti_active"],
                 pref_wsum=z["pref_wsum"],
+                anti_bits=z["anti_bits"],
                 outs=[z[f"out_{i}"] for i in range(n)],
             )
 
@@ -59,6 +62,7 @@ def state_to_checkpoint(state, cursor: int, outs: List[np.ndarray]) -> ReplayChe
         match_count=np.asarray(state.match_count),
         anti_active=np.asarray(state.anti_active),
         pref_wsum=np.asarray(state.pref_wsum),
+        anti_bits=np.asarray(state.anti_bits),
         outs=[np.asarray(o) for o in outs],
     )
 
@@ -73,4 +77,5 @@ def checkpoint_to_state(ckpt: ReplayCheckpoint):
         match_count=jnp.asarray(ckpt.match_count),
         anti_active=jnp.asarray(ckpt.anti_active),
         pref_wsum=jnp.asarray(ckpt.pref_wsum),
+        anti_bits=jnp.asarray(ckpt.anti_bits),
     )

@@ -57,9 +57,17 @@ class StepSpec:
     resource_weights: Tuple[float, ...] = ()  # [R]
     shape_x: Tuple[float, ...] = (0.0, 100.0)
     shape_y: Tuple[float, ...] = (0.0, 100.0)
+    # Static trace property: whether any pod carries preferred (anti-)
+    # affinity terms — gates the only remaining [G, N] sweep in scoring.
+    has_symmetric_pref: bool = True
 
     @classmethod
-    def from_config(cls, ec: EncodedCluster, config: Optional[FrameworkConfig]) -> "StepSpec":
+    def from_config(
+        cls,
+        ec: EncodedCluster,
+        config: Optional[FrameworkConfig],
+        pods: Optional[EncodedPods] = None,
+    ) -> "StepSpec":
         entries = (config.plugins if config and config.plugins is not None else None)
         if entries is None:
             entries = [{"name": n} for n in DEFAULT_PLUGINS]
@@ -92,6 +100,9 @@ class StepSpec:
             resource_weights=tuple(float(x) for x in rw),
             shape_x=tuple(float(pt["utilization"]) for pt in shape),
             shape_y=tuple(float(pt["score"]) * 10.0 for pt in shape),
+            has_symmetric_pref=(
+                bool((pods.pref_aff >= 0).any()) if pods is not None else True
+            ),
         )
 
 
@@ -131,7 +142,7 @@ def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec:
         raw = T.node_affinity_score(d, s)
         total = total + w.get("NodeAffinity", 1.0) * T.normalize_max(raw, feasible)
     if spec.interpod and w.get("InterPodAffinity", 1.0) != 0:
-        raw = T.interpod_score(d, st, s)
+        raw = T.interpod_score(d, st, s, spec.has_symmetric_pref)
         total = total + w.get("InterPodAffinity", 1.0) * T.normalize_min_max(raw, feasible)
     if spec.spread and w.get("PodTopologySpread", 1.0) != 0:
         raw = T.spread_score(d, st, s)
@@ -198,7 +209,7 @@ class JaxReplayEngine:
     ):
         self.ec = ec
         self.pods = pods
-        self.spec = StepSpec.from_config(ec, config)
+        self.spec = StepSpec.from_config(ec, config, pods)
         self.wave_width = wave_width
         self.chunk_waves = chunk_waves
         self.dc = T.DevCluster.from_encoded(ec)
@@ -207,22 +218,53 @@ class JaxReplayEngine:
         self.chunk_fn = make_chunk_fn(self.D, wave_width, self.spec)
 
     def _init_dev_state(self) -> T.DevState:
+        from ..ops.cpu import _group_dom_per_node
+
         host = init_state(self.ec, self.pods)  # applies pre-bound pods
+        gdom = _group_dom_per_node(self.ec)
         return T.DevState(
             used=jnp.asarray(host.used),
             match_count=jnp.asarray(host.match_count),
             anti_active=jnp.asarray(host.anti_active),
             pref_wsum=jnp.asarray(host.pref_wsum),
+            anti_bits=jnp.asarray(T.anti_bits_from_counts(host.anti_active, gdom)),
         )
+
+    def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
+        """Arrival time of each wave's first valid pod (for timed events)."""
+        first = idx[:, 0]
+        safe = np.clip(first, 0, None)
+        t = self.pods.arrival[safe]
+        return np.where(first >= 0, t, np.inf)
+
+    def _apply_node_events(self, events, saved_alloc: np.ndarray) -> None:
+        """Mutate the device cluster's allocatable rows (failure injection;
+        device-path semantics: capacity changes affect FUTURE placements —
+        no eviction of already-placed pods, unlike the CPU event engine)."""
+        alloc = np.asarray(self.dc.allocatable).copy()
+        for ev in events:
+            if ev.kind == "node_down":
+                alloc[ev.node] = 0.0
+            elif ev.kind == "node_up":
+                alloc[ev.node] = saved_alloc[ev.node]
+            elif ev.kind == "capacity_scale":
+                alloc[ev.node] = saved_alloc[ev.node] * ev.scale
+        self.dc = self.dc._replace(allocatable=jnp.asarray(alloc))
 
     def replay(
         self,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 0,
         resume: bool = False,
+        node_events=None,
     ) -> ReplayResult:
         """Run the replay; optionally snapshot the carry every K chunks to
-        ``checkpoint_path`` and/or resume from it (SURVEY.md §5)."""
+        ``checkpoint_path`` and/or resume from it (SURVEY.md §5).
+
+        ``node_events`` (list of sim.runtime.NodeEvent) are applied at chunk
+        boundaries: an event fires before the first chunk whose start wave's
+        arrival time is past the event time (granularity = chunk_waves; use
+        smaller chunks for finer timing)."""
         from .checkpoint import ReplayCheckpoint, checkpoint_to_state, state_to_checkpoint
 
         idx = self.waves.idx
@@ -240,10 +282,19 @@ class JaxReplayEngine:
             state = checkpoint_to_state(ck)
             all_choices = [jnp.asarray(o) for o in ck.outs]
             start_chunk = ck.chunk_cursor
+        pending_events = sorted(node_events or [], key=lambda e: e.time)
+        wave_times = self._wave_start_times(idx) if pending_events else None
+        saved_alloc = np.asarray(self.dc.allocatable).copy()
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_chunk:
                 continue
+            if pending_events:
+                chunk_t = wave_times[c0]
+                due = [e for e in pending_events if e.time <= chunk_t]
+                if due:
+                    self._apply_node_events(due, saved_alloc)
+                    pending_events = pending_events[len(due):]
             slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
             state, choices = self.chunk_fn(self.dc, state, slots)
             all_choices.append(choices)
@@ -251,6 +302,8 @@ class JaxReplayEngine:
                 state_to_checkpoint(state, ci + 1, all_choices).save(checkpoint_path)
         choices = jax.block_until_ready(jnp.concatenate(all_choices, axis=0))
         wall = time.perf_counter() - t0
+        if node_events:
+            self.dc = self.dc._replace(allocatable=jnp.asarray(saved_alloc))
 
         choices_np = np.asarray(choices)
         assignments = np.where(self.pods.bound_node >= 0, self.pods.bound_node, PAD).astype(

@@ -190,14 +190,20 @@ class WhatIfEngine:
         chunk_waves: int = 1024,
         mesh=None,
         collect_assignments: bool = False,
+        fork_checkpoint: Optional[str] = None,
     ):
+        """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
+        what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
+        starts from that replay's mid-trace state and continues with its own
+        perturbed cluster over the remaining waves."""
         self.ec = ec
         self.pods = pods
-        self.spec = StepSpec.from_config(ec, config)
+        self.spec = StepSpec.from_config(ec, config, pods)
         self.wave_width = wave_width
         self.chunk_waves = chunk_waves
         self.mesh = mesh
         self.collect_assignments = collect_assignments
+        self.fork_checkpoint = fork_checkpoint
         self.sset = ScenarioSet(ec, scenarios)
         self.S = self.sset.num_scenarios
         if mesh is not None:
@@ -242,7 +248,22 @@ class WhatIfEngine:
         )
 
     def _init_states(self) -> T.DevState:
-        host = init_state(self.ec, self.pods)  # pre-bound pods
+        self._fork_waves_done = 0
+        self._fork_choices = None
+        if self.fork_checkpoint:
+            from .checkpoint import ReplayCheckpoint
+
+            ck = ReplayCheckpoint.load(self.fork_checkpoint)
+            host = init_state(self.ec, self.pods, apply_prebound=False)
+            host.used = ck.used
+            host.match_count = ck.match_count
+            host.anti_active = ck.anti_active
+            host.pref_wsum = ck.pref_wsum
+            if ck.outs:
+                self._fork_choices = np.concatenate(ck.outs, axis=0)  # [waves, W]
+                self._fork_waves_done = self._fork_choices.shape[0]
+        else:
+            host = init_state(self.ec, self.pods)  # pre-bound pods
         G, D = host.match_count.shape[0], self.D
         # Domain dim may have grown (label perturbations) → pad.
         mc = np.zeros((G, D), np.float32)
@@ -251,18 +272,38 @@ class WhatIfEngine:
         aa[:, : host.anti_active.shape[1]] = host.anti_active
         pw = np.zeros((G, D), np.float32)
         pw[:, : host.pref_wsum.shape[1]] = host.pref_wsum
+        # anti_bits depend on each scenario's node→domain table.
+        nd = np.asarray(self.sset.dc.node_domain)  # [S, T, N]
+        gt = np.clip(self.ec.group_topo, 0, None)
+        bits = np.stack(
+            [
+                T.anti_bits_from_counts(
+                    aa,
+                    np.where(self.ec.group_topo[:, None] >= 0, nd[s][gt], PAD),
+                )
+                for s in range(self.S)
+            ]
+        )
         rep = lambda a: jnp.asarray(np.repeat(a[None], self.S, axis=0))
         return T.DevState(
-            used=rep(host.used), match_count=rep(mc), anti_active=rep(aa), pref_wsum=rep(pw)
+            used=rep(host.used),
+            match_count=rep(mc),
+            anti_active=rep(aa),
+            pref_wsum=rep(pw),
+            anti_bits=jnp.asarray(bits),
         )
 
     def run(self) -> WhatIfResult:
+        states = self._init_states()  # sets fork bookkeeping first
         idx = self.waves.idx
+        if self._fork_waves_done:
+            idx = idx[self._fork_waves_done :]
+            if idx.shape[0] == 0:
+                idx = np.full((1, self.waves.wave_width), PAD, np.int32)
         C = min(self.chunk_waves, max(idx.shape[0], 1))
         pad_to = ((idx.shape[0] + C - 1) // C) * C
         if pad_to != idx.shape[0]:
             idx = np.concatenate([idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)])
-        states = self._init_states()
         dc = self.sset.dc
         if self.mesh is not None:
             dc = shard_scenario_tree(self.mesh, dc)
@@ -289,6 +330,12 @@ class WhatIfEngine:
             ]
             flat_choice = choices.reshape(self.S, -1)
             assignments[:, flat_idx[valid]] = flat_choice[:, valid]
+            if self._fork_choices is not None:
+                # Pre-fork placements are common to every scenario.
+                pidx = self.waves.idx[: self._fork_waves_done].reshape(-1)
+                pch = self._fork_choices.reshape(-1)
+                pv = pidx >= 0
+                assignments[:, pidx[pv]] = pch[pv][None, :]
             placed = (flat_choice[:, valid] >= 0).sum(axis=1).astype(np.int32)
         else:
             assignments = None

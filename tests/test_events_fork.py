@@ -1,0 +1,58 @@
+"""Device-path timed failure injection + what-if fork-from-checkpoint
+(SURVEY.md §5)."""
+
+import numpy as np
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+from kubernetes_simulator_tpu.sim.synthetic import config1, make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.whatif import Perturbation, Scenario, WhatIfEngine
+
+
+def test_jax_timed_node_down_diverts_placements():
+    cluster, pods, plugins = config1(num_nodes=4, num_pods=200)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=plugins)
+    base = JaxReplayEngine(ec, ep, cfg, chunk_waves=4).replay()
+    # Down node 0 halfway through the arrival stream.
+    mid_t = float(np.median(ep.arrival))
+    eng = JaxReplayEngine(ec, ep, cfg, chunk_waves=4)
+    res = eng.replay(node_events=[NodeEvent(time=mid_t, kind="node_down", node=0)])
+    # Events land at chunk boundaries: find the first chunk whose start wave
+    # arrives at/after the event; from there no pod may use node 0.
+    wave_t = eng._wave_start_times(eng.waves.idx)
+    starts = np.arange(0, eng.waves.idx.shape[0], 4)
+    boundary = next(c for c in starts if wave_t[c] >= mid_t)
+    late_pods = eng.waves.idx[boundary:].reshape(-1)
+    late_pods = late_pods[late_pods >= 0]
+    assert not (res.assignments[late_pods] == 0).any()
+    assert (base.assignments == 0).any()
+    # Engine restores capacity for subsequent replays.
+    again = eng.replay()
+    assert (again.assignments == base.assignments).all()
+
+
+def test_whatif_fork_from_checkpoint(tmp_path):
+    cluster = make_cluster(10, seed=4)
+    pods, _ = make_workload(160, seed=4, with_affinity=True, with_spread=True)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    ck = str(tmp_path / "fork.npz")
+    # Replay the first half and snapshot (2 chunks of 5 waves = 80 pods).
+    eng = JaxReplayEngine(ec, ep, cfg, chunk_waves=5)
+    full = eng.replay(checkpoint_path=ck, checkpoint_every=2)
+
+    # Fork: base scenario continues unperturbed → must equal the full replay.
+    scen = [Scenario(), Scenario([Perturbation("node_down", nodes=np.arange(5))])]
+    wi = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=5, collect_assignments=True, fork_checkpoint=ck
+    )
+    res = wi.run()
+    assert (res.assignments[0] == full.assignments).all()
+    # The perturbed branch diverges after the fork point but shares the prefix.
+    prefix_pods = wi.waves.idx[: wi._fork_waves_done].reshape(-1)
+    prefix_pods = prefix_pods[prefix_pods >= 0]
+    assert (res.assignments[1][prefix_pods] == full.assignments[prefix_pods]).all()
+    assert res.placed[1] <= res.placed[0]
