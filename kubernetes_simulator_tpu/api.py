@@ -1,0 +1,80 @@
+"""High-level facade — the one-stop API a reference user reaches for.
+
+    sim = Simulator(cluster, pods, strategy="jax")
+    result = sim.run()
+    whatif = sim.what_if(scenarios=256, mesh=True)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .framework.framework import FrameworkConfig
+from .framework.registry import available_strategies, get_strategy
+from .models.core import Cluster, Pod
+from .models.encode import encode
+
+
+class Simulator:
+    def __init__(
+        self,
+        cluster: Cluster,
+        pods: Sequence[Pod],
+        strategy: str = "cpu",
+        plugins: Optional[List[dict]] = None,
+        weights: Optional[dict] = None,
+        enable_preemption: bool = True,
+        **engine_kw,
+    ):
+        self.cluster = cluster
+        self.pods = list(pods)
+        self.strategy = strategy
+        self.config = FrameworkConfig(
+            plugins=plugins, weights=weights, enable_preemption=enable_preemption
+        )
+        self.engine_kw = engine_kw
+        self.ec, self.ep = encode(cluster, self.pods)
+
+    def run(self, **replay_kw):
+        engine = get_strategy(self.strategy)(self.ec, self.ep, self.config, **self.engine_kw)
+        return engine.replay(**replay_kw)
+
+    def what_if(
+        self,
+        scenarios=None,
+        num_scenarios: int = 0,
+        seed: int = 0,
+        mesh: bool = False,
+        collect_assignments: bool = False,
+        fork_checkpoint: Optional[str] = None,
+        **kw,
+    ):
+        """Batched what-if over cluster-state perturbations. Pass explicit
+        ``scenarios`` (list of sim.whatif.Scenario) or ``num_scenarios``
+        for the uniform random sampler."""
+        from .parallel.mesh import make_mesh
+        from .sim.whatif import WhatIfEngine, uniform_scenarios
+
+        if scenarios is None:
+            scenarios = uniform_scenarios(self.ec, num_scenarios, seed=seed)
+        eng = WhatIfEngine(
+            self.ec,
+            self.ep,
+            scenarios,
+            self.config,
+            mesh=make_mesh() if mesh else None,
+            collect_assignments=collect_assignments,
+            fork_checkpoint=fork_checkpoint,
+            **kw,
+        )
+        return eng.run()
+
+    @staticmethod
+    def strategies() -> List[str]:
+        # Force-register the builtins, then report.
+        for name in ("cpu", "jax"):
+            try:
+                get_strategy(name)
+            except Exception:
+                pass
+        return available_strategies()

@@ -1,0 +1,154 @@
+"""Unit tests: scheduling queue semantics, normalize functions, scoring
+strategies across backends, facade API, scale smoke (SURVEY.md §4.1, §4.5)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.queue import SchedulingQueue
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.ops import cpu as K
+
+
+class TestQueue:
+    def test_priority_then_fifo(self):
+        q = SchedulingQueue()
+        q.push(1, priority=0)
+        q.push(2, priority=100)
+        q.push(3, priority=100)
+        assert q.pop() == 2  # higher priority first
+        assert q.pop() == 3  # FIFO within priority
+        assert q.pop() == 1
+        assert q.pop() is None
+
+    def test_backoff_is_exponential_and_capped(self):
+        q = SchedulingQueue()
+        for attempt, want_delay in [(0, 1.0), (1, 2.0), (2, 4.0), (3, 8.0), (4, 10.0), (5, 10.0)]:
+            q.requeue_backoff(7, priority=0, now=100.0)
+            assert q.next_backoff_time() == pytest.approx(100.0 + want_delay)
+            q.flush_backoff(200.0)
+            assert q.pop() == 7
+
+    def test_unschedulable_flush(self):
+        q = SchedulingQueue()
+        q.mark_unschedulable(5, priority=10)
+        assert len(q) == 0 and q.num_unschedulable == 1
+        q.flush_unschedulable()
+        assert q.pop() == 5 and q.num_unschedulable == 0
+
+    def test_backoff_not_released_early(self):
+        q = SchedulingQueue()
+        q.requeue_backoff(1, priority=0, now=0.0)
+        q.flush_backoff(0.5)
+        assert q.pop() is None
+        q.flush_backoff(1.5)
+        assert q.pop() == 1
+
+
+class TestNormalize:
+    def test_normalize_max_basic(self):
+        raw = np.array([0.0, 5.0, 10.0], dtype=np.float32)
+        feas = np.array([True, True, True])
+        out = K.normalize_max(raw, feas)
+        assert list(out) == [0.0, 50.0, 100.0]
+        rev = K.normalize_max(raw, feas, reverse=True)
+        assert list(rev) == [100.0, 50.0, 0.0]
+
+    def test_normalize_max_all_zero(self):
+        raw = np.zeros(3, dtype=np.float32)
+        feas = np.ones(3, dtype=bool)
+        assert (K.normalize_max(raw, feas) == 0).all()
+        assert (K.normalize_max(raw, feas, reverse=True) == 100).all()
+
+    def test_normalize_max_ignores_infeasible_for_max(self):
+        raw = np.array([1000.0, 5.0, 10.0], dtype=np.float32)
+        feas = np.array([False, True, True])
+        out = K.normalize_max(raw, feas)
+        assert out[2] == 100.0
+
+    def test_normalize_min_max_negative(self):
+        raw = np.array([-10.0, 0.0, 10.0], dtype=np.float32)
+        feas = np.ones(3, dtype=bool)
+        out = K.normalize_min_max(raw, feas)
+        assert list(out) == [0.0, 50.0, 100.0]
+
+    def test_normalize_min_max_constant(self):
+        raw = np.full(3, 7.0, dtype=np.float32)
+        assert (K.normalize_min_max(raw, np.ones(3, bool)) == 0).all()
+
+
+class TestScoringStrategies:
+    """MostAllocated and RequestedToCapacityRatio parity across all three
+    implementations (oracle formulas inline here)."""
+
+    def _case(self):
+        from kubernetes_simulator_tpu.sim.synthetic import config1
+
+        cluster, pods, _ = config1(num_nodes=20, num_pods=150)
+        return cluster, pods
+
+    @pytest.mark.parametrize(
+        "plugins",
+        [
+            [{"name": "NodeResourcesFit", "args": {"strategy": "MostAllocated"}}],
+            [
+                {
+                    "name": "NodeResourcesFit",
+                    "args": {
+                        "strategy": "RequestedToCapacityRatio",
+                        "shape": [
+                            {"utilization": 0, "score": 0},
+                            {"utilization": 50, "score": 9},
+                            {"utilization": 100, "score": 3},
+                        ],
+                    },
+                }
+            ],
+        ],
+    )
+    def test_cpu_jax_parity(self, plugins):
+        from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+        from kubernetes_simulator_tpu.sim.greedy import greedy_replay
+        from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+
+        cluster, pods = self._case()
+        ec, ep = encode(cluster, pods)
+        cpu = greedy_replay(ec, ep, FrameworkConfig(plugins=plugins))
+        jx = JaxReplayEngine(ec, ep, FrameworkConfig(plugins=plugins)).replay()
+        assert (cpu.assignments == jx.assignments).all()
+
+
+class TestFacade:
+    def test_simulator_api(self):
+        from kubernetes_simulator_tpu.api import Simulator
+        from kubernetes_simulator_tpu.sim.synthetic import config1
+
+        cluster, pods, plugins = config1(num_nodes=15, num_pods=60)
+        sim = Simulator(cluster, pods, strategy="jax", plugins=plugins)
+        res = sim.run()
+        assert res.placed == 60
+        wi = sim.what_if(num_scenarios=4, seed=1)
+        assert wi.placed.shape == (4,)
+        assert "cpu" in Simulator.strategies() and "jax" in Simulator.strategies()
+
+
+def test_scale_smoke_5k_nodes():
+    """SURVEY.md §4.5: a 5k-node replay completes under a wall budget even
+    on the CPU XLA backend (pods kept small to bound CI time)."""
+    import time
+
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.encode import encode as enc
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+    cluster = make_cluster(5000, seed=0, taint_fraction=0.1)
+    pods, _ = make_workload(3000, seed=0, with_affinity=True, with_spread=True,
+                            with_tolerations=True)
+    ec, ep = enc(cluster, pods)
+    t0 = time.perf_counter()
+    res = JaxReplayEngine(ec, ep, FrameworkConfig(), chunk_waves=256).replay()
+    wall = time.perf_counter() - t0
+    # Greedy has no retry loop, so a few DoNotSchedule spread pods may stay
+    # unschedulable at arrival time.
+    assert res.placed >= 2980
+    assert wall < 120.0, f"5k-node smoke too slow: {wall:.1f}s"
