@@ -1,18 +1,27 @@
 """Scheduling kernels — JAX device edition (SURVEY.md §3.5).
 
 Same math as :mod:`.cpu`, re-expressed for XLA: everything is static-shape
-jnp over ``[N]``/``[G, D]`` tensors, composable under ``jit``/``vmap``/
+jnp over ``[N]``/``[G, N]`` tensors, composable under ``jit``/``vmap``/
 ``lax.scan``. One pending pod (a "slot" row pytree) is evaluated against
 all nodes at once; the mutable scheduling state is a small pytree updated
-by scatter-adds so the whole replay runs as one compiled scan on device.
+by masked elementwise adds so the whole replay runs as one compiled scan
+on device.
 
 Design notes (TPU-first):
-- masks stay bool, scores f32; the [N]-wide ops map onto VPU lanes and the
-  [N, R] contractions onto the MXU-friendly layouts XLA picks.
+- **No gathers or scatters anywhere in the hot loop.** Batched
+  gather/scatter with per-scenario dynamic indices lowers to a serialized
+  per-batch loop on TPU (~135 µs per op measured on v5e — 100× the cost of
+  the math). Every dynamic-index access is instead expressed as a one-hot
+  contraction (MXU matvec) or a masked elementwise update (VPU), which are
+  effectively free at these shapes.
+- Count-group state lives in **node space** ``[G, N]`` (the value each node
+  *sees*: ``count[g, domain_of(g, n)]``), not domain space ``[G, D]``.
+  Reads become row contractions; a bind updates every node in the bound
+  node's domain via an equality mask — one fused elementwise op.
+- masks stay bool, scores f32; per-pod term loops (tolerations, affinity
+  terms, spread constraints) are python-unrolled over SMALL static widths.
 - no data-dependent shapes: padded slots are neutralized with `where`, a
   `valid` flag multiplies every state update.
-- per-pod term loops (tolerations, affinity terms, spread constraints) are
-  python-unrolled over SMALL static widths — they trace once and fuse.
 """
 
 from __future__ import annotations
@@ -28,6 +37,8 @@ from ..models.core import Effect, Operator
 
 MAX_NODE_SCORE = 100.0
 NEG_INF = -jnp.inf
+# One-hot contractions must accumulate exactly (integer-valued f32 counts).
+_HI = jax.lax.Precision.HIGHEST
 
 
 class DevCluster(NamedTuple):
@@ -69,55 +80,50 @@ class DevCluster(NamedTuple):
         )
 
 
-def num_bit_words(num_groups: int) -> int:
-    return max((max(num_groups, 1) + 31) // 32, 1)
-
-
-def pack_group_bits(mat: np.ndarray) -> np.ndarray:
-    """[..., G] bool → [..., W32] uint32 little-endian bit words."""
-    G = mat.shape[-1]
-    W = num_bit_words(G)
-    out = np.zeros(mat.shape[:-1] + (W,), dtype=np.uint32)
-    for g in range(G):
-        out[..., g // 32] |= mat[..., g].astype(np.uint32) << np.uint32(g % 32)
-    return out
-
-
-def anti_bits_from_counts(anti_active: np.ndarray, gdom: np.ndarray) -> np.ndarray:
-    """Host build of the [N, W32] symmetric-anti bit tensor: bit g of node n
-    is set iff a placed pod with required anti-affinity term g sits in n's
-    domain under g's topology key."""
-    G, N = gdom.shape
-    at_nodes = np.where(
-        gdom >= 0, np.take_along_axis(anti_active, np.clip(gdom, 0, None), axis=1), 0.0
-    )  # [G, N]
-    return pack_group_bits((at_nodes > 0).T)  # [N, W32]
-
-
 class DevState(NamedTuple):
     """Mutable scheduling state carried through lax.scan (device twin of
-    models.state.SchedState). ``anti_bits`` is a packed accelerator for the
-    symmetric anti-affinity check: bit g of node n ⇔
-    anti_active[g, dom(g, n)] > 0 — it turns a per-slot [G, N] sweep into a
-    [N, G/32] AND."""
+    models.state.SchedState, **node space**): ``match_count[g, n]`` is the
+    number of placed pods matching group g in node n's domain under g's
+    topology key (0 where the node has no domain). ``match_total[g]`` is the
+    cluster-wide count (needed for the bootstrap self-match rule — a plain
+    sum over node space would overcount domains with many nodes)."""
 
     used: jax.Array  # [N, R] f32
-    match_count: jax.Array  # [G, D] f32
-    anti_active: jax.Array  # [G, D] f32
-    pref_wsum: jax.Array  # [G, D] f32
-    anti_bits: jax.Array  # [N, W32] uint32
+    match_count: jax.Array  # [G, N] f32
+    anti_active: jax.Array  # [G, N] f32
+    pref_wsum: jax.Array  # [G, N] f32
+    match_total: jax.Array  # [G] f32
 
     @classmethod
     def init(cls, ec: EncodedCluster) -> "DevState":
         G = max(ec.num_groups, 1)
-        D = max(ec.max_domains, 1)
+        N = ec.num_nodes
         return cls(
-            used=jnp.zeros((ec.num_nodes, ec.num_resources), jnp.float32),
-            match_count=jnp.zeros((G, D), jnp.float32),
-            anti_active=jnp.zeros((G, D), jnp.float32),
-            pref_wsum=jnp.zeros((G, D), jnp.float32),
-            anti_bits=jnp.zeros((ec.num_nodes, num_bit_words(G)), jnp.uint32),
+            used=jnp.zeros((N, ec.num_resources), jnp.float32),
+            match_count=jnp.zeros((G, N), jnp.float32),
+            anti_active=jnp.zeros((G, N), jnp.float32),
+            pref_wsum=jnp.zeros((G, N), jnp.float32),
+            match_total=jnp.zeros((G,), jnp.float32),
         )
+
+
+def domain_to_node_space(arr_gd: np.ndarray, gdom: np.ndarray) -> np.ndarray:
+    """Host: [G, D] domain-space counts → [G, N] node-space (0 where the
+    node has no domain under that group's topology key)."""
+    safe = np.clip(gdom, 0, None)
+    out = np.take_along_axis(arr_gd, safe, axis=1).astype(np.float32)
+    return np.where(gdom >= 0, out, 0.0)
+
+
+def node_space_to_domain(arr_gn: np.ndarray, gdom: np.ndarray, D: int) -> np.ndarray:
+    """Host: inverse of :func:`domain_to_node_space` (every domain has ≥1
+    node by construction; values agree across a domain's nodes)."""
+    G, N = arr_gn.shape
+    out = np.zeros((G, D), np.float32)
+    valid = gdom >= 0
+    gi = np.broadcast_to(np.arange(G)[:, None], (G, N))
+    out[gi[valid], gdom[valid]] = arr_gn[valid]
+    return out
 
 
 class PodSlot(NamedTuple):
@@ -141,7 +147,6 @@ class PodSlot(NamedTuple):
     spread_skew: jax.Array  # [SP] i32
     spread_dns: jax.Array  # [SP] bool
     pmg: jax.Array  # [G] bool
-    pmg_bits: jax.Array  # [W32] uint32 (packed pmg)
     group: jax.Array  # i32 scalar (wave-local gang handling)
 
 
@@ -169,7 +174,6 @@ def gather_slots(ep: EncodedPods, idx: np.ndarray) -> PodSlot:
         spread_skew=take(ep.spread_skew),
         spread_dns=take(ep.spread_dns),
         pmg=take(ep.pod_matches_group),
-        pmg_bits=jnp.asarray(pack_group_bits(ep.pod_matches_group[safe])),
         group=jnp.asarray(np.where(idx >= 0, ep.group_id[safe], PAD).astype(np.int32)),
     )
 
@@ -204,27 +208,27 @@ def expr_match_matrix(dc: DevCluster) -> jax.Array:
 
 
 def group_dom_per_node(dc: DevCluster) -> jax.Array:
-    """[G, N] — domain of each node under each count-group's topology key."""
+    """[G, N] f32 — domain of each node under each count-group's topology
+    key (PAD = -1 where none). f32 so node one-hots can contract with it on
+    the MXU; domain ids ≤ N are exact in f32."""
     gt = jnp.clip(dc.group_topo, 0, None)
-    dom = dc.node_domain[gt]  # [G, N]
-    return jnp.where(dc.group_topo[:, None] >= 0, dom, PAD)
-
-
-def domain_valid_mask(dc: DevCluster, D: int) -> jax.Array:
-    """[G, D] — which domain slots exist for each group's topology key."""
-    gt = jnp.clip(dc.group_topo, 0, None)
-    nd = dc.num_domains[gt]  # [G]
-    return (jnp.arange(D)[None, :] < nd[:, None]) & (dc.group_topo[:, None] >= 0)
+    dom = dc.node_domain[gt]  # [G, N] (static indices — fine)
+    return jnp.where(dc.group_topo[:, None] >= 0, dom, PAD).astype(jnp.float32)
 
 
 class Derived(NamedTuple):
     M: jax.Array  # [N, E] expr match
-    gdom: jax.Array  # [G, N]
-    dom_valid: jax.Array  # [G, D]
+    gdom_f: jax.Array  # [G, N] f32 (PAD = -1)
 
     @classmethod
-    def build(cls, dc: DevCluster, D: int) -> "Derived":
-        return cls(expr_match_matrix(dc), group_dom_per_node(dc), domain_valid_mask(dc, D))
+    def build(cls, dc: DevCluster) -> "Derived":
+        return cls(expr_match_matrix(dc), group_dom_per_node(dc))
+
+
+def _term_onehot(gs: jax.Array, G: int) -> jax.Array:
+    """[..., A, G] f32 — one-hot rows for term group ids (zero row for
+    PAD). Broadcasts over any leading axes (e.g. a wave axis)."""
+    return ((gs[..., None] == jnp.arange(G)) & (gs[..., None] >= 0)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -284,90 +288,99 @@ def node_affinity_score(d: Derived, s: PodSlot) -> jax.Array:
     return jnp.sum(per_term * s.na_pref_w[None, :], axis=1).astype(jnp.float32)
 
 
-def _term_counts(counts: jax.Array, d: Derived, gs: jax.Array) -> jax.Array:
-    """[N] — counts[gs, dom(gs, n)] for ONE term group (a [D] row gather
-    then a [N] map through the node→domain table; no [G, N] sweep)."""
-    row = jnp.take(counts, gs, axis=0)  # [D]
-    gdom_g = jnp.take(d.gdom, gs, axis=0)  # [N]
-    vals = jnp.take(row, jnp.clip(gdom_g, 0, None))
-    return jnp.where(gdom_g >= 0, vals, 0.0)
+def _term_rows(st_counts: jax.Array, oh: jax.Array) -> jax.Array:
+    """[A, N] — node-space count rows for A term groups (one-hot matmul —
+    exact: each output is a single selected element)."""
+    return jnp.einsum("ag,gn->an", oh, st_counts, precision=_HI)
 
 
 def interpod_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    """Per-term [N] row ops; the symmetric existing-pods'-anti-affinity
-    check is one packed-bit AND over [N, G/32] (see DevState.anti_bits)."""
-    N = d.gdom.shape[1]
+    """Required (anti-)affinity + the SYMMETRIC existing-pods'-anti check,
+    all as one-hot contractions over node-space counts — no gathers."""
+    G = st.match_count.shape[0]
+    N = d.gdom_f.shape[1]
+    pmg_f = s.pmg.astype(jnp.float32)
     ok = jnp.ones(N, dtype=bool)
-    for a in range(s.aff_req.shape[0]):  # small static unroll
-        g = s.aff_req[a]
-        gs = jnp.clip(g, 0, None)
-        cnt_n = _term_counts(st.match_count, d, gs)
-        total = jnp.sum(jnp.take(st.match_count, gs, axis=0))
-        boot = (total == 0) & s.pmg[gs]
-        gdom_g = jnp.take(d.gdom, gs, axis=0)
-        term_ok = (cnt_n >= 1) & (gdom_g >= 0)
-        ok = ok & jnp.where(g >= 0, term_ok | boot, True)
-    for a in range(s.anti_req.shape[0]):
-        g = s.anti_req[a]
-        gs = jnp.clip(g, 0, None)
-        cnt_n = _term_counts(st.match_count, d, gs)
-        gdom_g = jnp.take(d.gdom, gs, axis=0)
-        viol = (cnt_n >= 1) & (gdom_g >= 0)
-        ok = ok & jnp.where(g >= 0, ~viol, True)
-    blocked = jnp.zeros(N, dtype=bool)
-    for w in range(st.anti_bits.shape[1]):
-        blocked = blocked | ((st.anti_bits[:, w] & s.pmg_bits[w]) != 0)
+    gvalid_all = d.gdom_f >= 0  # [G, N]
+
+    ohA = _term_onehot(s.aff_req, G)  # [A, G]
+    if ohA.shape[0]:
+        cnt = _term_rows(st.match_count, ohA)  # [A, N]
+        gvalid = jnp.einsum("ag,gn->an", ohA, gvalid_all.astype(jnp.float32), precision=_HI) > 0.5
+        total = jnp.einsum("ag,g->a", ohA, st.match_total, precision=_HI)  # [A]
+        selfm = jnp.einsum("ag,g->a", ohA, pmg_f, precision=_HI) > 0.5  # [A]
+        boot = (total == 0) & selfm
+        term_ok = (cnt >= 1) & gvalid
+        ok = ok & jnp.all(
+            jnp.where((s.aff_req >= 0)[:, None], term_ok | boot[:, None], True), axis=0
+        )
+
+    ohB = _term_onehot(s.anti_req, G)
+    if ohB.shape[0]:
+        cntb = _term_rows(st.match_count, ohB)
+        gvalidb = jnp.einsum("ag,gn->an", ohB, gvalid_all.astype(jnp.float32), precision=_HI) > 0.5
+        viol = (cntb >= 1) & gvalidb
+        ok = ok & jnp.all(jnp.where((s.anti_req >= 0)[:, None], ~viol, True), axis=0)
+
+    # Symmetric: a node is blocked if any placed pod with a required anti
+    # term g sits in its domain and this pod matches g.
+    blocked = (
+        jnp.einsum("g,gn->n", pmg_f, (st.anti_active > 0).astype(jnp.float32), precision=_HI)
+        > 0.5
+    )
     return ok & ~blocked
 
 
 def interpod_score(d: Derived, st: DevState, s: PodSlot, has_symmetric_pref: bool = True) -> jax.Array:
-    N = d.gdom.shape[1]
+    G = st.match_count.shape[0]
+    N = d.gdom_f.shape[1]
     raw = jnp.zeros(N, dtype=jnp.float32)
-    for a in range(s.pref_aff.shape[0]):
-        g = s.pref_aff[a]
-        gs = jnp.clip(g, 0, None)
-        cnt_n = _term_counts(st.match_count, d, gs)
-        raw = raw + jnp.where(g >= 0, s.pref_aff_w[a] * cnt_n, 0.0)
+    ohP = _term_onehot(s.pref_aff, G)
+    if ohP.shape[0]:
+        cnt = _term_rows(st.match_count, ohP)  # [P, N]
+        w = jnp.where(s.pref_aff >= 0, s.pref_aff_w, 0.0)
+        raw = raw + jnp.einsum("p,pn->n", w, cnt, precision=_HI)
     if has_symmetric_pref:
-        # Needs every group's weight sum — the one remaining [G, N] sweep;
-        # statically skipped when the trace has no preferred terms.
-        safe = jnp.clip(d.gdom, 0, None)
-        wsum = jnp.where(d.gdom >= 0, jnp.take_along_axis(st.pref_wsum, safe, axis=1), 0.0)
-        raw = raw + jnp.sum(wsum * s.pmg[:, None], axis=0)
+        # pref_wsum is already node-space — the old [G, N] sweep is now a
+        # single matvec.
+        raw = raw + jnp.einsum(
+            "g,gn->n", s.pmg.astype(jnp.float32), st.pref_wsum, precision=_HI
+        )
     return raw
 
 
 def spread_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    N = d.gdom.shape[1]
-    ok = jnp.ones(N, dtype=bool)
-    for a in range(s.spread_g.shape[0]):
-        g = s.spread_g[a]
-        gs = jnp.clip(g, 0, None)
-        row = jnp.take(st.match_count, gs, axis=0)  # [D]
-        valid_row = jnp.take(d.dom_valid, gs, axis=0)  # [D]
-        min_cnt = jnp.min(jnp.where(valid_row, row, jnp.inf))
-        cnt_n = _term_counts(st.match_count, d, gs)
-        gdom_g = jnp.take(d.gdom, gs, axis=0)
-        self_match = s.pmg[gs].astype(jnp.float32)
-        has_domains = jnp.isfinite(min_cnt)
-        c_ok = (
-            (gdom_g >= 0)
-            & has_domains
-            & (cnt_n + self_match - jnp.where(has_domains, min_cnt, 0.0) <= s.spread_skew[a])
-        )
-        ok = ok & jnp.where((g >= 0) & s.spread_dns[a], c_ok, True)
-    return ok
+    G = st.match_count.shape[0]
+    N = d.gdom_f.shape[1]
+    ohS = _term_onehot(s.spread_g, G)  # [A, G]
+    if not ohS.shape[0]:
+        return jnp.ones(N, dtype=bool)
+    cnt = _term_rows(st.match_count, ohS)  # [A, N]
+    gvalid = jnp.einsum("ag,gn->an", ohS, (d.gdom_f >= 0).astype(jnp.float32), precision=_HI) > 0.5
+    # min over valid domains == min over nodes that have a domain (every
+    # domain has ≥1 node by construction).
+    minv = jnp.min(jnp.where(gvalid, cnt, jnp.inf), axis=1)  # [A]
+    has_domains = jnp.isfinite(minv)
+    selfm = jnp.einsum("ag,g->a", ohS, s.pmg.astype(jnp.float32), precision=_HI)
+    c_ok = (
+        gvalid
+        & has_domains[:, None]
+        & (cnt + selfm[:, None] - jnp.where(has_domains, minv, 0.0)[:, None]
+           <= s.spread_skew[:, None])
+    )
+    return jnp.all(jnp.where(((s.spread_g >= 0) & s.spread_dns)[:, None], c_ok, True), axis=0)
 
 
 def spread_score(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
-    N = d.gdom.shape[1]
-    raw = jnp.zeros(N, dtype=jnp.float32)
-    for a in range(s.spread_g.shape[0]):
-        g = s.spread_g[a]
-        gs = jnp.clip(g, 0, None)
-        cnt_n = _term_counts(st.match_count, d, gs)
-        raw = raw + jnp.where(g >= 0, cnt_n + s.pmg[gs].astype(jnp.float32), 0.0)
-    return raw
+    G = st.match_count.shape[0]
+    N = d.gdom_f.shape[1]
+    ohS = _term_onehot(s.spread_g, G)
+    if not ohS.shape[0]:
+        return jnp.zeros(N, dtype=jnp.float32)
+    cnt = _term_rows(st.match_count, ohS)  # [A, N]
+    selfm = jnp.einsum("ag,g->a", ohS, s.pmg.astype(jnp.float32), precision=_HI)
+    valid = (s.spread_g >= 0)[:, None]
+    return jnp.sum(jnp.where(valid, cnt + selfm[:, None], 0.0), axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -483,47 +496,85 @@ def select_node(scores: jax.Array, feasible: jax.Array):
     return jnp.where(placed, choice, PAD), placed
 
 
-def apply_binding(
-    dc: DevCluster, d: Derived, st: DevState, s: PodSlot, node: jax.Array, on: jax.Array, sign: float = 1.0
-) -> DevState:
-    """Masked bind (sign=+1) / unbind (sign=-1). ``on`` is a bool scalar;
-    when False the update is a no-op — keeps the scan branch-free."""
-    w = jnp.where(on & s.valid, sign, 0.0).astype(jnp.float32)
-    ns = jnp.clip(node, 0, None)
-    used = st.used.at[ns].add(w * s.req)
-    G = st.match_count.shape[0]
-    dom_g = d.gdom[:, ns]  # [G]
-    dval = dom_g >= 0
-    doms = jnp.clip(dom_g, 0, None)
-    match_count = st.match_count.at[jnp.arange(G), doms].add(
-        w * (s.pmg & dval).astype(jnp.float32)
+def _bind_deltas(d: Derived, node: jax.Array):
+    """Shared pieces of a masked bind: the node one-hot, the [G, N]
+    domain-equality mask (node n is in the same domain as `node` under
+    group g's topology key), and the [G] has-domain flags for the bound
+    node."""
+    N = d.gdom_f.shape[1]
+    oh_n = ((jnp.arange(N) == node) & (node >= 0)).astype(jnp.float32)  # [N]
+    # Domain id of the bound node per group (one selected element — exact).
+    gdom_at = jnp.einsum("gn,n->g", d.gdom_f, oh_n, precision=_HI)  # [G]
+    node_has_dom = (
+        jnp.einsum("gn,n->g", (d.gdom_f >= 0).astype(jnp.float32), oh_n, precision=_HI) > 0.5
     )
-    anti = st.anti_active
-    bits = st.anti_bits
-    for a in range(s.anti_req.shape[0]):
-        g = s.anti_req[a]
-        gs = jnp.clip(g, 0, None)
-        ok = (g >= 0) & dval[gs]
-        anti = anti.at[gs, doms[gs]].add(w * ok.astype(jnp.float32))
-        # Refresh bit plane g of anti_bits from the updated count row: bit
-        # set ⇔ count > 0 in the node's domain. Only term groups of the
-        # bound pod can change, so this is a few [N] ops per bind.
-        row = jnp.take(anti, gs, axis=0)  # [D]
-        gdom_g = jnp.take(d.gdom, gs, axis=0)  # [N]
-        on_nodes = (jnp.take(row, jnp.clip(gdom_g, 0, None)) > 0) & (gdom_g >= 0)
-        bit = jnp.left_shift(jnp.uint32(1), (gs % 32).astype(jnp.uint32))
-        apply_g = ok & (on & s.valid)
-        for wd in range(bits.shape[1]):
-            in_word = apply_g & (gs // 32 == wd)
-            old = bits[:, wd]
-            new = jnp.where(on_nodes, old | bit, old & ~bit)
-            bits = bits.at[:, wd].set(jnp.where(in_word, new, old))
-    pref = st.pref_wsum
-    for a in range(s.pref_aff.shape[0]):
-        g = s.pref_aff[a]
-        gs = jnp.clip(g, 0, None)
-        ok = (g >= 0) & dval[gs]
-        pref = pref.at[gs, doms[gs]].add(w * s.pref_aff_w[a] * ok.astype(jnp.float32))
+    dom_sel = (
+        (d.gdom_f == gdom_at[:, None]) & node_has_dom[:, None] & (d.gdom_f >= 0)
+    ).astype(jnp.float32)  # [G, N]
+    return oh_n, dom_sel, node_has_dom.astype(jnp.float32)
+
+
+def _pod_group_vectors(s: PodSlot, G: int):
+    """([..., G] anti-term one-hot sum, [..., G] pref weight sum); term axes
+    may carry a leading wave axis."""
+    ohB = _term_onehot(s.anti_req, G)
+    anti_g = jnp.sum(ohB, axis=-2)
+    ohP = _term_onehot(s.pref_aff, G)
+    w = jnp.where(s.pref_aff >= 0, s.pref_aff_w, 0.0)
+    pref_g = jnp.einsum("...a,...ag->...g", w, ohP, precision=_HI)
+    return anti_g, pref_g
+
+
+def apply_binding(
+    d: Derived, st: DevState, s: PodSlot, node: jax.Array, on: jax.Array
+) -> DevState:
+    """Masked bind. ``on`` is a bool scalar; when False the update is a
+    no-op — keeps the scan branch-free. All updates are elementwise (no
+    scatters). Gang rollback goes through :func:`apply_unbind_wave`."""
+    G = st.match_count.shape[0]
+    w = jnp.where(on & s.valid, 1.0, 0.0).astype(jnp.float32)
+    oh_n, dom_sel, has_dom = _bind_deltas(d, node)
+    used = st.used + (w * oh_n)[:, None] * s.req[None, :]
+    pmg_f = s.pmg.astype(jnp.float32)
+    match_count = st.match_count + (w * pmg_f)[:, None] * dom_sel
+    # Total counts only domain-carrying binds — it must stay exactly
+    # sum-over-domains of match_count (ops.cpu's bootstrap total).
+    match_total = st.match_total + w * pmg_f * has_dom
+    anti_g, pref_g = _pod_group_vectors(s, G)
+    anti = st.anti_active + (w * anti_g)[:, None] * dom_sel
+    pref = st.pref_wsum + (w * pref_g)[:, None] * dom_sel
     return DevState(
-        used=used, match_count=match_count, anti_active=anti, pref_wsum=pref, anti_bits=bits
+        used=used, match_count=match_count, anti_active=anti, pref_wsum=pref,
+        match_total=match_total,
+    )
+
+
+def apply_unbind_wave(
+    d: Derived, st: DevState, sb: PodSlot, choice: jax.Array, revert: jax.Array
+) -> DevState:
+    """Batched gang rollback: subtract every reverted slot's bind in ONE
+    set of elementwise updates (sb fields have leading wave axis W)."""
+    G = st.match_count.shape[0]
+    N = d.gdom_f.shape[1]
+    w = jnp.where(revert & sb.valid, 1.0, 0.0).astype(jnp.float32)  # [W]
+    oh = ((jnp.arange(N)[None, :] == choice[:, None]) & (choice[:, None] >= 0)).astype(
+        jnp.float32
+    )  # [W, N]
+    used = st.used - jnp.einsum("w,wn,wr->nr", w, oh, sb.req, precision=_HI)
+    gdom_at = jnp.einsum("gn,wn->wg", d.gdom_f, oh, precision=_HI)  # [W, G]
+    has_dom = jnp.einsum("gn,wn->wg", (d.gdom_f >= 0).astype(jnp.float32), oh, precision=_HI) > 0.5
+    dom_sel = (
+        (d.gdom_f[None] == gdom_at[:, :, None]) & has_dom[:, :, None] & (d.gdom_f >= 0)[None]
+    ).astype(jnp.float32)  # [W, G, N]
+    pmg_f = sb.pmg.astype(jnp.float32)  # [W, G]
+    match_count = st.match_count - jnp.einsum("w,wg,wgn->gn", w, pmg_f, dom_sel, precision=_HI)
+    match_total = st.match_total - jnp.einsum(
+        "w,wg->g", w, pmg_f * has_dom.astype(jnp.float32), precision=_HI
+    )
+    anti_wg, pref_wg = _pod_group_vectors(sb, G)  # [W, G] each
+    anti = st.anti_active - jnp.einsum("w,wg,wgn->gn", w, anti_wg, dom_sel, precision=_HI)
+    pref = st.pref_wsum - jnp.einsum("w,wg,wgn->gn", w, pref_wg, dom_sel, precision=_HI)
+    return DevState(
+        used=used, match_count=match_count, anti_active=anti, pref_wsum=pref,
+        match_total=match_total,
     )

@@ -3,7 +3,10 @@ chunks so a 1M-pod replay can resume after interruption; the snapshot also
 doubles as a what-if fork point (snapshot → perturb → fan out).
 
 Plain ``.npz`` — the state is four dense tensors plus a cursor; orbax would
-add dependency weight for no benefit at this size.
+add dependency weight for no benefit at this size. Count tensors are stored
+in DOMAIN space ``[G, D]`` (the canonical semantic form — scenario-
+independent), and converted to/from the device engine's node space
+``[G, N]`` at save/load (see ops.tpu.DevState).
 """
 
 from __future__ import annotations
@@ -19,10 +22,9 @@ import numpy as np
 class ReplayCheckpoint:
     chunk_cursor: int  # next chunk index to execute
     used: np.ndarray
-    match_count: np.ndarray
-    anti_active: np.ndarray
-    pref_wsum: np.ndarray
-    anti_bits: np.ndarray
+    match_count: np.ndarray  # [G, D] domain space
+    anti_active: np.ndarray  # [G, D]
+    pref_wsum: np.ndarray  # [G, D]
     outs: List[np.ndarray]  # per-chunk collected outputs so far
 
     def save(self, path: str) -> None:
@@ -34,7 +36,6 @@ class ReplayCheckpoint:
             match_count=self.match_count,
             anti_active=self.anti_active,
             pref_wsum=self.pref_wsum,
-            anti_bits=self.anti_bits,
             num_outs=np.int64(len(self.outs)),
             **{f"out_{i}": o for i, o in enumerate(self.outs)},
         )
@@ -50,32 +51,34 @@ class ReplayCheckpoint:
                 match_count=z["match_count"],
                 anti_active=z["anti_active"],
                 pref_wsum=z["pref_wsum"],
-                anti_bits=z["anti_bits"],
                 outs=[z[f"out_{i}"] for i in range(n)],
             )
 
 
-def state_to_checkpoint(state, cursor: int, outs: List[np.ndarray]) -> ReplayCheckpoint:
+def state_to_checkpoint(
+    state, gdom: np.ndarray, D: int, cursor: int, outs: List[np.ndarray]
+) -> ReplayCheckpoint:
+    from ..ops.tpu import node_space_to_domain
+
     return ReplayCheckpoint(
         chunk_cursor=cursor,
         used=np.asarray(state.used),
-        match_count=np.asarray(state.match_count),
-        anti_active=np.asarray(state.anti_active),
-        pref_wsum=np.asarray(state.pref_wsum),
-        anti_bits=np.asarray(state.anti_bits),
+        match_count=node_space_to_domain(np.asarray(state.match_count), gdom, D),
+        anti_active=node_space_to_domain(np.asarray(state.anti_active), gdom, D),
+        pref_wsum=node_space_to_domain(np.asarray(state.pref_wsum), gdom, D),
         outs=[np.asarray(o) for o in outs],
     )
 
 
-def checkpoint_to_state(ckpt: ReplayCheckpoint):
+def checkpoint_to_state(ckpt: ReplayCheckpoint, gdom: np.ndarray):
     import jax.numpy as jnp
 
-    from ..ops.tpu import DevState
+    from ..ops.tpu import DevState, domain_to_node_space
 
     return DevState(
         used=jnp.asarray(ckpt.used),
-        match_count=jnp.asarray(ckpt.match_count),
-        anti_active=jnp.asarray(ckpt.anti_active),
-        pref_wsum=jnp.asarray(ckpt.pref_wsum),
-        anti_bits=jnp.asarray(ckpt.anti_bits),
+        match_count=jnp.asarray(domain_to_node_space(ckpt.match_count, gdom)),
+        anti_active=jnp.asarray(domain_to_node_space(ckpt.anti_active, gdom)),
+        pref_wsum=jnp.asarray(domain_to_node_space(ckpt.pref_wsum, gdom)),
+        match_total=jnp.asarray(ckpt.match_count.sum(axis=1).astype(np.float32)),
     )

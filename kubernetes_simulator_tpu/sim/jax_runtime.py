@@ -57,9 +57,9 @@ class StepSpec:
     resource_weights: Tuple[float, ...] = ()  # [R]
     shape_x: Tuple[float, ...] = (0.0, 100.0)
     shape_y: Tuple[float, ...] = (0.0, 100.0)
-    # Static trace property: whether any pod carries preferred (anti-)
-    # affinity terms — gates the only remaining [G, N] sweep in scoring.
-    has_symmetric_pref: bool = True
+    # Static trace properties: gate work the trace can never trigger.
+    has_symmetric_pref: bool = True  # any preferred (anti-)affinity terms
+    has_gangs: bool = True  # any pod-group membership (gang rollback)
 
     @classmethod
     def from_config(
@@ -103,6 +103,7 @@ class StepSpec:
             has_symmetric_pref=(
                 bool((pods.pref_aff >= 0).any()) if pods is not None else True
             ),
+            has_gangs=(bool((pods.group_id >= 0).any()) if pods is not None else True),
         )
 
 
@@ -152,50 +153,53 @@ def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec:
     return feasible, total
 
 
-def make_wave_step(dc_D: int, wave_width: int, spec: StepSpec):
+def make_wave_step(dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSpec):
     """Build the scan body: one wave = W sequential slot placements +
-    wave-boundary gang commit (SURVEY.md §3.3 Permit-as-masked-commit)."""
+    wave-boundary gang commit (SURVEY.md §3.3 Permit-as-masked-commit).
 
-    def wave_step(carry, slot_batch: T.PodSlot):
-        dc, d, st = carry
+    ``dc``/``d`` are loop invariants CLOSED OVER, not carried — keeping them
+    out of the scan carry stops XLA copying ~10s of MB per iteration (the
+    single biggest perf bug in the earlier [G, D]-carry design)."""
+
+    def wave_step(st: T.DevState, slot_batch: T.PodSlot):
         choices, placeds = [], []
         for wslot in range(wave_width):
             s = jax.tree.map(lambda a: a[wslot], slot_batch)
             feasible, scores = eval_pod(dc, d, st, s, spec)
             node, placed = T.select_node(scores, feasible)
             placed = placed & s.valid
-            st = T.apply_binding(dc, d, st, s, node, placed)
+            st = T.apply_binding(d, st, s, node, placed)
             choices.append(node)
             placeds.append(placed)
         choice = jnp.stack(choices)  # [W]
         placed = jnp.stack(placeds)  # [W]
-        groups = slot_batch.group  # [W]
-        same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
-        fail = jnp.any(same & ~placed[None, :], axis=1)  # gang all-or-nothing
-        revert = placed & fail
-        for wslot in range(wave_width):
-            s = jax.tree.map(lambda a: a[wslot], slot_batch)
-            st = T.apply_binding(dc, d, st, s, choice[wslot], revert[wslot], sign=-1.0)
-        final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
-        return (dc, d, st), final
+        if spec.has_gangs:
+            groups = slot_batch.group  # [W]
+            same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
+            fail = jnp.any(same & ~placed[None, :], axis=1)  # gang all-or-nothing
+            revert = placed & fail
+            st = T.apply_unbind_wave(d, st, slot_batch, choice, revert)
+            final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
+        else:
+            final = jnp.where(placed, choice, PAD).astype(jnp.int32)
+        return st, final
 
     return wave_step
 
 
-def make_chunk_fn(D: int, wave_width: int, spec: StepSpec):
+def make_chunk_fn(wave_width: int, spec: StepSpec):
     """jit-compiled: (DevCluster, DevState, slots[C, W]) → (DevState,
     choices[C, W]). Derived tensors are rebuilt inside jit from the cluster
-    tensors, so perturbed clusters reuse the same executable."""
+    tensors, so perturbed clusters reuse the same executable. The state
+    buffers are donated — the carry updates in place across chunk calls."""
 
-    wave_step = make_wave_step(D, wave_width, spec)
-
-    @jax.jit
     def chunk_fn(dc: T.DevCluster, state: T.DevState, slots: T.PodSlot):
-        d = T.Derived.build(dc, D)
-        (_, _, state), choices = jax.lax.scan(wave_step, (dc, d, state), slots)
+        d = T.Derived.build(dc)
+        wave_step = make_wave_step(dc, d, wave_width, spec)
+        state, choices = jax.lax.scan(wave_step, state, slots)
         return state, choices
 
-    return chunk_fn
+    return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
 class JaxReplayEngine:
@@ -215,19 +219,21 @@ class JaxReplayEngine:
         self.dc = T.DevCluster.from_encoded(ec)
         self.waves = pack_waves(pods, wave_width)
         self.D = max(ec.max_domains, 1)
-        self.chunk_fn = make_chunk_fn(self.D, wave_width, self.spec)
+        self.chunk_fn = make_chunk_fn(wave_width, self.spec)
 
     def _init_dev_state(self) -> T.DevState:
         from ..ops.cpu import _group_dom_per_node
 
         host = init_state(self.ec, self.pods)  # applies pre-bound pods
         gdom = _group_dom_per_node(self.ec)
+        self._gdom = gdom
+        self._Dhost = host.match_count.shape[1]
         return T.DevState(
             used=jnp.asarray(host.used),
-            match_count=jnp.asarray(host.match_count),
-            anti_active=jnp.asarray(host.anti_active),
-            pref_wsum=jnp.asarray(host.pref_wsum),
-            anti_bits=jnp.asarray(T.anti_bits_from_counts(host.anti_active, gdom)),
+            match_count=jnp.asarray(T.domain_to_node_space(host.match_count, gdom)),
+            anti_active=jnp.asarray(T.domain_to_node_space(host.anti_active, gdom)),
+            pref_wsum=jnp.asarray(T.domain_to_node_space(host.pref_wsum, gdom)),
+            match_total=jnp.asarray(host.match_count.sum(axis=1).astype(np.float32)),
         )
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
@@ -279,7 +285,7 @@ class JaxReplayEngine:
         start_chunk = 0
         if resume and checkpoint_path:
             ck = ReplayCheckpoint.load(checkpoint_path)
-            state = checkpoint_to_state(ck)
+            state = checkpoint_to_state(ck, self._gdom)
             all_choices = [jnp.asarray(o) for o in ck.outs]
             start_chunk = ck.chunk_cursor
         pending_events = sorted(node_events or [], key=lambda e: e.time)
@@ -299,7 +305,9 @@ class JaxReplayEngine:
             state, choices = self.chunk_fn(self.dc, state, slots)
             all_choices.append(choices)
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
-                state_to_checkpoint(state, ci + 1, all_choices).save(checkpoint_path)
+                state_to_checkpoint(state, self._gdom, self._Dhost, ci + 1, all_choices).save(
+                    checkpoint_path
+                )
         choices = jax.block_until_ready(jnp.concatenate(all_choices, axis=0))
         wall = time.perf_counter() - t0
         if node_events:
@@ -327,9 +335,9 @@ class JaxReplayEngine:
                 util[rname] = float(u.mean())
         host_state = SchedState(
             used=used,
-            match_count=np.asarray(state.match_count),
-            anti_active=np.asarray(state.anti_active),
-            pref_wsum=np.asarray(state.pref_wsum),
+            match_count=T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost),
+            anti_active=T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost),
+            pref_wsum=T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost),
             bound=assignments.copy(),
         )
         return ReplayResult(

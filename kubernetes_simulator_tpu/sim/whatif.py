@@ -215,25 +215,26 @@ class WhatIfEngine:
         self._chunk_fn = self._build_chunk_fn()
 
     def _build_chunk_fn(self):
-        wave_step = make_wave_step(self.D, self.wave_width, self.spec)
         collect = self.collect_assignments
+        spec, wave_width = self.spec, self.wave_width
 
         def per_scenario(dc, state, slots):
-            d = T.Derived.build(dc, self.D)
+            d = T.Derived.build(dc)
+            wave_step = make_wave_step(dc, d, wave_width, spec)
 
-            def step(carry, slot_batch):
-                (dc_, d_, st_), choices = wave_step(carry, slot_batch)
+            def step(st, slot_batch):
+                st, choices = wave_step(st, slot_batch)
                 placed_w = jnp.sum((choices >= 0) & slot_batch.valid).astype(jnp.int32)
                 out = choices if collect else placed_w
-                return (dc_, d_, st_), out
+                return st, out
 
-            (_, _, state), outs = jax.lax.scan(step, (dc, d, state), slots)
+            state, outs = jax.lax.scan(step, state, slots)
             return state, outs
 
         vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None))
 
         if self.mesh is None:
-            return jax.jit(vmapped)
+            return jax.jit(vmapped, donate_argnums=(1,))
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -245,6 +246,7 @@ class WhatIfEngine:
             in_shardings=(dc_sh, jax.tree.map(lambda _: shard, T.DevState.init(self.ec)),
                           jax.tree.map(lambda _: repl, T.gather_slots(self.pods, self.waves.idx[:1]))),
             out_shardings=(shard, shard),
+            donate_argnums=(1,),
         )
 
     def _init_states(self) -> T.DevState:
@@ -260,8 +262,12 @@ class WhatIfEngine:
             host.anti_active = ck.anti_active
             host.pref_wsum = ck.pref_wsum
             if ck.outs:
-                self._fork_choices = np.concatenate(ck.outs, axis=0)  # [waves, W]
-                self._fork_waves_done = self._fork_choices.shape[0]
+                # The source replay pads its wave list to a multiple of its
+                # chunk size — clamp to the REAL wave count so padded tail
+                # waves aren't treated as already-scheduled.
+                fork = np.concatenate(ck.outs, axis=0)  # [waves(+pad), W]
+                self._fork_waves_done = min(fork.shape[0], self.waves.idx.shape[0])
+                self._fork_choices = fork[: self._fork_waves_done]
         else:
             host = init_state(self.ec, self.pods)  # pre-bound pods
         G, D = host.match_count.shape[0], self.D
@@ -272,25 +278,23 @@ class WhatIfEngine:
         aa[:, : host.anti_active.shape[1]] = host.anti_active
         pw = np.zeros((G, D), np.float32)
         pw[:, : host.pref_wsum.shape[1]] = host.pref_wsum
-        # anti_bits depend on each scenario's node→domain table.
+        # Node-space state depends on each scenario's node→domain table
+        # (label perturbations change domains).
         nd = np.asarray(self.sset.dc.node_domain)  # [S, T, N]
         gt = np.clip(self.ec.group_topo, 0, None)
-        bits = np.stack(
-            [
-                T.anti_bits_from_counts(
-                    aa,
-                    np.where(self.ec.group_topo[:, None] >= 0, nd[s][gt], PAD),
-                )
-                for s in range(self.S)
-            ]
+        gdom_s = np.where(
+            self.ec.group_topo[None, :, None] >= 0, nd[:, gt, :], PAD
+        )  # [S, G, N]
+        to_nodes = lambda arr: jnp.asarray(
+            np.stack([T.domain_to_node_space(arr, gdom_s[s]) for s in range(self.S)])
         )
         rep = lambda a: jnp.asarray(np.repeat(a[None], self.S, axis=0))
         return T.DevState(
             used=rep(host.used),
-            match_count=rep(mc),
-            anti_active=rep(aa),
-            pref_wsum=rep(pw),
-            anti_bits=jnp.asarray(bits),
+            match_count=to_nodes(mc),
+            anti_active=to_nodes(aa),
+            pref_wsum=to_nodes(pw),
+            match_total=rep(mc.sum(axis=1).astype(np.float32)),
         )
 
     def run(self) -> WhatIfResult:

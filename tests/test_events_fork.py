@@ -56,3 +56,26 @@ def test_whatif_fork_from_checkpoint(tmp_path):
     prefix_pods = prefix_pods[prefix_pods >= 0]
     assert (res.assignments[1][prefix_pods] == full.assignments[prefix_pods]).all()
     assert res.placed[1] <= res.placed[0]
+
+
+def test_whatif_fork_from_padded_checkpoint(tmp_path):
+    """Regression: the source replay pads its wave list to a multiple of
+    chunk_waves; a checkpoint taken past the real wave count must not make
+    the fork treat padding waves as already-scheduled (IndexError before
+    the clamp in WhatIfEngine._init_states)."""
+    cluster = make_cluster(12, seed=7)
+    # 90 pods / width 8 → 12 waves; chunk_waves=5 pads to 15.
+    pods, _ = make_workload(90, seed=7, with_affinity=True, with_spread=True)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    ck = str(tmp_path / "ck.npz")
+    eng = JaxReplayEngine(ec, ep, cfg, chunk_waves=5)
+    assert eng.waves.idx.shape[0] % 5 != 0  # the padding case
+    full = eng.replay(checkpoint_path=ck, checkpoint_every=1)
+    weng = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario()], cfg, chunk_waves=5,
+        collect_assignments=True, fork_checkpoint=ck,
+    )
+    res = weng.run()
+    # Checkpoint covered the whole trace → fork reproduces it exactly.
+    assert (res.assignments[0] == full.assignments).all()

@@ -111,3 +111,35 @@ def test_jax_determinism():
     r1 = JaxReplayEngine(ec, ep, FrameworkConfig(plugins=None)).replay()
     r2 = JaxReplayEngine(ec, ep, FrameworkConfig(plugins=None)).replay()
     assert (r1.assignments == r2.assignments).all()
+
+
+def test_parity_bootstrap_on_domainless_node():
+    """A pod placed via the bootstrap exception on a node WITHOUT the
+    topology label must not count toward the group total — a later pod with
+    the same required term still gets the bootstrap (regression: device
+    match_total once counted domainless binds; ops/cpu.py total is
+    match_count.sum which never sees them)."""
+    from kubernetes_simulator_tpu.models.core import (
+        Cluster, LabelSelector, Node, Pod, PodAffinitySpec, PodAffinityTerm,
+    )
+
+    zone = "topology.kubernetes.io/zone"
+    nodes = [
+        # Has the zone label but too small for any pod below.
+        Node("n-zoned", capacity={"cpu": 0.5, "memory": 1, "pods": 10},
+             labels={zone: "a"}),
+        # Fits everything but has NO zone label → no domain under `zone`.
+        Node("n-bare", capacity={"cpu": 8, "memory": 32, "pods": 10}),
+    ]
+    aff = PodAffinitySpec(
+        required=(PodAffinityTerm(LabelSelector.make({"app": "x"}), zone),)
+    )
+    pods = [
+        Pod("a", labels={"app": "x"}, requests={"cpu": 1}, arrival_time=0.0,
+            pod_affinity=aff),
+        Pod("b", labels={"app": "x"}, requests={"cpu": 1}, arrival_time=1.0,
+            pod_affinity=aff),
+    ]
+    cpu_res, jax_res = assert_parity(Cluster(nodes=nodes), pods)
+    # Both pods bootstrap onto the bare node; neither may be unschedulable.
+    assert cpu_res.placed == 2
