@@ -1,0 +1,178 @@
+"""Native runtime layer — ctypes bindings to the C++ host-side components
+(``native/*.cpp``): gang-aware wave packing and columnar trace IO.
+
+The shared library is built lazily with ``g++ -O3`` into
+``native/_build/`` the first time it is needed and cached by source mtime.
+Every entry point has a pure-Python fallback (the original implementations)
+so the framework still runs where no toolchain exists; parity between the
+two is pinned by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO / "native"
+_BUILD = _SRC / "_build"
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SOURCES = ("wavepack.cpp", "traceio.cpp")
+
+
+def _build_lib() -> Optional[Path]:
+    so = _BUILD / "libksim.so"
+    srcs = [_SRC / s for s in _SOURCES]
+    if not all(s.exists() for s in srcs):
+        return None
+    if so.exists() and so.stat().st_mtime >= max(s.stat().st_mtime for s in srcs):
+        return so
+    _BUILD.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(so)] + [
+        str(s) for s in srcs
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return so
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        if os.environ.get("KSIM_NO_NATIVE"):
+            return None
+        so = _build_lib()
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(str(so))
+            except OSError:
+                return None
+            lib.ksim_pack_waves.restype = ctypes.c_int64
+            lib.ksim_pack_waves.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.ksim_trace_count.restype = ctypes.c_int64
+            lib.ksim_trace_count.argtypes = [ctypes.c_char_p]
+            lib.ksim_trace_parse.restype = ctypes.c_int64
+            lib.ksim_trace_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.ksim_trace_write.restype = ctypes.c_int64
+            lib.ksim_trace_write.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ]
+            _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def pack_waves_native(
+    order: np.ndarray, group_of: np.ndarray, wave_width: int
+) -> Optional[np.ndarray]:
+    """[num_waves, W] i32 wave table (PAD=-1), or None if the native lib is
+    unavailable. Raises ValueError when a gang exceeds the wave width (same
+    contract as the Python packer)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    order = np.ascontiguousarray(order, dtype=np.int32)
+    group_of = np.ascontiguousarray(group_of, dtype=np.int32)
+    n = order.shape[0]
+    out = np.empty((max(n, 1), wave_width), dtype=np.int32)
+    waves = lib.ksim_pack_waves(
+        _i32p(order), n, _i32p(group_of), group_of.shape[0], wave_width, _i32p(out)
+    )
+    if waves < 0:
+        raise ValueError(f"gang exceeds wave width {wave_width}")
+    return out[:waves].copy()
+
+
+def read_trace_csv(path: str | os.PathLike) -> Optional[dict]:
+    """Columnar task-event trace → dict of numpy arrays, or None if the
+    native lib is unavailable (callers fall back to numpy loadtxt)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    p = str(path).encode()
+    n = lib.ksim_trace_count(p)
+    if n < 0:
+        raise FileNotFoundError(path)
+    cols = {
+        "arrival": np.empty(n, np.float64),
+        "cpu": np.empty(n, np.float32),
+        "mem": np.empty(n, np.float32),
+        "priority": np.empty(n, np.int32),
+        "group_id": np.empty(n, np.int32),
+        "app_id": np.empty(n, np.int32),
+        "tolerates": np.empty(n, np.int32),
+        "duration": np.empty(n, np.float32),
+    }
+    got = lib.ksim_trace_parse(
+        p, n,
+        cols["arrival"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cols["cpu"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        cols["mem"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _i32p(cols["priority"]), _i32p(cols["group_id"]), _i32p(cols["app_id"]),
+        _i32p(cols["tolerates"]),
+        cols["duration"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if got < 0:
+        raise ValueError(f"malformed trace file: {path}")
+    return {k: v[:got] for k, v in cols.items()}
+
+
+def write_trace_csv(path: str | os.PathLike, cols: dict) -> bool:
+    """Write a columnar trace; False if the native lib is unavailable."""
+    lib = _lib()
+    if lib is None:
+        return False
+    n = len(cols["arrival"])
+    arrs = {
+        "arrival": np.ascontiguousarray(cols["arrival"], np.float64),
+        "cpu": np.ascontiguousarray(cols["cpu"], np.float32),
+        "mem": np.ascontiguousarray(cols["mem"], np.float32),
+        "priority": np.ascontiguousarray(cols["priority"], np.int32),
+        "group_id": np.ascontiguousarray(cols["group_id"], np.int32),
+        "app_id": np.ascontiguousarray(cols["app_id"], np.int32),
+        "tolerates": np.ascontiguousarray(cols["tolerates"], np.int32),
+        "duration": np.ascontiguousarray(cols["duration"], np.float32),
+    }
+    got = lib.ksim_trace_write(
+        str(path).encode(), n,
+        arrs["arrival"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        arrs["cpu"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        arrs["mem"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _i32p(arrs["priority"]), _i32p(arrs["group_id"]), _i32p(arrs["app_id"]),
+        _i32p(arrs["tolerates"]),
+        arrs["duration"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if got != n:
+        raise IOError(f"short trace write to {path}: {got}/{n}")
+    return True

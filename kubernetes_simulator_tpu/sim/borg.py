@@ -88,31 +88,20 @@ def _make_templates(spec: BorgSpec) -> List[Pod]:
     return out
 
 
-def make_borg_encoded(spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict]:
-    """Vectorized trace build → (EncodedCluster, EncodedPods, meta)."""
+def _sample_cols(spec: BorgSpec) -> dict:
+    """Sample the per-task trace columns (the CSV/columnar schema shared
+    with native.read_trace_csv): arrival, cpu, mem, priority, group_id,
+    app_id, tolerates, duration."""
     rng = np.random.default_rng(spec.seed)
-    cluster = make_cluster(spec.nodes, seed=spec.seed, taint_fraction=0.15)
-    templates = _make_templates(spec)
-    enc = Encoder()
-    ec, tmpl_ep = enc.encode(cluster, templates)
-
     P = spec.tasks
-    T = len(templates)
-    # Template choice: app ~ zipf-ish, toleration per tier.
     app_probs = 1.0 / (np.arange(spec.num_apps) + 2.0)
     app_probs /= app_probs.sum()
-    app = rng.choice(spec.num_apps, size=P, p=app_probs)
+    app = rng.choice(spec.num_apps, size=P, p=app_probs).astype(np.int32)
     tier = rng.choice(len(PRIORITY_TIERS), size=P, p=TIER_PROBS)
-    tol = (tier <= 1) & (rng.random(P) < spec.toleration_fraction)
-    tidx = (app * 2 + tol.astype(np.int64)).astype(np.int64)
+    tol = ((tier <= 1) & (rng.random(P) < spec.toleration_fraction)).astype(np.int32)
 
     cpu = rng.choice(CPU_BUCKETS, size=P, p=CPU_PROBS).astype(np.float32)
     mem = rng.choice(MEM_BUCKETS, size=P, p=MEM_PROBS).astype(np.float32)
-    requests = tmpl_ep.requests[tidx].copy()
-    ci, mi, pi = enc.vocab._r["cpu"], enc.vocab._r["memory"], enc.vocab._r["pods"]
-    requests[:, ci] = cpu
-    requests[:, mi] = mem
-    requests[:, pi] = 1.0
 
     # Diurnal-bursty arrivals over a virtual day.
     base_rate = P / 86400.0
@@ -124,7 +113,6 @@ def make_borg_encoded(spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict
 
     # Alloc sets: contiguous gangs.
     group_id = np.full(P, PAD, dtype=np.int32)
-    gang_sizes: List[int] = []
     i = 0
     g = 0
     while i < P:
@@ -132,20 +120,69 @@ def make_borg_encoded(spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict
             size = int(rng.integers(2, spec.max_gang + 1))
             size = min(size, P - i)
             group_id[i : i + size] = g
-            gang_sizes.append(size)
             g += 1
             i += size
         else:
             i += 1
-    pg_min = np.array(gang_sizes or [1], dtype=np.int32)
 
-    duration = rng.exponential(spec.mean_duration, size=P).astype(np.float32)
+    return {
+        "arrival": arrival,
+        "cpu": cpu,
+        "mem": mem,
+        "priority": PRIORITY_TIERS[tier].astype(np.int32),
+        "group_id": group_id,
+        "app_id": app,
+        "tolerates": tol,
+        "duration": rng.exponential(spec.mean_duration, size=P).astype(np.float32),
+    }
+
+
+def encoded_from_cols(spec: BorgSpec, cols: dict) -> Tuple[EncodedCluster, EncodedPods, dict]:
+    """Columnar trace → (EncodedCluster, EncodedPods, meta) by expanding the
+    app/toleration templates through the normal Encoder. The inverse of
+    export_trace_csv; also the ingest path for external trace files."""
+    cluster = make_cluster(spec.nodes, seed=spec.seed, taint_fraction=0.15)
+    templates = _make_templates(spec)
+    enc = Encoder()
+    ec, tmpl_ep = enc.encode(cluster, templates)
+
+    P = len(cols["arrival"])
+    app = np.clip(np.asarray(cols["app_id"], np.int64), 0, spec.num_apps - 1)
+    tol = np.asarray(cols["tolerates"], np.int64).clip(0, 1)
+    tidx = app * 2 + tol
+
+    requests = tmpl_ep.requests[tidx].copy()
+    ci, mi, pi = enc.vocab._r["cpu"], enc.vocab._r["memory"], enc.vocab._r["pods"]
+    requests[:, ci] = np.asarray(cols["cpu"], np.float32)
+    requests[:, mi] = np.asarray(cols["mem"], np.float32)
+    requests[:, pi] = 1.0
+
+    arrival = np.asarray(cols["arrival"], np.float64)
+    group_id = np.asarray(cols["group_id"], np.int32)
+    duration = np.asarray(cols["duration"], np.float32)
+
+    # pg_min_member is indexed by gang id, so external traces with sparse
+    # group ids (real Borg collection ids) are remapped to contiguous ids
+    # in first-appearance order.
+    mask = group_id >= 0
+    if mask.any():
+        uniq, first_idx, inv = np.unique(
+            group_id[mask], return_index=True, return_inverse=True
+        )
+        rank = np.empty(len(uniq), dtype=np.int32)
+        rank[np.argsort(first_idx)] = np.arange(len(uniq), dtype=np.int32)
+        group_id = group_id.copy()
+        group_id[mask] = rank[inv]
+        gang_sizes = [int(c) for c in np.bincount(group_id[mask], minlength=len(uniq))]
+    else:
+        gang_sizes = []
+    pg_min = np.array(gang_sizes or [1], dtype=np.int32)
 
     ep = EncodedPods(
         num_pods=P,
         names=[f"task-{j}" for j in range(P)],
         requests=requests,
-        priority=PRIORITY_TIERS[tier].astype(np.int32),
+        priority=np.asarray(cols["priority"], np.int32),
         arrival=arrival,
         duration=duration,
         ns=tmpl_ep.ns[tidx],
@@ -176,6 +213,54 @@ def make_borg_encoded(spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict
         "makespan": float(arrival[-1]) if P else 0.0,
     }
     return ec, ep, meta
+
+
+def make_borg_encoded(spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict]:
+    """Vectorized trace build → (EncodedCluster, EncodedPods, meta)."""
+    return encoded_from_cols(spec, _sample_cols(spec))
+
+
+def export_trace_csv(spec: BorgSpec, path) -> dict:
+    """Sample a Borg-shaped trace and write it as a columnar task-event CSV
+    (native C++ writer when available, numpy otherwise). Returns the cols."""
+    from ..native import write_trace_csv
+
+    cols = _sample_cols(spec)
+    if not write_trace_csv(path, cols):
+        header = "arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s"
+        stacked = np.column_stack(
+            [
+                cols["arrival"], cols["cpu"], cols["mem"], cols["priority"],
+                cols["group_id"], cols["app_id"], cols["tolerates"], cols["duration"],
+            ]
+        )
+        np.savetxt(path, stacked, fmt="%.6f,%g,%g,%d,%d,%d,%d,%g", header=header, comments="")
+    return cols
+
+
+def load_trace_csv(path, spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, dict]:
+    """Ingest a task-event trace file (the replay driver's external-trace
+    path). ``spec`` supplies the cluster shape and template vocabulary."""
+    from ..native import read_trace_csv
+
+    cols = read_trace_csv(path)
+    if cols is None:  # pure-python fallback (header optional, as native)
+        with open(path) as f:
+            first = f.readline()
+        skip = 0 if first[:1].lstrip() and first.lstrip()[0] in "0123456789-+." else 1
+        raw = np.genfromtxt(path, delimiter=",", skip_header=skip)
+        raw = raw.reshape(-1, 8)
+        cols = {
+            "arrival": raw[:, 0].astype(np.float64),
+            "cpu": raw[:, 1].astype(np.float32),
+            "mem": raw[:, 2].astype(np.float32),
+            "priority": raw[:, 3].astype(np.int32),
+            "group_id": raw[:, 4].astype(np.int32),
+            "app_id": raw[:, 5].astype(np.int32),
+            "tolerates": raw[:, 6].astype(np.int32),
+            "duration": raw[:, 7].astype(np.float32),
+        }
+    return encoded_from_cols(spec, cols)
 
 
 def make_borg_trace(spec) -> Tuple[Cluster, List[Pod]]:

@@ -218,7 +218,6 @@ class JaxReplayEngine:
         self.chunk_waves = chunk_waves
         self.dc = T.DevCluster.from_encoded(ec)
         self.waves = pack_waves(pods, wave_width)
-        self.D = max(ec.max_domains, 1)
         self.chunk_fn = make_chunk_fn(wave_width, self.spec)
 
     def _init_dev_state(self) -> T.DevState:

@@ -34,10 +34,17 @@ def pack_waves(
     ep: EncodedPods, wave_width: int = 8, order: Optional[np.ndarray] = None
 ) -> WaveBatch:
     """Pack schedulable pods into waves. ``order`` defaults to arrival order
-    of unbound pods (stable; deterministic)."""
+    of unbound pods (stable; deterministic). Uses the native C++ packer
+    (kubernetes_simulator_tpu.native) when available — ~40× faster at 1M
+    pods; this Python path is the semantic reference and fallback."""
     if order is None:
         unbound = np.nonzero(ep.bound_node == PAD)[0]
         order = unbound[np.argsort(ep.arrival[unbound], kind="stable")]
+    from ..native import pack_waves_native
+
+    idx_native = pack_waves_native(np.asarray(order), ep.group_id, wave_width)
+    if idx_native is not None:
+        return WaveBatch(idx=idx_native, wave_width=wave_width)
     members: Dict[int, List[int]] = {}
     for p in order:
         g = int(ep.group_id[p])

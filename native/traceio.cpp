@@ -1,0 +1,145 @@
+// Native trace loader — columnar CSV ingest for the trace-replay driver
+// (SURVEY.md §2 L5: "Ingest Google Borg 2019 trace ... columnar ETL").
+//
+// Format (one task event per line, header optional, '#' comments skipped):
+//   arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s
+// group_id -1 = no alloc-set (gang); app_id selects the workload template;
+// tolerates in {0,1}.
+//
+// The whole file is slurped and parsed in one pass into caller-provided
+// columnar buffers — the C++ twin of a pandas read_csv that would otherwise
+// dominate 1M-task replay startup.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct FileBuf {
+  char* data = nullptr;
+  int64_t size = 0;
+  ~FileBuf() { std::free(data); }
+};
+
+bool slurp(const char* path, FileBuf* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (sz < 0) {
+    std::fclose(f);
+    return false;
+  }
+  out->data = static_cast<char*>(std::malloc(static_cast<size_t>(sz) + 1));
+  if (!out->data) {
+    std::fclose(f);
+    return false;
+  }
+  size_t rd = std::fread(out->data, 1, static_cast<size_t>(sz), f);
+  std::fclose(f);
+  out->data[rd] = '\0';
+  out->size = static_cast<int64_t>(rd);
+  return true;
+}
+
+inline bool data_line(const char* p) {
+  // Skip blanks, comments, and a header line (starts with a letter).
+  while (*p == ' ' || *p == '\t') ++p;
+  if (*p == '\0' || *p == '\n' || *p == '\r' || *p == '#') return false;
+  return (*p >= '0' && *p <= '9') || *p == '-' || *p == '+' || *p == '.';
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of data rows, or -1 on IO error.
+int64_t ksim_trace_count(const char* path) {
+  FileBuf buf;
+  if (!slurp(path, &buf)) return -1;
+  int64_t rows = 0;
+  char* p = buf.data;
+  while (p < buf.data + buf.size) {
+    char* nl = std::strchr(p, '\n');
+    if (data_line(p)) ++rows;
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return rows;
+}
+
+// Parse into columnar buffers (each sized [max_rows]); returns rows parsed
+// or -1 on IO/format error.
+int64_t ksim_trace_parse(const char* path, int64_t max_rows,
+                         double* arrival, float* cpu, float* mem,
+                         int32_t* priority, int32_t* group_id,
+                         int32_t* app_id, int32_t* tolerates,
+                         float* duration) {
+  FileBuf buf;
+  if (!slurp(path, &buf)) return -1;
+  int64_t row = 0;
+  char* p = buf.data;
+  char* end = buf.data + buf.size;
+  while (p < end && row < max_rows) {
+    char* nl = std::strchr(p, '\n');
+    if (nl) *nl = '\0';
+    if (data_line(p)) {
+      char* q = p;
+      char* next = nullptr;
+      arrival[row] = std::strtod(q, &next);
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      cpu[row] = std::strtof(q, &next);
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      mem[row] = std::strtof(q, &next);
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      priority[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      group_id[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      app_id[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      tolerates[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
+      if (next == q || *next != ',') return -1;
+      q = next + 1;
+      duration[row] = std::strtof(q, &next);
+      if (next == q) return -1;
+      ++row;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return row;
+}
+
+// Columnar CSV writer (round-trip partner of ksim_trace_parse); returns
+// rows written or -1.
+int64_t ksim_trace_write(const char* path, int64_t rows,
+                         const double* arrival, const float* cpu,
+                         const float* mem, const int32_t* priority,
+                         const int32_t* group_id, const int32_t* app_id,
+                         const int32_t* tolerates, const float* duration) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  std::fputs("arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s\n", f);
+  for (int64_t i = 0; i < rows; ++i) {
+    std::fprintf(f, "%.6f,%g,%g,%d,%d,%d,%d,%g\n", arrival[i],
+                 static_cast<double>(cpu[i]), static_cast<double>(mem[i]),
+                 priority[i], group_id[i], app_id[i], tolerates[i],
+                 static_cast<double>(duration[i]));
+  }
+  std::fclose(f);
+  return rows;
+}
+
+}  // extern "C"

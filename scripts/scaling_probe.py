@@ -1,0 +1,38 @@
+"""Perf probe: how does per-pod step cost scale with S (scenarios) and N
+(nodes)? Finds whether the wave scan is latency- or compute-bound."""
+import time
+
+import numpy as np
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+
+def probe(nodes, pods_n, S, chunk_waves=256):
+    cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
+    pods, _ = make_workload(
+        pods_n, seed=0, with_affinity=True, with_spread=True, with_tolerations=True,
+        gang_fraction=0.02, gang_size=4,
+    )
+    ec, ep = encode(cluster, pods)
+    scenarios = uniform_scenarios(ec, S, seed=0)
+    eng = WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=chunk_waves)
+    eng.run()  # warmup
+    t0 = time.perf_counter()
+    res = eng.run()
+    wall = time.perf_counter() - t0
+    per_pod_us = wall / pods_n * 1e6
+    print(
+        f"S={S:4d} N={nodes:5d} P={pods_n:6d} G={ec.num_groups:3d} "
+        f"wall={wall:6.2f}s agg={res.placements_per_sec/1e3:8.1f}k/s "
+        f"us/pod-step={per_pod_us:7.1f}"
+    , flush=True)
+
+
+if __name__ == "__main__":
+    for S in (8, 32, 128, 256):
+        probe(2000, 10_000, S)
+    probe(10_000, 10_000, 32)
+    probe(10_000, 10_000, 128)
