@@ -549,6 +549,260 @@ def apply_binding(
     )
 
 
+# ---------------------------------------------------------------------------
+# Fused wave evaluation (the hot path)
+#
+# The naive per-pod chain (eval_pod in sim.jax_runtime) issues ~30
+# non-fusable ops per pod (einsums + reductions); at ~1-3 µs fixed cost per
+# op inside a TPU scan, the replay is dispatch-latency-bound, not
+# FLOP-bound.  Two fixes, both exact (bit-identical results):
+#
+# 1. Everything state-INDEPENDENT (taint matrices, node-affinity expression
+#    matching, term one-hots, bind vectors) is precomputed for the whole
+#    wave in one batched shot (WavePre) — W pods' worth of the biggest
+#    tensors leave the sequential chain.
+# 2. The per-pod state reads collapse into ONE stacked one-hot matmul
+#    against match_count (+3 small matvecs), and the per-plugin score
+#    normalizations collapse into one stacked masked min+max pair.
+# ---------------------------------------------------------------------------
+
+
+class WavePre(NamedTuple):
+    """Per-wave precomputed tensors (leading axis W). Static widths:
+    A = #required-affinity terms, B = #required-anti terms, SP = #spread
+    constraints; lhs row layout is [A aff | B anti | SP spread | 1 pref]."""
+
+    lhs: jax.Array  # [W, K, G] f32 stacked one-hot rows (K = A+B+SP+1 or 0)
+    gvalid: jax.Array  # [W, KT, N] bool (KT = A+B+SP) domain-valid per term row
+    taint_ok: jax.Array  # [W, N] bool
+    taint_raw: jax.Array  # [W, N] f32 (PreferNoSchedule counts)
+    na_ok: jax.Array  # [W, N] bool
+    na_raw: jax.Array  # [W, N] f32
+    aff_valid: jax.Array  # [W, A] bool
+    aff_selfm: jax.Array  # [W, A] bool (pod matches its own aff term)
+    anti_valid: jax.Array  # [W, B] bool
+    sp_valid: jax.Array  # [W, SP] bool
+    sp_dns: jax.Array  # [W, SP] bool (valid & DoNotSchedule)
+    sp_selfm: jax.Array  # [W, SP] f32
+    sp_skew: jax.Array  # [W, SP] f32
+    pmg_f: jax.Array  # [W, G] f32
+
+
+def wave_widths(s: "PodSlot", spec) -> tuple:
+    """(A, B, SP) static term widths after spec gating."""
+    A = s.aff_req.shape[-1] if spec.interpod else 0
+    B = s.anti_req.shape[-1] if spec.interpod else 0
+    SP = s.spread_g.shape[-1] if spec.spread else 0
+    return A, B, SP
+
+
+def build_wave_pre(dc: DevCluster, d: Derived, sb: PodSlot, spec) -> WavePre:
+    """Batched (over the wave axis) precompute of every state-independent
+    piece of eval. ``sb`` fields carry a leading W axis."""
+    W = sb.pod_id.shape[0]
+    G = d.gdom_f.shape[0]
+    N = d.gdom_f.shape[1]
+    A, B, SP = wave_widths(sb, spec)
+    pmg_f = sb.pmg.astype(jnp.float32)  # [W, G]
+
+    pieces = []
+    if spec.interpod:
+        ohA = _term_onehot(sb.aff_req, G)  # [W, A, G]
+        ohB = _term_onehot(sb.anti_req, G)
+        pieces += [ohA, ohB]
+    else:
+        ohA = jnp.zeros((W, 0, G), jnp.float32)
+        ohB = ohA
+    if spec.spread:
+        ohS = _term_onehot(sb.spread_g, G)
+        pieces.append(ohS)
+    else:
+        ohS = jnp.zeros((W, 0, G), jnp.float32)
+    if spec.interpod:
+        ohP = _term_onehot(sb.pref_aff, G)  # [W, PA, G]
+        wp = jnp.where(sb.pref_aff >= 0, sb.pref_aff_w, 0.0)
+        pref_row = jnp.einsum("wp,wpg->wg", wp, ohP, precision=_HI)[:, None, :]
+        pieces.append(pref_row)
+    lhs = (
+        jnp.concatenate(pieces, axis=1)
+        if pieces
+        else jnp.zeros((W, 0, G), jnp.float32)
+    )
+    terms = lhs[:, : A + B + SP]
+    gvalid = (
+        jnp.einsum(
+            "wkg,gn->wkn", terms, (d.gdom_f >= 0).astype(jnp.float32), precision=_HI
+        )
+        > 0.5
+        if A + B + SP
+        else jnp.zeros((W, 0, N), bool)
+    )
+
+    if spec.taints:
+        taint_ok = jax.vmap(lambda s: taint_mask(dc, s))(sb)
+        taint_raw = jax.vmap(lambda s: taint_prefer_count(dc, s))(sb)
+    else:
+        taint_ok = jnp.ones((W, N), bool)
+        taint_raw = jnp.zeros((W, N), jnp.float32)
+    if spec.node_affinity:
+        na_ok = jax.vmap(lambda s: node_affinity_mask(d, s))(sb)
+        na_raw = jax.vmap(lambda s: node_affinity_score(d, s))(sb)
+    else:
+        na_ok = jnp.ones((W, N), bool)
+        na_raw = jnp.zeros((W, N), jnp.float32)
+
+    return WavePre(
+        lhs=lhs,
+        gvalid=gvalid,
+        taint_ok=taint_ok,
+        taint_raw=taint_raw,
+        na_ok=na_ok,
+        na_raw=na_raw,
+        aff_valid=sb.aff_req[:, :A] >= 0,
+        aff_selfm=jnp.einsum("wag,wg->wa", ohA, pmg_f, precision=_HI) > 0.5,
+        anti_valid=sb.anti_req[:, :B] >= 0,
+        sp_valid=sb.spread_g[:, :SP] >= 0,
+        sp_dns=(sb.spread_g[:, :SP] >= 0) & sb.spread_dns[:, :SP],
+        sp_selfm=jnp.einsum("wag,wg->wa", ohS, pmg_f, precision=_HI),
+        sp_skew=sb.spread_skew[:, :SP].astype(jnp.float32),
+        pmg_f=pmg_f,
+    )
+
+
+def eval_pod_fused(
+    dc: DevCluster,
+    d: Derived,
+    st: DevState,
+    s: PodSlot,
+    p: WavePre,
+    spec,
+    widths: tuple,
+):
+    """Fused Filter+Score for one slot using wave-precomputed tensors.
+    Bit-identical to the reference chain (sim.jax_runtime.eval_pod) — the
+    parity suites pin this. Returns (feasible [N], scores [N], any_f)."""
+    N = dc.allocatable.shape[0]
+    A, B, SP = widths
+    K = p.lhs.shape[0]
+
+    used1 = st.used + s.req[None, :]  # shared by fit mask + fit score
+    feasible = jnp.ones(N, dtype=bool)
+    if spec.fit:
+        feasible = jnp.all(used1 <= dc.allocatable + 1e-6, axis=1)
+    if spec.taints:
+        feasible = feasible & p.taint_ok
+    if spec.node_affinity:
+        feasible = feasible & p.na_ok
+
+    reads = (
+        jnp.einsum("kg,gn->kn", p.lhs, st.match_count, precision=_HI)
+        if K
+        else jnp.zeros((0, N), jnp.float32)
+    )
+    if spec.interpod:
+        if A:
+            totals = jnp.einsum("ag,g->a", p.lhs[:A], st.match_total, precision=_HI)
+            boot = (totals == 0) & p.aff_selfm  # bootstrap self-match
+            term_ok = (reads[:A] >= 1) & p.gvalid[:A]
+            feasible = feasible & jnp.all(
+                jnp.where(p.aff_valid[:, None], term_ok | boot[:, None], True), axis=0
+            )
+        if B:
+            viol = (reads[A : A + B] >= 1) & p.gvalid[A : A + B]
+            feasible = feasible & jnp.all(
+                jnp.where(p.anti_valid[:, None], ~viol, True), axis=0
+            )
+        blocked = (
+            jnp.einsum("g,gn->n", p.pmg_f, st.anti_active, precision=_HI) > 0.5
+        )  # symmetric: anti_active entries are non-negative counts
+        feasible = feasible & ~blocked
+    if spec.spread and SP:
+        cnts = reads[A + B : A + B + SP]  # [SP, N]
+        gval = p.gvalid[A + B : A + B + SP]
+        minv = jnp.min(jnp.where(gval, cnts, jnp.inf), axis=1)
+        has = jnp.isfinite(minv)
+        c_ok = (
+            gval
+            & has[:, None]
+            & (cnts + p.sp_selfm[:, None] - jnp.where(has, minv, 0.0)[:, None]
+               <= p.sp_skew[:, None])
+        )
+        feasible = feasible & jnp.all(
+            jnp.where(p.sp_dns[:, None], c_ok, True), axis=0
+        )
+
+    any_f = jnp.any(feasible)
+
+    # ---- scores: stack raw rows, one masked min+max, per-row normalize ----
+    w = dict(spec.weights)
+    total = jnp.zeros(N, dtype=jnp.float32)
+    if spec.fit and w.get("NodeResourcesFit", 1.0) != 0:
+        rw = np.asarray(spec.resource_weights, dtype=np.float32)
+        if spec.fit_strategy == "LeastAllocated":
+            raw = least_allocated_score(dc, st, s, rw)
+        elif spec.fit_strategy == "MostAllocated":
+            raw = most_allocated_score(dc, st, s, rw)
+        else:
+            raw = requested_to_capacity_ratio_score(
+                dc, st, s, rw, spec.shape_x, spec.shape_y
+            )
+        total = total + w.get("NodeResourcesFit", 1.0) * raw
+
+    # (raw, weight, minmax?, reverse?) rows, in the reference accumulation
+    # order: taint, node-affinity, interpod, spread.
+    rows = []
+    if spec.taints and w.get("TaintToleration", 1.0) != 0:
+        rows.append((p.taint_raw, w.get("TaintToleration", 1.0), False, True))
+    if spec.node_affinity and w.get("NodeAffinity", 1.0) != 0:
+        rows.append((p.na_raw, w.get("NodeAffinity", 1.0), False, False))
+    if spec.interpod and w.get("InterPodAffinity", 1.0) != 0:
+        raw = reads[A + B + SP]
+        if spec.has_symmetric_pref:
+            raw = raw + jnp.einsum("g,gn->n", p.pmg_f, st.pref_wsum, precision=_HI)
+        rows.append((raw, w.get("InterPodAffinity", 1.0), True, False))
+    if spec.spread and w.get("PodTopologySpread", 1.0) != 0:
+        if SP:
+            raw = jnp.sum(
+                jnp.where(
+                    p.sp_valid[:, None],
+                    reads[A + B : A + B + SP] + p.sp_selfm[:, None],
+                    0.0,
+                ),
+                axis=0,
+            )
+        else:
+            raw = jnp.zeros(N, jnp.float32)
+        rows.append((raw, w.get("PodTopologySpread", 1.0), True, True))
+
+    if rows:
+        stack = jnp.stack([r[0] for r in rows])  # [Kn, N]
+        hi = jnp.max(jnp.where(feasible[None, :], stack, -jnp.inf), axis=1)
+        lo = jnp.min(jnp.where(feasible[None, :], stack, jnp.inf), axis=1)
+        for i, (raw, wt, minmax, reverse) in enumerate(rows):
+            if minmax:  # mirror normalize_min_max exactly
+                span = hi[i] - lo[i]
+                ok = any_f & (span > 0)
+                out = jnp.floor(
+                    (raw - jnp.where(ok, lo[i], 0.0))
+                    * (np.float32(MAX_NODE_SCORE) / jnp.where(ok, span, 1.0))
+                )
+                out = jnp.where(ok, out, 0.0)
+                if reverse:
+                    out = jnp.where(ok, np.float32(MAX_NODE_SCORE) - out, 0.0)
+            else:  # mirror normalize_max exactly (raws are non-negative)
+                pos = hi[i] > 0
+                out = jnp.floor(
+                    (raw * np.float32(MAX_NODE_SCORE)) / jnp.where(pos, hi[i], 1.0)
+                )
+                out = jnp.where(pos, out, 0.0)
+                if reverse:
+                    out = jnp.where(
+                        pos, np.float32(MAX_NODE_SCORE) - out, np.float32(MAX_NODE_SCORE)
+                    )
+            total = total + np.float32(wt) * out
+    return feasible, total, any_f
+
+
 def apply_unbind_wave(
     d: Derived, st: DevState, sb: PodSlot, choice: jax.Array, revert: jax.Array
 ) -> DevState:

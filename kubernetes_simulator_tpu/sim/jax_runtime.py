@@ -159,15 +159,28 @@ def make_wave_step(dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSp
 
     ``dc``/``d`` are loop invariants CLOSED OVER, not carried — keeping them
     out of the scan carry stops XLA copying ~10s of MB per iteration (the
-    single biggest perf bug in the earlier [G, D]-carry design)."""
+    single biggest perf bug in the earlier [G, D]-carry design).
+
+    The per-slot evaluation is the fused path (ops.tpu.build_wave_pre +
+    eval_pod_fused): all state-independent tensors are computed for the
+    whole wave in one batched shot, and each slot's sequential chain is
+    ~12 non-fusable ops instead of ~30 — bit-identical to :func:`eval_pod`
+    (pinned by the parity suites)."""
 
     def wave_step(st: T.DevState, slot_batch: T.PodSlot):
+        pre = T.build_wave_pre(dc, d, slot_batch, spec)
+        widths = T.wave_widths(slot_batch, spec)
         choices, placeds = [], []
         for wslot in range(wave_width):
             s = jax.tree.map(lambda a: a[wslot], slot_batch)
-            feasible, scores = eval_pod(dc, d, st, s, spec)
-            node, placed = T.select_node(scores, feasible)
-            placed = placed & s.valid
+            p = jax.tree.map(lambda a: a[wslot], pre)
+            feasible, scores, any_f = T.eval_pod_fused(dc, d, st, s, p, spec, widths)
+            node = jnp.where(
+                any_f,
+                jnp.argmax(jnp.where(feasible, scores, T.NEG_INF)).astype(jnp.int32),
+                PAD,
+            )
+            placed = any_f & s.valid
             st = T.apply_binding(d, st, s, node, placed)
             choices.append(node)
             placeds.append(placed)
