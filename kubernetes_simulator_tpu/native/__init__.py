@@ -35,12 +35,17 @@ def _build_lib() -> Optional[Path]:
     if so.exists() and so.stat().st_mtime >= max(s.stat().st_mtime for s in srcs):
         return so
     _BUILD.mkdir(parents=True, exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(so)] + [
+    # Compile to a process-private path and os.replace into place, so a
+    # concurrent process never dlopens a partially written .so.
+    tmp = _BUILD / f"libksim.{os.getpid()}.so"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(tmp)] + [
         str(s) for s in srcs
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
     except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
         return None
     return so
 
@@ -70,7 +75,7 @@ def _lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
                 ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
             ]
             lib.ksim_trace_write.restype = ctypes.c_int64
@@ -78,7 +83,7 @@ def _lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
                 ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
             ]
             _LIB = lib
@@ -91,6 +96,10 @@ def available() -> bool:
 
 def _i32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
 def pack_waves_native(
@@ -129,8 +138,8 @@ def read_trace_csv(path: str | os.PathLike) -> Optional[dict]:
         "cpu": np.empty(n, np.float32),
         "mem": np.empty(n, np.float32),
         "priority": np.empty(n, np.int32),
-        "group_id": np.empty(n, np.int32),
-        "app_id": np.empty(n, np.int32),
+        "group_id": np.empty(n, np.int64),  # real Borg collection ids > 2^31
+        "app_id": np.empty(n, np.int64),
         "tolerates": np.empty(n, np.int32),
         "duration": np.empty(n, np.float32),
     }
@@ -139,7 +148,7 @@ def read_trace_csv(path: str | os.PathLike) -> Optional[dict]:
         cols["arrival"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         cols["cpu"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         cols["mem"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        _i32p(cols["priority"]), _i32p(cols["group_id"]), _i32p(cols["app_id"]),
+        _i32p(cols["priority"]), _i64p(cols["group_id"]), _i64p(cols["app_id"]),
         _i32p(cols["tolerates"]),
         cols["duration"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
     )
@@ -159,8 +168,8 @@ def write_trace_csv(path: str | os.PathLike, cols: dict) -> bool:
         "cpu": np.ascontiguousarray(cols["cpu"], np.float32),
         "mem": np.ascontiguousarray(cols["mem"], np.float32),
         "priority": np.ascontiguousarray(cols["priority"], np.int32),
-        "group_id": np.ascontiguousarray(cols["group_id"], np.int32),
-        "app_id": np.ascontiguousarray(cols["app_id"], np.int32),
+        "group_id": np.ascontiguousarray(cols["group_id"], np.int64),
+        "app_id": np.ascontiguousarray(cols["app_id"], np.int64),
         "tolerates": np.ascontiguousarray(cols["tolerates"], np.int32),
         "duration": np.ascontiguousarray(cols["duration"], np.float32),
     }
@@ -169,7 +178,7 @@ def write_trace_csv(path: str | os.PathLike, cols: dict) -> bool:
         arrs["arrival"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         arrs["cpu"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         arrs["mem"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        _i32p(arrs["priority"]), _i32p(arrs["group_id"]), _i32p(arrs["app_id"]),
+        _i32p(arrs["priority"]), _i64p(arrs["group_id"]), _i64p(arrs["app_id"]),
         _i32p(arrs["tolerates"]),
         arrs["duration"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
     )

@@ -459,16 +459,36 @@ def requested_to_capacity_ratio_score(
 # Normalization + selection + state update
 # ---------------------------------------------------------------------------
 
+def _normalize_row(raw, lo, hi, any_f, minmax: bool, reverse: bool) -> jax.Array:
+    """The one copy of the normalize arithmetic (mirrors ops.cpu). Callers
+    supply the masked extrema; ``minmax`` picks min-max vs max-only form.
+    For the max-only form, a −inf-filled ``hi`` is equivalent to the CPU
+    path's 0-filled max because raws are non-negative."""
+    if minmax:
+        span = hi - lo
+        ok = any_f & (span > 0)
+        out = jnp.floor(
+            (raw - jnp.where(ok, lo, 0.0))
+            * (np.float32(MAX_NODE_SCORE) / jnp.where(ok, span, 1.0))
+        )
+        out = jnp.where(ok, out, 0.0)
+        if reverse:
+            out = jnp.where(ok, np.float32(MAX_NODE_SCORE) - out, 0.0)
+    else:
+        pos = hi > 0
+        out = jnp.floor((raw * np.float32(MAX_NODE_SCORE)) / jnp.where(pos, hi, 1.0))
+        out = jnp.where(pos, out, 0.0)
+        if reverse:
+            out = jnp.where(
+                pos, np.float32(MAX_NODE_SCORE) - out, np.float32(MAX_NODE_SCORE)
+            )
+    return out.astype(jnp.float32)
+
+
 def normalize_max(raw: jax.Array, feasible: jax.Array, reverse: bool = False) -> jax.Array:
     """Mirror of ops.cpu.normalize_max: floor(raw·100/max), integer scores."""
-    vals = jnp.where(feasible, raw, 0.0)
-    mx = jnp.max(vals)
-    pos = mx > 0
-    out = jnp.floor((raw * np.float32(MAX_NODE_SCORE)) / jnp.where(pos, mx, 1.0))
-    out = jnp.where(pos, out, 0.0)
-    if reverse:
-        out = jnp.where(pos, np.float32(MAX_NODE_SCORE) - out, np.float32(MAX_NODE_SCORE))
-    return out.astype(jnp.float32)
+    mx = jnp.max(jnp.where(feasible, raw, 0.0))
+    return _normalize_row(raw, None, mx, None, False, reverse)
 
 
 def normalize_min_max(raw: jax.Array, feasible: jax.Array, reverse: bool = False) -> jax.Array:
@@ -476,15 +496,7 @@ def normalize_min_max(raw: jax.Array, feasible: jax.Array, reverse: bool = False
     any_f = jnp.any(feasible)
     lo = jnp.min(jnp.where(feasible, raw, jnp.inf)).astype(jnp.float32)
     hi = jnp.max(jnp.where(feasible, raw, -jnp.inf)).astype(jnp.float32)
-    span = hi - lo
-    ok = any_f & (span > 0)
-    out = jnp.floor(
-        (raw - jnp.where(ok, lo, 0.0)) * (np.float32(MAX_NODE_SCORE) / jnp.where(ok, span, 1.0))
-    )
-    out = jnp.where(ok, out, 0.0)
-    if reverse:
-        out = jnp.where(ok, np.float32(MAX_NODE_SCORE) - out, 0.0)
-    return out.astype(jnp.float32)
+    return _normalize_row(raw, lo, hi, any_f, True, reverse)
 
 
 def select_node(scores: jax.Array, feasible: jax.Array):
@@ -779,26 +791,7 @@ def eval_pod_fused(
         hi = jnp.max(jnp.where(feasible[None, :], stack, -jnp.inf), axis=1)
         lo = jnp.min(jnp.where(feasible[None, :], stack, jnp.inf), axis=1)
         for i, (raw, wt, minmax, reverse) in enumerate(rows):
-            if minmax:  # mirror normalize_min_max exactly
-                span = hi[i] - lo[i]
-                ok = any_f & (span > 0)
-                out = jnp.floor(
-                    (raw - jnp.where(ok, lo[i], 0.0))
-                    * (np.float32(MAX_NODE_SCORE) / jnp.where(ok, span, 1.0))
-                )
-                out = jnp.where(ok, out, 0.0)
-                if reverse:
-                    out = jnp.where(ok, np.float32(MAX_NODE_SCORE) - out, 0.0)
-            else:  # mirror normalize_max exactly (raws are non-negative)
-                pos = hi[i] > 0
-                out = jnp.floor(
-                    (raw * np.float32(MAX_NODE_SCORE)) / jnp.where(pos, hi[i], 1.0)
-                )
-                out = jnp.where(pos, out, 0.0)
-                if reverse:
-                    out = jnp.where(
-                        pos, np.float32(MAX_NODE_SCORE) - out, np.float32(MAX_NODE_SCORE)
-                    )
+            out = _normalize_row(raw, lo[i], hi[i], any_f, minmax, reverse)
             total = total + np.float32(wt) * out
     return feasible, total, any_f
 

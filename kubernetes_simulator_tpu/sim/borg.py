@@ -158,20 +158,21 @@ def encoded_from_cols(spec: BorgSpec, cols: dict) -> Tuple[EncodedCluster, Encod
     requests[:, pi] = 1.0
 
     arrival = np.asarray(cols["arrival"], np.float64)
-    group_id = np.asarray(cols["group_id"], np.int32)
+    # int64 until after the remap: real Borg collection ids exceed 2^31.
+    group_raw = np.asarray(cols["group_id"], np.int64)
     duration = np.asarray(cols["duration"], np.float32)
 
     # pg_min_member is indexed by gang id, so external traces with sparse
     # group ids (real Borg collection ids) are remapped to contiguous ids
     # in first-appearance order.
-    mask = group_id >= 0
+    mask = group_raw >= 0
+    group_id = np.full(P, PAD, dtype=np.int32)
     if mask.any():
         uniq, first_idx, inv = np.unique(
-            group_id[mask], return_index=True, return_inverse=True
+            group_raw[mask], return_index=True, return_inverse=True
         )
         rank = np.empty(len(uniq), dtype=np.int32)
         rank[np.argsort(first_idx)] = np.arange(len(uniq), dtype=np.int32)
-        group_id = group_id.copy()
         group_id[mask] = rank[inv]
         gang_sizes = [int(c) for c in np.bincount(group_id[mask], minlength=len(uniq))]
     else:
@@ -244,19 +245,25 @@ def load_trace_csv(path, spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, d
     from ..native import read_trace_csv
 
     cols = read_trace_csv(path)
-    if cols is None:  # pure-python fallback (header optional, as native)
+    if cols is None:  # pure-python fallback, same per-line rule as native
+        def _data_lines(f):
+            # Mirror traceio.cpp data_line(): skip blanks, '#' comments and
+            # any non-numeric (header) line, wherever it appears.
+            for line in f:
+                s = line.lstrip()
+                if s and s[0] != "#" and s[0] in "0123456789-+.":
+                    yield s
+
         with open(path) as f:
-            first = f.readline()
-        skip = 0 if first[:1].lstrip() and first.lstrip()[0] in "0123456789-+." else 1
-        raw = np.genfromtxt(path, delimiter=",", skip_header=skip)
+            raw = np.genfromtxt(_data_lines(f), delimiter=",")
         raw = raw.reshape(-1, 8)
         cols = {
             "arrival": raw[:, 0].astype(np.float64),
             "cpu": raw[:, 1].astype(np.float32),
             "mem": raw[:, 2].astype(np.float32),
             "priority": raw[:, 3].astype(np.int32),
-            "group_id": raw[:, 4].astype(np.int32),
-            "app_id": raw[:, 5].astype(np.int32),
+            "group_id": raw[:, 4].astype(np.int64),
+            "app_id": raw[:, 5].astype(np.int64),
             "tolerates": raw[:, 6].astype(np.int32),
             "duration": raw[:, 7].astype(np.float32),
         }

@@ -175,11 +175,7 @@ def make_wave_step(dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSp
             s = jax.tree.map(lambda a: a[wslot], slot_batch)
             p = jax.tree.map(lambda a: a[wslot], pre)
             feasible, scores, any_f = T.eval_pod_fused(dc, d, st, s, p, spec, widths)
-            node = jnp.where(
-                any_f,
-                jnp.argmax(jnp.where(feasible, scores, T.NEG_INF)).astype(jnp.int32),
-                PAD,
-            )
+            node, _ = T.select_node(scores, feasible)  # XLA CSEs the any()
             placed = any_f & s.valid
             st = T.apply_binding(d, st, s, node, placed)
             choices.append(node)

@@ -77,8 +77,8 @@ int64_t ksim_trace_count(const char* path) {
 // or -1 on IO/format error.
 int64_t ksim_trace_parse(const char* path, int64_t max_rows,
                          double* arrival, float* cpu, float* mem,
-                         int32_t* priority, int32_t* group_id,
-                         int32_t* app_id, int32_t* tolerates,
+                         int32_t* priority, int64_t* group_id,
+                         int64_t* app_id, int32_t* tolerates,
                          float* duration) {
   FileBuf buf;
   if (!slurp(path, &buf)) return -1;
@@ -103,10 +103,12 @@ int64_t ksim_trace_parse(const char* path, int64_t max_rows,
       priority[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
       if (next == q || *next != ',') return -1;
       q = next + 1;
-      group_id[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
+      // 64-bit: real Borg 2019 collection ids exceed 2^31; downstream
+      // remaps sparse ids to contiguous int32 (sim/borg.py).
+      group_id[row] = static_cast<int64_t>(std::strtoll(q, &next, 10));
       if (next == q || *next != ',') return -1;
       q = next + 1;
-      app_id[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
+      app_id[row] = static_cast<int64_t>(std::strtoll(q, &next, 10));
       if (next == q || *next != ',') return -1;
       q = next + 1;
       tolerates[row] = static_cast<int32_t>(std::strtol(q, &next, 10));
@@ -127,19 +129,23 @@ int64_t ksim_trace_parse(const char* path, int64_t max_rows,
 int64_t ksim_trace_write(const char* path, int64_t rows,
                          const double* arrival, const float* cpu,
                          const float* mem, const int32_t* priority,
-                         const int32_t* group_id, const int32_t* app_id,
+                         const int64_t* group_id, const int64_t* app_id,
                          const int32_t* tolerates, const float* duration) {
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
-  std::fputs("arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s\n", f);
-  for (int64_t i = 0; i < rows; ++i) {
-    std::fprintf(f, "%.6f,%g,%g,%d,%d,%d,%d,%g\n", arrival[i],
-                 static_cast<double>(cpu[i]), static_cast<double>(mem[i]),
-                 priority[i], group_id[i], app_id[i], tolerates[i],
-                 static_cast<double>(duration[i]));
+  bool ok = std::fputs(
+                "arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s\n",
+                f) >= 0;
+  for (int64_t i = 0; ok && i < rows; ++i) {
+    ok = std::fprintf(f, "%.6f,%g,%g,%d,%lld,%lld,%d,%g\n", arrival[i],
+                      static_cast<double>(cpu[i]), static_cast<double>(mem[i]),
+                      priority[i], static_cast<long long>(group_id[i]),
+                      static_cast<long long>(app_id[i]), tolerates[i],
+                      static_cast<double>(duration[i])) >= 0;
   }
-  std::fclose(f);
-  return rows;
+  // fclose failure (e.g. ENOSPC on flush) must also fail the write.
+  if (std::fclose(f) != 0) ok = false;
+  return ok ? rows : -1;
 }
 
 }  // extern "C"

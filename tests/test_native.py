@@ -125,6 +125,47 @@ class TestTraceRoundtrip:
         assert ep.num_pods == 2
         np.testing.assert_allclose(ep.arrival, [0.5, 1.5])
 
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_borg_scale_collection_ids(self, tmp_path, monkeypatch, use_native):
+        # Real Borg 2019 collection ids exceed 2^31; both readers must
+        # carry them un-truncated into the contiguous remap.
+        if use_native and not native.available():
+            pytest.skip("native lib unavailable")
+        if not use_native:
+            monkeypatch.setenv("KSIM_NO_NATIVE", "1")
+            monkeypatch.setattr(native, "_LIB", None)
+            monkeypatch.setattr(native, "_TRIED", False)
+        path = tmp_path / "big.csv"
+        g1, g2 = 380618516317, 380618516317 + (1 << 32)  # would collide in int32
+        lines = ["arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s"]
+        for i, g in enumerate([g1, g1, g2, g2, -1]):
+            lines.append(f"{i}.0,1.0,1000.0,100,{g},0,0,60.0")
+        path.write_text("\n".join(lines) + "\n")
+        spec = BorgSpec(nodes=10, tasks=5, seed=0)
+        _, ep, meta = load_trace_csv(path, spec)
+        assert meta["num_gangs"] == 2
+        np.testing.assert_array_equal(ep.group_id, [0, 0, 1, 1, PAD])
+        np.testing.assert_array_equal(ep.pg_min_member, [2, 2])
+
+    def test_comment_then_header_python_fallback(self, tmp_path, monkeypatch):
+        # A '#' comment before the header must not push the header row into
+        # the data (the one-line sniff bug); same rule as the native reader.
+        path = tmp_path / "ch.csv"
+        path.write_text(
+            "# generated\n"
+            "arrival_s,cpu,mem_bytes,priority,group_id,app_id,tolerates,duration_s\n"
+            " 0.5,1.0,1000.0,100,-1,0,0,60.0\n"
+            "1.5,2.0,2000.0,0,-1,1,1,30.0\n"
+        )
+        monkeypatch.setenv("KSIM_NO_NATIVE", "1")
+        monkeypatch.setattr(native, "_LIB", None)
+        monkeypatch.setattr(native, "_TRIED", False)
+        spec = BorgSpec(nodes=5, tasks=2, seed=0)
+        _, ep, _ = load_trace_csv(path, spec)
+        assert ep.num_pods == 2
+        np.testing.assert_allclose(ep.arrival, [0.5, 1.5])
+        assert np.isfinite(ep.arrival).all()
+
     @pytest.mark.skipif(not native.available(), reason="native lib unavailable")
     def test_native_reader_used(self, tmp_path):
         spec = BorgSpec(nodes=10, tasks=100, seed=0)
