@@ -25,7 +25,7 @@ import numpy as np
 def main():
     nodes = int(os.environ.get("BENCH_NODES", 2000))
     pods_n = int(os.environ.get("BENCH_PODS", 20_000))
-    S = int(os.environ.get("BENCH_SCENARIOS", 32))
+    S = int(os.environ.get("BENCH_SCENARIOS", 128))
     cpu_pods = int(os.environ.get("BENCH_CPU_PODS", 2000))
 
     from kubernetes_simulator_tpu.framework.framework import FrameworkConfig

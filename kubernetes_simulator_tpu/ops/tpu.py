@@ -407,20 +407,28 @@ def _int_resource_score(frac: jax.Array, weights) -> jax.Array:
     return jnp.floor(acc / np.float32(wsum))
 
 
-def least_allocated_score(dc: DevCluster, st: DevState, s: PodSlot, weights) -> jax.Array:
+def least_allocated_score_from_used(dc: DevCluster, used: jax.Array, s: PodSlot, weights) -> jax.Array:
     alloc = dc.allocatable
     denom = jnp.where(alloc > 0, alloc, 1.0)
-    frac = jnp.where(alloc > 0, (alloc - st.used - s.req[None, :]) / denom, 0.0)
+    frac = jnp.where(alloc > 0, (alloc - used - s.req[None, :]) / denom, 0.0)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    return _int_resource_score(frac, weights)
+
+
+def least_allocated_score(dc: DevCluster, st: DevState, s: PodSlot, weights) -> jax.Array:
+    return least_allocated_score_from_used(dc, st.used, s, weights)
+
+
+def most_allocated_score_from_used(dc: DevCluster, used: jax.Array, s: PodSlot, weights) -> jax.Array:
+    alloc = dc.allocatable
+    denom = jnp.where(alloc > 0, alloc, 1.0)
+    frac = jnp.where(alloc > 0, (used + s.req[None, :]) / denom, 0.0)
     frac = jnp.clip(frac, 0.0, 1.0)
     return _int_resource_score(frac, weights)
 
 
 def most_allocated_score(dc: DevCluster, st: DevState, s: PodSlot, weights) -> jax.Array:
-    alloc = dc.allocatable
-    denom = jnp.where(alloc > 0, alloc, 1.0)
-    frac = jnp.where(alloc > 0, (st.used + s.req[None, :]) / denom, 0.0)
-    frac = jnp.clip(frac, 0.0, 1.0)
-    return _int_resource_score(frac, weights)
+    return most_allocated_score_from_used(dc, st.used, s, weights)
 
 
 def piecewise_interp_int(util: jax.Array, xs, ys) -> jax.Array:
@@ -438,9 +446,17 @@ def piecewise_interp_int(util: jax.Array, xs, ys) -> jax.Array:
 def requested_to_capacity_ratio_score(
     dc: DevCluster, st: DevState, s: PodSlot, weights, shape_x, shape_y
 ) -> jax.Array:
+    return requested_to_capacity_ratio_score_from_used(
+        dc, st.used, s, weights, shape_x, shape_y
+    )
+
+
+def requested_to_capacity_ratio_score_from_used(
+    dc: DevCluster, used: jax.Array, s: PodSlot, weights, shape_x, shape_y
+) -> jax.Array:
     alloc = dc.allocatable
     denom = jnp.where(alloc > 0, alloc, 1.0)
-    frac = jnp.where(alloc > 0, (st.used + s.req[None, :]) / denom, 0.0)
+    frac = jnp.where(alloc > 0, (used + s.req[None, :]) / denom, 0.0)
     util = jnp.floor(jnp.clip(frac, 0.0, 1.0) * np.float32(100.0))
     score_r = piecewise_interp_int(util, list(shape_x), list(shape_y))
     acc = jnp.zeros(alloc.shape[0], dtype=jnp.float32)

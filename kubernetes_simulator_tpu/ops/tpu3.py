@@ -1,0 +1,972 @@
+"""Scheduling kernels v3 — domain-space state + wave-deferred commits.
+
+Why: profiling the v2 node-space design showed the replay is HBM-bound at
+scale: every pod step streamed the ``[S, G, N]`` count planes ~10× (reads
++ functional rewrites), saturating ~270k placements/s regardless of
+scenario count. v3 restructures the STATE, not the semantics:
+
+- **Domain-space planes** ``[G, Dcap]`` for groups whose topology has few
+  domains (zone/rack): tiny (KBs), so reads are micro-matmuls and commits
+  are dense one-hot adds — no [N]-wide traffic at all.
+- **Host planes** ``[Gh, N]`` only for groups keyed by hostname-scale
+  topologies (domain ≈ node), kept per *referenced plane section* so a
+  trace with no such terms (Borg shape) carries none.
+- **Wave-deferred commits**: within a wave the carried tensors are never
+  rewritten; each pod's evaluation adds exact in-wave correction terms
+  (rank-1 in the bound node / bound domain) for the pods before it, and
+  the wave commits once — with the gang all-or-nothing mask folded in, so
+  rollback is free. ``used`` is read once per pod (the unavoidable fit
+  stream) but written once per wave.
+- **Node-value expansion** of domain-space rows rides a fused masked-sum
+  over the ≤Dcap domains (``val[n] = rows[dom(n)]`` without gathers, which
+  serialize on TPU — measured 100× slower than the arithmetic forms).
+
+Semantics match the v2 chain (ops.tpu.eval_pod_fused) and the CPU oracle:
+same greedy arrival order, same speculative in-wave visibility, same
+normalization arithmetic (shared helpers), same tie-breaks. Pinned by
+tests/test_jax_parity.py (which drives this path) and test_tpu3_equiv.
+
+Exactness caveat: the wave-deferred ``used`` commit sums a wave's requests
+in one reduction instead of v2's per-pod sequential adds. Both are f32
+sums of the same multiset, so results are bit-identical whenever the
+per-node accumulations are exactly representable (bucketed k8s quantities
+— powers-of-two multiples — at realistic magnitudes are); a pathological
+trace mixing ~2^24-ulp-apart magnitudes on one node could flip a
+floor-quantized score by one. The parity suites pin equality on realistic
+traces; whatif batches pick v2/v3 per batch (labels_dirty), so keep that
+caveat in mind when comparing across batches at extreme magnitudes.
+
+Not supported here (callers fall back to v2): scenario batches whose
+label perturbations change topology domains (whatif ``labels_dirty``) —
+v3 shares the node→domain tables across scenarios.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from . import tpu as T2
+from .tpu import (
+    DevCluster,
+    Derived,
+    PodSlot,
+    _HI,
+    _normalize_row,
+    _term_onehot,
+    select_node,
+)
+
+# ---------------------------------------------------------------------------
+# Static (per-trace) structure
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class V3Static:
+    """Host-side, numpy. Row layout over the unified term axis KT:
+    [A aff | B anti | SP spread | PA pref | MA sym-anti | MP sym-pref];
+    every row is one (group, plane) read. Sections read planes:
+    aff/anti/spread/pref → match-count; sym-anti → anti; sym-pref → pref."""
+
+    A: int
+    B: int
+    SP: int
+    PA: int
+    MA: int
+    MP: int
+    # Static maintenance gates: a plane is carried only if some row can
+    # ever read it (match counts also need A>0 for bootstrap totals).
+    maintain_mc: bool
+    maintain_anti: bool
+    maintain_pref: bool
+    Dcap: int  # max #domains over coarse groups (≥1)
+    G: int
+    is_host: np.ndarray  # [G] bool — hostname-scale topology
+    nd_g: np.ndarray  # [G] i32 — #domains of each group's topology
+    single_g: np.ndarray  # [G] bool — every domain holds exactly one node
+    # (hostname). Host commits then collapse to bound-node one-hots; host
+    # groups over multi-node domains need the dom-equality commit path.
+    # Host-plane group lists per plane kind (global group ids).
+    mc_h_ids: np.ndarray  # [Hmc]
+    anti_h_ids: np.ndarray  # [Ha]
+    pref_h_ids: np.ndarray  # [Hp]
+    g2mc_h: np.ndarray  # [G] local id or -1
+    g2anti_h: np.ndarray
+    g2pref_h: np.ndarray
+    # Per-pod matched-group index lists for the symmetric checks,
+    # restricted to groups actually referenced by anti/pref terms.
+    anti_midx: np.ndarray  # [P, MA]
+    pref_midx: np.ndarray  # [P, MP]
+    has_gangs: bool
+    # Toleration / node-affinity equivalence classes: pods sharing identical
+    # term rows share one per-chunk [N] mask+raw (C ≪ P in real traces, e.g.
+    # one class per workload template). class id PAD → fall back row 0 is a
+    # never-used zero row only when C == 0.
+    tol_class: np.ndarray  # [P] i32
+    tol_rep: np.ndarray  # [Ct] i32 representative pod index per class
+    na_class: np.ndarray  # [P] i32
+    na_rep: np.ndarray  # [Cn] i32
+
+    @property
+    def KT(self) -> int:
+        return self.A + self.B + self.SP + self.PA + self.MA + self.MP
+
+    @property
+    def has_host_rows(self) -> bool:
+        """Any term row can hit a host plane (else the host-value paths
+        compile away entirely)."""
+        return bool(len(self.mc_h_ids) or len(self.anti_h_ids) or len(self.pref_h_ids))
+
+    # Class-mask fallback guard: degenerate traces (every pod distinct)
+    # would make the per-chunk class tensors [C, N] bigger than the work
+    # they save; fall back to per-wave vmap evaluation there.
+    MAX_CLASSES = 256
+
+    @property
+    def use_tol_classes(self) -> bool:
+        return 0 < len(self.tol_rep) <= self.MAX_CLASSES
+
+    @property
+    def use_na_classes(self) -> bool:
+        return 0 < len(self.na_rep) <= self.MAX_CLASSES
+
+    @property
+    def sections(self) -> Tuple[int, ...]:
+        """Start offsets of (aff, anti, spread, pref, symanti, sympref, end)."""
+        a = self.A
+        b = a + self.B
+        s = b + self.SP
+        p = s + self.PA
+        ma = p + self.MA
+        return (0, a, b, s, p, ma, ma + self.MP)
+
+    @classmethod
+    def build(
+        cls,
+        ec: EncodedCluster,
+        ep: EncodedPods,
+        spec,
+        dmax_coarse: int = 128,
+    ) -> "V3Static":
+        G = max(ec.num_groups, 1)
+        gt = ec.group_topo[:G] if ec.group_topo.shape[0] >= G else np.full(G, PAD, np.int32)
+        nd_g = np.where(gt >= 0, ec.num_domains[np.clip(gt, 0, None)], 0).astype(np.int32)
+        is_host = nd_g > dmax_coarse
+        Dcap = int(max(nd_g[~is_host].max() if (~is_host).any() else 1, 1))
+        # Per topology: does every domain hold exactly one node?
+        Tn = ec.node_domain.shape[0]
+        topo_single = np.zeros(Tn, bool)
+        for ti in range(Tn):
+            dom = ec.node_domain[ti]
+            labeled = dom[dom >= 0]
+            topo_single[ti] = labeled.size == 0 or (
+                np.bincount(labeled).max() == 1
+            )
+        single_g = np.where(gt >= 0, topo_single[np.clip(gt, 0, None)], True)
+
+        interpod = spec.interpod
+        spread = spec.spread
+        A = ec_width(ep.aff_req) if interpod else 0
+        B = ec_width(ep.anti_req) if interpod else 0
+        SP = ec_width(ep.spread_g) if spread else 0
+        PA = ec_width(ep.pref_aff) if interpod else 0
+
+        pmg = ep.pod_matches_group  # [P, G']
+        Pg = pmg.shape[1]
+        anti_ref = np.zeros(G, bool)
+        pref_ref = np.zeros(G, bool)
+        if interpod:
+            for g in np.unique(ep.anti_req[ep.anti_req >= 0]):
+                anti_ref[g] = True
+            for g in np.unique(ep.pref_aff[ep.pref_aff >= 0]):
+                pref_ref[g] = True
+        anti_midx = _matched_idx(pmg, anti_ref[:Pg]) if interpod else np.zeros((ep.num_pods, 0), np.int32)
+        pref_midx = (
+            _matched_idx(pmg, pref_ref[:Pg])
+            if (interpod and spec.has_symmetric_pref)
+            else np.zeros((ep.num_pods, 0), np.int32)
+        )
+
+        mc_ref = np.zeros(G, bool)  # groups whose match-count a row can read
+        for arr, on in ((ep.aff_req, interpod), (ep.anti_req, interpod),
+                        (ep.spread_g, spread), (ep.pref_aff, interpod)):
+            if on and arr.size:
+                for g in np.unique(arr[arr >= 0]):
+                    mc_ref[g] = True
+        mc_h_ids = np.nonzero(mc_ref & is_host)[0].astype(np.int32)
+        anti_h_ids = np.nonzero(anti_ref & is_host)[0].astype(np.int32)
+        pref_h_ids = np.nonzero(pref_ref & is_host)[0].astype(np.int32)
+
+        def inv(ids):
+            m = np.full(G, -1, np.int32)
+            m[ids] = np.arange(len(ids), dtype=np.int32)
+            return m
+
+        tol_class, tol_rep = _row_classes(
+            np.concatenate([ep.tol_key, ep.tol_kv, ep.tol_effect], axis=1)
+        )
+        na_class, na_rep = _row_classes(
+            np.concatenate(
+                [
+                    ep.na_req.reshape(ep.num_pods, -1),
+                    ep.na_has_req[:, None].astype(np.int32),
+                    ep.na_pref.reshape(ep.num_pods, -1),
+                    ep.na_pref_w.view(np.int32).reshape(ep.num_pods, -1),
+                ],
+                axis=1,
+            )
+        )
+        return cls(
+            tol_class=tol_class, tol_rep=tol_rep,
+            na_class=na_class, na_rep=na_rep,
+            A=A, B=B, SP=SP, PA=PA,
+            MA=anti_midx.shape[1], MP=pref_midx.shape[1],
+            maintain_mc=bool(mc_ref.any()),
+            maintain_anti=bool(anti_midx.shape[1]),
+            maintain_pref=bool(pref_midx.shape[1]),
+            Dcap=Dcap, G=G, is_host=is_host, nd_g=nd_g, single_g=single_g,
+            mc_h_ids=mc_h_ids, anti_h_ids=anti_h_ids, pref_h_ids=pref_h_ids,
+            g2mc_h=inv(mc_h_ids), g2anti_h=inv(anti_h_ids), g2pref_h=inv(pref_h_ids),
+            anti_midx=anti_midx, pref_midx=pref_midx,
+            has_gangs=spec.has_gangs,
+        )
+
+
+def ec_width(arr: np.ndarray) -> int:
+    """Static term width, treating the all-PAD placeholder column as 0."""
+    return arr.shape[1] if arr.size and (arr >= 0).any() else 0
+
+
+def _row_classes(rows: np.ndarray):
+    """(class_of [P] i32, rep [C] i32): group identical rows; rep[c] is the
+    first pod index exhibiting class c."""
+    if rows.shape[0] == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    uniq, first, inv = np.unique(
+        np.ascontiguousarray(rows), axis=0, return_index=True, return_inverse=True
+    )
+    order = np.argsort(first)
+    rank = np.empty(len(uniq), np.int32)
+    rank[order] = np.arange(len(uniq), dtype=np.int32)
+    return rank[inv].astype(np.int32), first[order].astype(np.int32)
+
+
+def _matched_idx(pmg: np.ndarray, ref: np.ndarray) -> np.ndarray:
+    """[P, M] group ids each pod matches, restricted to ``ref`` groups."""
+    sel = pmg & ref[None, :]
+    counts = sel.sum(axis=1)
+    M = int(counts.max()) if counts.size else 0
+    out = np.full((pmg.shape[0], M), PAD, np.int32)
+    for p in np.nonzero(counts)[0]:
+        ids = np.nonzero(sel[p])[0]
+        out[p, : len(ids)] = ids
+    return out
+
+
+def _gdom_table(ec: EncodedCluster, G: int) -> np.ndarray:
+    """[G, N] i32 — domain of node n under group g's topology (PAD=-1).
+    The one shared derivation for Shared3 / from_host / to_host."""
+    gt = np.clip(ec.group_topo[:G], 0, None)
+    return np.where(ec.group_topo[:G, None] >= 0, ec.node_domain[gt], PAD).astype(
+        np.int32
+    )
+
+
+class Shared3(NamedTuple):
+    """Scenario-shared device tensors (v3 requires shared topology)."""
+
+    gdom_f: jax.Array  # [G, N] f32 domain of node n under group g (PAD=-1)
+    coarse_f: jax.Array  # [G] f32 1.0 where coarse
+    mt_mask: jax.Array  # [G] f32 1.0 where group has domains (for totals)
+
+    @classmethod
+    def build(cls, ec: EncodedCluster, st: V3Static) -> "Shared3":
+        return cls(
+            gdom_f=jnp.asarray(_gdom_table(ec, st.G).astype(np.float32)),
+            coarse_f=jnp.asarray((~st.is_host).astype(np.float32)),
+            mt_mask=jnp.asarray((st.nd_g > 0).astype(np.float32)),
+        )
+
+
+class DevState3(NamedTuple):
+    """Carried state. Domain planes are [G, Dcap] (host-group rows stay
+    zero); host planes are [H*, N] per plane kind.
+
+    ``used`` is stored TRANSPOSED [R, N]: with R tiny (3-5), [N, R] minor-R
+    tensors force every fit/score op to carry a dead minor axis; [R, N]
+    planes keep all hot elementwise work at [S, N] shape and let the R loop
+    unroll statically."""
+
+    used: jax.Array  # [R, N] f32
+    mc_dom: jax.Array  # [G, Dcap] f32
+    anti_dom: jax.Array  # [G, Dcap] f32
+    pref_dom: jax.Array  # [G, Dcap] f32
+    mc_host: jax.Array  # [Hmc, N] f32
+    anti_host: jax.Array  # [Ha, N] f32
+    pref_host: jax.Array  # [Hp, N] f32
+    match_total: jax.Array  # [G] f32
+
+    @classmethod
+    def from_host(
+        cls, used: np.ndarray, mc: np.ndarray, aa: np.ndarray, pw: np.ndarray,
+        ec: EncodedCluster, st: V3Static,
+    ) -> "DevState3":
+        """Domain-space host arrays [G, D] (models.state layout) → v3."""
+        G, Dcap = st.G, st.Dcap
+
+        def dom_part(arr):
+            out = np.zeros((G, Dcap), np.float32)
+            w = min(arr.shape[1], Dcap)
+            out[: arr.shape[0], :w] = np.where(st.is_host[: arr.shape[0], None], 0.0, arr[:, :w])
+            return out
+
+        gdom = _gdom_table(ec, G)
+
+        def host_part(arr, ids):
+            out = np.zeros((len(ids), ec.num_nodes), np.float32)
+            for li, g in enumerate(ids):
+                if g < arr.shape[0]:
+                    out[li] = T2.domain_to_node_space(arr[g : g + 1], gdom[g : g + 1])[0]
+            return out
+
+        mt = np.zeros(G, np.float32)
+        mt[: mc.shape[0]] = mc.sum(axis=1)
+        return cls(
+            used=jnp.asarray(np.ascontiguousarray(used.T).astype(np.float32)),
+            mc_dom=jnp.asarray(dom_part(mc)),
+            anti_dom=jnp.asarray(dom_part(aa)),
+            pref_dom=jnp.asarray(dom_part(pw)),
+            mc_host=jnp.asarray(host_part(mc, st.mc_h_ids)),
+            anti_host=jnp.asarray(host_part(aa, st.anti_h_ids)),
+            pref_host=jnp.asarray(host_part(pw, st.pref_h_ids)),
+            match_total=jnp.asarray(mt),
+        )
+
+    def to_host(self, ec: EncodedCluster, st: V3Static, D: int):
+        """v3 → domain-space [G, D] host arrays (checkpoint/result layout)."""
+        gdom = _gdom_table(ec, st.G)
+
+        def back(dom_arr, host_arr, ids):
+            out = np.zeros((st.G, D), np.float32)
+            w = min(st.Dcap, D)
+            out[:, :w] = np.asarray(dom_arr)[:, :w]
+            for li, g in enumerate(ids):
+                out[g] = T2.node_space_to_domain(
+                    np.asarray(host_arr)[li : li + 1], gdom[g : g + 1], D
+                )[0]
+            return out
+
+        return (
+            np.ascontiguousarray(np.asarray(self.used).T),  # back to [N, R]
+            back(self.mc_dom, self.mc_host, st.mc_h_ids),
+            back(self.anti_dom, self.anti_host, st.anti_h_ids),
+            back(self.pref_dom, self.pref_host, st.pref_h_ids),
+        )
+
+
+class SlotExtra(NamedTuple):
+    """v3-only per-slot rows gathered alongside PodSlot."""
+
+    anti_midx: jax.Array  # [MA] i32
+    pref_midx: jax.Array  # [MP] i32
+    tol_class: jax.Array  # i32 scalar
+    na_class: jax.Array  # i32 scalar
+
+
+def gather_extra(st: V3Static, idx: np.ndarray) -> SlotExtra:
+    safe = np.clip(idx, 0, None)
+    ok = (idx >= 0)[..., None]
+    tol_c = st.tol_class[safe] if st.tol_class.size else np.zeros_like(safe)
+    na_c = st.na_class[safe] if st.na_class.size else np.zeros_like(safe)
+    return SlotExtra(
+        anti_midx=jnp.asarray(np.where(ok, st.anti_midx[safe], PAD).astype(np.int32)),
+        pref_midx=jnp.asarray(np.where(ok, st.pref_midx[safe], PAD).astype(np.int32)),
+        tol_class=jnp.asarray(tol_c.astype(np.int32)),
+        na_class=jnp.asarray(na_c.astype(np.int32)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wave machinery
+# ---------------------------------------------------------------------------
+
+
+class WavePre3(NamedTuple):
+    """Per-wave precompute. Scenario-independent unless noted."""
+
+    row_g: jax.Array  # [W, KT] i32 global group id (PAD invalid)
+    oh_row: jax.Array  # [W, KT, G] f32 one-hot
+    coarse_row: jax.Array  # [W, KT] f32 row's group is coarse
+    dmap: jax.Array  # [W, KT, N] f32 node→domain per row (PAD=-1)
+    ov: jax.Array  # [W(j), W(k), KT] f32 bind-of-j → read-of-(k,row) coupling
+    oh_mc_h: jax.Array  # [W, KT, Hmc] f32 host-plane one-hots
+    oh_anti_h: jax.Array  # [W, KT, Ha] f32
+    oh_pref_h: jax.Array  # [W, KT, Hp] f32
+    row_w: jax.Array  # [W, KT] f32 per-row weight (pref rows; 1/0 elsewhere)
+    aff_selfm: jax.Array  # [W, A] bool
+    sp_selfm: jax.Array  # [W, SP] f32
+    sp_skew: jax.Array  # [W, SP] f32
+    sp_dns: jax.Array  # [W, SP] bool
+    pmg_f: jax.Array  # [W, G] f32
+    anti_g: jax.Array  # [W, G] f32 (required-anti term one-hot sums)
+    pref_g: jax.Array  # [W, G] f32 (preferred term weight sums)
+    taint_ok: jax.Array  # [W, N] bool (PER-SCENARIO under vmap)
+    taint_raw: jax.Array  # [W, N] f32 (per-scenario)
+    na_ok: jax.Array  # [W, N] bool (per-scenario)
+    na_raw: jax.Array  # [W, N] f32 (per-scenario)
+
+
+def build_wave_pre3(
+    dc: DevCluster, d: Derived, sh: Shared3, st: V3Static,
+    sb: PodSlot, sx: SlotExtra, spec,
+) -> WavePre3:
+    W = sb.pod_id.shape[0]
+    G = st.G
+    N = sh.gdom_f.shape[1]
+    pmg_f = sb.pmg.astype(jnp.float32)[:, :G] if sb.pmg.shape[1] >= G else jnp.pad(
+        sb.pmg.astype(jnp.float32), ((0, 0), (0, G - sb.pmg.shape[1]))
+    )
+
+    secs = []
+    if st.A:
+        secs.append(sb.aff_req[:, : st.A])
+    if st.B:
+        secs.append(sb.anti_req[:, : st.B])
+    if st.SP:
+        secs.append(sb.spread_g[:, : st.SP])
+    if st.PA:
+        secs.append(sb.pref_aff[:, : st.PA])
+    if st.MA:
+        secs.append(sx.anti_midx)
+    if st.MP:
+        secs.append(sx.pref_midx)
+    row_g = (
+        jnp.concatenate(secs, axis=1) if secs else jnp.zeros((W, 0), jnp.int32)
+    )
+    oh_row = _term_onehot(row_g, G)  # [W, KT, G]
+    coarse_row = jnp.einsum("wkg,g->wk", oh_row, sh.coarse_f, precision=_HI)
+    dmap = jnp.einsum("wkg,gn->wkn", oh_row, sh.gdom_f, precision=_HI)
+    # Rows of PAD groups must read nothing and match no node.
+    dmap = jnp.where((row_g >= 0)[:, :, None], dmap, float(PAD))
+
+    anti_g, pref_g = T2._pod_group_vectors(sb, G)
+
+    # Coupling: how much does pod j's bind add to row (k, r)'s count when
+    # the bound node shares the row-group's domain — per plane kind.
+    kmask = kind_masks(st)
+    ov = (
+        (
+            jnp.einsum("jg,wkg->jwk", pmg_f, oh_row, precision=_HI)
+            * kmask["mc"][None, None, :]
+            + jnp.einsum("jg,wkg->jwk", anti_g, oh_row, precision=_HI)
+            * kmask["anti"][None, None, :]
+            + jnp.einsum("jg,wkg->jwk", pref_g, oh_row, precision=_HI)
+            * kmask["pref"][None, None, :]
+        )
+        if st.KT
+        else jnp.zeros((W, W, 0), jnp.float32)
+    )
+
+    def hostoh(g2local, H):
+        if H == 0:
+            return jnp.zeros((W, st.KT, 0), jnp.float32)
+        loc = jnp.asarray(g2local)  # [G] static table
+        # one-hot over local host ids; zero for coarse/PAD rows
+        lrow = jnp.einsum("wkg,g->wk", oh_row, loc.astype(jnp.float32), precision=_HI)
+        valid = (1.0 - coarse_row) * (row_g >= 0)
+        return (
+            (lrow[:, :, None] == jnp.arange(H)[None, None, :])
+            & (valid > 0.5)[:, :, None]
+        ).astype(jnp.float32)
+
+    # Host reads per plane kind: mask rows to the right sections.
+    oh_mc_h = hostoh(st.g2mc_h, len(st.mc_h_ids)) * kmask["mc"][None, :, None]
+    oh_anti_h = hostoh(st.g2anti_h, len(st.anti_h_ids)) * kmask["anti"][None, :, None]
+    oh_pref_h = hostoh(st.g2pref_h, len(st.pref_h_ids)) * kmask["pref"][None, :, None]
+
+    o0, o1, o2, o3, o4, o5, o6 = st.sections
+    row_w = jnp.ones((W, st.KT), jnp.float32)
+    if st.PA:
+        w = jnp.where(sb.pref_aff[:, : st.PA] >= 0, sb.pref_aff_w[:, : st.PA], 0.0)
+        row_w = row_w.at[:, o3:o4].set(w)
+    row_w = row_w * (row_g >= 0)
+
+    if st.A:
+        ohA = oh_row[:, :o1]
+        aff_selfm = jnp.einsum("wag,wg->wa", ohA, pmg_f, precision=_HI) > 0.5
+    else:
+        aff_selfm = jnp.zeros((W, 0), bool)
+    if st.SP:
+        ohS = oh_row[:, o2:o3]
+        sp_selfm = jnp.einsum("wag,wg->wa", ohS, pmg_f, precision=_HI)
+        sp_skew = sb.spread_skew[:, : st.SP].astype(jnp.float32)
+        sp_dns = (sb.spread_g[:, : st.SP] >= 0) & sb.spread_dns[:, : st.SP]
+    else:
+        sp_selfm = jnp.zeros((W, 0), jnp.float32)
+        sp_skew = jnp.zeros((W, 0), jnp.float32)
+        sp_dns = jnp.zeros((W, 0), bool)
+
+    # Taint/NA per-wave tensors only exist on the non-class fallback path;
+    # with classes the per-chunk [C, N] masks are read via tiny one-hots.
+    if spec.taints and not st.use_tol_classes:
+        taint_ok = jax.vmap(lambda s: T2.taint_mask(dc, s))(sb)
+        taint_raw = jax.vmap(lambda s: T2.taint_prefer_count(dc, s))(sb)
+    else:
+        taint_ok = jnp.ones((W, 1), bool)
+        taint_raw = jnp.zeros((W, 1), jnp.float32)
+    if spec.node_affinity and not st.use_na_classes:
+        na_ok = jax.vmap(lambda s: T2.node_affinity_mask(d, s))(sb)
+        na_raw = jax.vmap(lambda s: T2.node_affinity_score(d, s))(sb)
+    else:
+        na_ok = jnp.ones((W, 1), bool)
+        na_raw = jnp.zeros((W, 1), jnp.float32)
+
+    return WavePre3(
+        row_g=row_g, oh_row=oh_row, coarse_row=coarse_row, dmap=dmap, ov=ov,
+        oh_mc_h=oh_mc_h, oh_anti_h=oh_anti_h, oh_pref_h=oh_pref_h,
+        row_w=row_w, aff_selfm=aff_selfm,
+        sp_selfm=sp_selfm, sp_skew=sp_skew, sp_dns=sp_dns,
+        pmg_f=pmg_f, anti_g=anti_g, pref_g=pref_g,
+        taint_ok=taint_ok, taint_raw=taint_raw, na_ok=na_ok, na_raw=na_raw,
+    )
+
+
+def _fit_score_r(used1_r, alloc_r, weights, strategy, shape_x, shape_y) -> jax.Array:
+    """NodeResourcesFit scoring over per-resource [N] planes, statically
+    unrolled over R. Arithmetic mirrors ops.tpu._int_resource_score /
+    piecewise_interp_int bit-for-bit (same floor chain, same r order)."""
+    N = used1_r[0].shape[0]
+    acc = jnp.zeros(N, jnp.float32)
+    wsum = 0.0
+    for r in range(len(used1_r)):
+        w = float(weights[r])
+        if w == 0:
+            continue
+        alloc = alloc_r[r]
+        denom = jnp.where(alloc > 0, alloc, 1.0)
+        if strategy == "LeastAllocated":
+            frac = jnp.where(alloc > 0, (alloc - used1_r[r]) / denom, 0.0)
+        else:
+            frac = jnp.where(alloc > 0, used1_r[r] / denom, 0.0)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        if strategy in ("LeastAllocated", "MostAllocated"):
+            s = jnp.floor(frac * np.float32(T2.MAX_NODE_SCORE))
+        else:
+            util = jnp.floor(frac * np.float32(100.0))
+            s = T2.piecewise_interp_int(util, list(shape_x), list(shape_y))
+        acc = acc + s * np.float32(w)
+        wsum += w
+    if wsum == 0:
+        return acc
+    return jnp.floor(acc / np.float32(wsum))
+
+
+def _masked_hi_lo(stack: jax.Array, feasible: jax.Array):
+    """(hi, lo) over feasible nodes per row — ONE variadic reduce kernel
+    instead of two passes over the stack."""
+
+    def comb(a, b):
+        return jnp.maximum(a[0], b[0]), jnp.minimum(a[1], b[1])
+
+    hi_in = jnp.where(feasible[None, :], stack, -jnp.inf)
+    lo_in = jnp.where(feasible[None, :], stack, jnp.inf)
+    return jax.lax.reduce(
+        (hi_in, lo_in),
+        (np.float32(-np.inf), np.float32(np.inf)),
+        comb,
+        dimensions=(1,),
+    )
+
+
+def _expand_rows(rows: jax.Array, dom_oh_k: jax.Array) -> jax.Array:
+    """[KT, Dcap] domain rows → [KT, N] node values: one-hot matmul against
+    the per-wave node→domain one-hot (exact selection; rides the MXU —
+    gathers serialize on TPU). PAD map entries have all-zero one-hots → 0."""
+    return jnp.einsum("kd,knd->kn", rows, dom_oh_k, precision=_HI)
+
+
+def class_masks(dc: DevCluster, d: Derived, st: V3Static, spec, rep_slots):
+    """Per-chunk [C, N] taint/NA masks+raws for the toleration / NA
+    equivalence classes (rep_slots: PodSlot of class representatives,
+    gathered host-side at engine build). Computed ONCE per chunk."""
+    tol_reps, na_reps = rep_slots
+    out = {}
+    if spec.taints and st.use_tol_classes:
+        out["tol_ok"] = jax.vmap(lambda s: T2.taint_mask(dc, s))(tol_reps).astype(
+            jnp.float32
+        )
+        out["tol_raw"] = jax.vmap(lambda s: T2.taint_prefer_count(dc, s))(tol_reps)
+    if spec.node_affinity and st.use_na_classes:
+        out["na_ok"] = jax.vmap(lambda s: T2.node_affinity_mask(d, s))(na_reps).astype(
+            jnp.float32
+        )
+        out["na_raw"] = jax.vmap(lambda s: T2.node_affinity_score(d, s))(na_reps)
+    return out
+
+
+def make_wave_step3(
+    dc: DevCluster, d: Derived, sh: Shared3, st: V3Static,
+    wave_width: int, spec, cmasks=None,
+):
+    """Scan body over (PodSlot, SlotExtra) wave batches. Bit-identical to
+    the v2 step; see module docstring for the traffic model. ``cmasks``:
+    per-chunk class masks from :func:`class_masks`."""
+    cmasks = cmasks or {}
+    G = st.G
+    Dcap = st.Dcap
+    o0, o1, o2, o3, o4, o5, o6 = st.sections
+    w_cfg = dict(spec.weights)
+    kmask = kind_masks(st)
+    # Bound-node domain vectors are only needed when some plane is carried.
+    maintain_dom = st.maintain_mc or st.maintain_anti or st.maintain_pref
+
+    def wave_step(carry: DevState3, batch):
+        sb, sx = batch
+        N = dc.allocatable.shape[0]
+        pre = build_wave_pre3(dc, d, sh, st, sb, sx, spec)
+
+        # Wave-start reads (identical for every pod in the wave).
+        if st.KT:
+            lhs_c = pre.oh_row * pre.coarse_row[:, :, None]  # [W, KT, G]
+            rows0 = (
+                jnp.einsum("wkg,gd->wkd", lhs_c * kmask["mc"][None, :, None],
+                           carry.mc_dom, precision=_HI)
+                + jnp.einsum("wkg,gd->wkd", lhs_c * kmask["anti"][None, :, None],
+                             carry.anti_dom, precision=_HI)
+                + jnp.einsum("wkg,gd->wkd", lhs_c * kmask["pref"][None, :, None],
+                             carry.pref_dom, precision=_HI)
+            )  # [W, KT, Dcap]
+            if st.has_host_rows:
+                vals_h0 = jnp.zeros((wave_width, st.KT, N), jnp.float32)
+                if len(st.mc_h_ids):
+                    vals_h0 = vals_h0 + jnp.einsum(
+                        "wkh,hn->wkn", pre.oh_mc_h, carry.mc_host, precision=_HI
+                    )
+                if len(st.anti_h_ids):
+                    vals_h0 = vals_h0 + jnp.einsum(
+                        "wkh,hn->wkn", pre.oh_anti_h, carry.anti_host, precision=_HI
+                    )
+                if len(st.pref_h_ids):
+                    vals_h0 = vals_h0 + jnp.einsum(
+                        "wkh,hn->wkn", pre.oh_pref_h, carry.pref_host, precision=_HI
+                    )
+            totals0 = jnp.einsum("wkg,g->wk", pre.oh_row, carry.match_total, precision=_HI)
+            # Per-wave node→domain one-hot (scenario-shared) for expansion.
+            dom_oh = (
+                pre.dmap[..., None] == jnp.arange(Dcap, dtype=jnp.float32)
+            ).astype(jnp.float32)  # [W, KT, N, Dcap]
+            # #domains per row (for the domain-space spread min).
+            nd_row = jnp.einsum(
+                "wkg,g->wk", pre.oh_row, jnp.asarray(st.nd_g, jnp.float32),
+                precision=_HI,
+            )  # [W, KT]
+        iota_n = jnp.arange(N)
+        R = carry.used.shape[0]
+        choices, placeds, dom_ats = [], [], []
+        for k in range(wave_width):
+            s = jax.tree.map(lambda a: a[k], sb)
+
+            # --- exact in-wave corrections from pods j<k -----------------
+            # One-hots are rebuilt from the chosen-node index inside the
+            # consuming fusions (never materialized as carried values).
+            rows_corr = jnp.zeros((st.KT, Dcap), jnp.float32) if st.KT else None
+            valh_corr = (
+                jnp.zeros((st.KT, N), jnp.float32)
+                if (st.KT and st.has_host_rows)
+                else None
+            )
+            tot_corr = jnp.zeros((st.KT,), jnp.float32) if st.KT else None
+            used_corr_r = [jnp.zeros((N,), jnp.float32) for _ in range(R)]
+            for j in range(k):
+                wj = placeds[j].astype(jnp.float32)
+                oh_j = wj * (iota_n == choices[j]).astype(jnp.float32)
+                for r in range(R):
+                    used_corr_r[r] = used_corr_r[r] + oh_j * sb.req[j, r]
+                if st.KT:
+                    # domain of j's bound node under row (k, r)'s group
+                    domat_r = jnp.einsum(
+                        "g,rg->r", dom_ats[j], pre.oh_row[k], precision=_HI
+                    )  # [KT]
+                    ovr = pre.ov[j, k] * pre.coarse_row[k]  # [KT]
+                    oh_d = (
+                        domat_r[:, None] == jnp.arange(Dcap, dtype=jnp.float32)
+                    ).astype(jnp.float32)
+                    rows_corr = rows_corr + (wj * ovr)[:, None] * oh_d
+                    if st.has_host_rows:
+                        ovh = (
+                            wj
+                            * pre.ov[j, k]
+                            * (1.0 - pre.coarse_row[k])
+                            * (pre.row_g[k] >= 0)
+                            * (domat_r >= 0)
+                        )
+                        # Domain-equality form: credits every node sharing
+                        # the bound node's domain (== the bound node alone
+                        # for singleton/hostname topologies).
+                        valh_corr = valh_corr + ovh[:, None] * (
+                            pre.dmap[k] == domat_r[:, None]
+                        )
+                    tot_corr = tot_corr + wj * pre.ov[j, k] * kmask["mc"] * (
+                        domat_r >= 0
+                    )
+
+            # --- fused Filter + Score (bit-identical to v2) --------------
+            # used1_r = per-resource used-after-this-pod planes, shared by
+            # the fit mask and every fit scoring strategy.
+            used1_r = [
+                carry.used[r] + used_corr_r[r] + s.req[r] for r in range(R)
+            ]
+            alloc_r = [dc.allocatable[:, r] for r in range(R)]
+            feasible = jnp.ones(N, bool)
+            if spec.fit:
+                for r in range(R):
+                    feasible = feasible & (used1_r[r] <= alloc_r[r] + 1e-6)
+            if spec.taints:
+                if st.use_tol_classes:
+                    oh_c = (
+                        jnp.arange(len(st.tol_rep)) == sx.tol_class[k]
+                    ).astype(jnp.float32)
+                    tok_k = (
+                        jnp.einsum("c,cn->n", oh_c, cmasks["tol_ok"], precision=_HI) > 0.5
+                    )
+                    traw_k = jnp.einsum("c,cn->n", oh_c, cmasks["tol_raw"], precision=_HI)
+                else:
+                    tok_k, traw_k = pre.taint_ok[k], pre.taint_raw[k]
+                feasible = feasible & tok_k
+            if spec.node_affinity:
+                if st.use_na_classes:
+                    oh_c = (
+                        jnp.arange(len(st.na_rep)) == sx.na_class[k]
+                    ).astype(jnp.float32)
+                    naok_k = (
+                        jnp.einsum("c,cn->n", oh_c, cmasks["na_ok"], precision=_HI) > 0.5
+                    )
+                    naraw_k = jnp.einsum("c,cn->n", oh_c, cmasks["na_raw"], precision=_HI)
+                else:
+                    naok_k, naraw_k = pre.na_ok[k], pre.na_raw[k]
+                feasible = feasible & naok_k
+
+            # Materialize the shared [N]-planes once: stops XLA from
+            # re-deriving used1/feasible inside every reduce-rooted kernel.
+            used1_r = list(jax.lax.optimization_barrier(tuple(used1_r)))
+            feasible = jax.lax.optimization_barrier(feasible)
+            if st.KT:
+                rows_k = rows0[k] + rows_corr  # [KT, Dcap]
+                vals = _expand_rows(rows_k, dom_oh[k])
+                if st.has_host_rows:
+                    vals = vals + vals_h0[k] + valh_corr
+                gvalid = pre.dmap[k] >= 0  # [KT, N]
+                totals = totals0[k] + tot_corr
+
+            if spec.interpod and st.A:
+                cnt = vals[o0:o1]
+                term_ok = (cnt >= 1) & gvalid[o0:o1]
+                boot = (totals[o0:o1] == 0) & pre.aff_selfm[k]
+                valid = (pre.row_g[k, o0:o1] >= 0)[:, None]
+                feasible = feasible & jnp.all(
+                    jnp.where(valid, term_ok | boot[:, None], True), axis=0
+                )
+            if spec.interpod and st.B:
+                viol = (vals[o1:o2] >= 1) & gvalid[o1:o2]
+                valid = (pre.row_g[k, o1:o2] >= 0)[:, None]
+                feasible = feasible & jnp.all(jnp.where(valid, ~viol, True), axis=0)
+            if spec.interpod and st.MA:
+                blocked = jnp.sum(vals[o4:o5], axis=0) > 0.5
+                feasible = feasible & ~blocked
+            if spec.spread and st.SP:
+                cnts = vals[o2:o3]
+                gval = gvalid[o2:o3]
+                # Min over domains — every existing domain has ≥1 node, so
+                # min over valid domains == min over gvalid nodes. Coarse
+                # rows reduce over [Dcap] (tiny); host rows (domain≈node)
+                # need the node-space min.
+                dval = (
+                    jnp.arange(Dcap, dtype=jnp.float32)[None, :]
+                    < nd_row[k, o2:o3][:, None]
+                )  # [SP, Dcap]
+                minv_dom = jnp.min(
+                    jnp.where(dval, rows_k[o2:o3], jnp.inf), axis=1
+                )
+                if st.has_host_rows:
+                    minv_node = jnp.min(jnp.where(gval, cnts, jnp.inf), axis=1)
+                    minv = jnp.where(
+                        pre.coarse_row[k, o2:o3] > 0.5, minv_dom, minv_node
+                    )
+                else:
+                    minv = minv_dom
+                has = jnp.isfinite(minv)
+                c_ok = (
+                    gval
+                    & has[:, None]
+                    & (cnts + pre.sp_selfm[k][:, None]
+                       - jnp.where(has, minv, 0.0)[:, None]
+                       <= pre.sp_skew[k][:, None])
+                )
+                feasible = feasible & jnp.all(
+                    jnp.where(pre.sp_dns[k][:, None], c_ok, True), axis=0
+                )
+
+            any_f = None  # derived from the hi reduce when rows exist
+            total = jnp.zeros(N, jnp.float32)
+            if spec.fit and w_cfg.get("NodeResourcesFit", 1.0) != 0:
+                rw = np.asarray(spec.resource_weights, dtype=np.float32)
+                raw = _fit_score_r(
+                    used1_r, alloc_r, rw, spec.fit_strategy, spec.shape_x, spec.shape_y
+                )
+                total = total + w_cfg.get("NodeResourcesFit", 1.0) * raw
+            rows_n = []
+            if spec.taints and w_cfg.get("TaintToleration", 1.0) != 0:
+                rows_n.append((traw_k, w_cfg.get("TaintToleration", 1.0), False, True))
+            if spec.node_affinity and w_cfg.get("NodeAffinity", 1.0) != 0:
+                rows_n.append((naraw_k, w_cfg.get("NodeAffinity", 1.0), False, False))
+            if spec.interpod and w_cfg.get("InterPodAffinity", 1.0) != 0:
+                raw = jnp.zeros(dc.allocatable.shape[0], jnp.float32)
+                if st.PA:
+                    raw = raw + jnp.einsum(
+                        "p,pn->n", pre.row_w[k, o3:o4], vals[o3:o4], precision=_HI
+                    )
+                if st.MP:
+                    raw = raw + jnp.sum(vals[o5:o6], axis=0)
+                rows_n.append((raw, w_cfg.get("InterPodAffinity", 1.0), True, False))
+            if spec.spread and w_cfg.get("PodTopologySpread", 1.0) != 0:
+                if st.SP:
+                    raw = jnp.sum(
+                        jnp.where(
+                            (pre.row_g[k, o2:o3] >= 0)[:, None],
+                            vals[o2:o3] + pre.sp_selfm[k][:, None],
+                            0.0,
+                        ),
+                        axis=0,
+                    )
+                else:
+                    raw = jnp.zeros(dc.allocatable.shape[0], jnp.float32)
+                rows_n.append((raw, w_cfg.get("PodTopologySpread", 1.0), True, True))
+            if rows_n:
+                stack = jnp.stack([r[0] for r in rows_n])
+                hi, lo = _masked_hi_lo(stack, feasible)
+                # hi > -inf ⟺ some node is feasible: any() comes free.
+                any_f = hi[0] > -jnp.inf
+                for i, (raw, wt, minmax, reverse) in enumerate(rows_n):
+                    total = total + np.float32(wt) * _normalize_row(
+                        raw, lo[i], hi[i], any_f, minmax, reverse
+                    )
+            else:
+                any_f = jnp.any(feasible)
+
+            node, _ = select_node(total, feasible)
+            placed = any_f & s.valid
+            if maintain_dom:
+                oh_n = ((iota_n == node) & (node >= 0)).astype(jnp.float32)
+                dom_at = jnp.einsum("gn,n->g", sh.gdom_f, oh_n, precision=_HI)
+                # A miss (or padded slot) must not look like domain 0.
+                dom_at = jnp.where(placed, dom_at, float(PAD))
+                dom_ats.append(dom_at)
+            choices.append(node)
+            placeds.append(placed)
+
+        choice = jnp.stack(choices)  # [W]
+        placed = jnp.stack(placeds)  # [W]
+        if st.has_gangs:
+            groups = sb.group
+            same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
+            fail = jnp.any(same & ~placed[None, :], axis=1)
+            final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
+            commit = placed & ~fail
+        else:
+            final = jnp.where(placed, choice, PAD).astype(jnp.int32)
+            commit = placed
+
+        # --- wave-end commit (gang rollback folded into the mask) --------
+        wv = commit.astype(jnp.float32)  # [W]
+        # One-hots rebuilt from chosen-node indices, bf16 operands: exact
+        # (0/1 values), half the einsum traffic of stacked f32 planes.
+        oh_all = (
+            (iota_n[None, :] == choice[:, None]) & (choice[:, None] >= 0)
+        ).astype(jnp.bfloat16)  # [W, N]
+        used = carry.used + jnp.einsum(
+            "w,wn,wr->rn", wv, oh_all, sb.req,
+            precision=_HI, preferred_element_type=jnp.float32,
+        )
+        mc_dom, anti_dom, pref_dom = carry.mc_dom, carry.anti_dom, carry.pref_dom
+        mc_host, anti_host, pref_host = carry.mc_host, carry.anti_host, carry.pref_host
+        match_total = carry.match_total
+        if maintain_dom:
+            dom_all = jnp.stack(dom_ats)  # [W, G]
+            oh_dom = (
+                dom_all[:, :, None] == jnp.arange(Dcap, dtype=jnp.float32)
+            ).astype(jnp.float32)  # [W, G, Dcap]
+            cf = sh.coarse_f[None, :]
+
+            def dom_commit(plane, vec):
+                return plane + jnp.einsum(
+                    "w,wg,wgd->gd", wv, vec * cf, oh_dom, precision=_HI
+                )
+
+            if st.maintain_mc:
+                mc_dom = dom_commit(carry.mc_dom, pre.pmg_f)
+            if st.maintain_anti:
+                anti_dom = dom_commit(carry.anti_dom, pre.anti_g)
+            if st.maintain_pref:
+                pref_dom = dom_commit(carry.pref_dom, pre.pref_g)
+            if st.A:
+                has_dom = (dom_all >= 0).astype(jnp.float32)  # [W, G]
+                match_total = carry.match_total + jnp.einsum(
+                    "w,wg->g", wv, pre.pmg_f * has_dom, precision=_HI
+                )
+
+        def host_commit(plane, vec, ids):
+            vh = vec[:, jnp.asarray(ids)]  # [W, H]
+            if st.single_g[ids].all():
+                # Singleton domains (hostname): the bound node IS the domain.
+                return plane + jnp.einsum(
+                    "w,wh,wn->hn", wv, vh, oh_all,
+                    precision=_HI, preferred_element_type=jnp.float32,
+                )
+            # General path: credit every node in the bound node's domain.
+            gdom_h = sh.gdom_f[jnp.asarray(ids)]  # [H, N] (static row select)
+            dom_at_h = jnp.stack(dom_ats)[:, jnp.asarray(ids)]  # [W, H]
+            for w in range(wave_width):
+                sel = (
+                    (gdom_h == dom_at_h[w][:, None]) & (dom_at_h[w] >= 0)[:, None]
+                ).astype(jnp.float32)
+                plane = plane + (wv[w] * vh[w])[:, None] * sel
+            return plane
+
+        if len(st.mc_h_ids):
+            mc_host = host_commit(carry.mc_host, pre.pmg_f, st.mc_h_ids)
+        if len(st.anti_h_ids):
+            anti_host = host_commit(carry.anti_host, pre.anti_g, st.anti_h_ids)
+        if len(st.pref_h_ids):
+            pref_host = host_commit(carry.pref_host, pre.pref_g, st.pref_h_ids)
+        return (
+            DevState3(
+                used=used, mc_dom=mc_dom, anti_dom=anti_dom, pref_dom=pref_dom,
+                mc_host=mc_host, anti_host=anti_host, pref_host=pref_host,
+                match_total=match_total,
+            ),
+            final,
+        )
+
+    return wave_step
+
+
+def kind_masks(st: V3Static):
+    """[KT] static 0/1 row masks by plane kind (mc/anti/pref sections)."""
+    o0, o1, o2, o3, o4, o5, o6 = st.sections
+    mc = np.zeros(st.KT, np.float32)
+    mc[:o4] = 1.0
+    anti = np.zeros(st.KT, np.float32)
+    anti[o4:o5] = 1.0
+    pref = np.zeros(st.KT, np.float32)
+    pref[o5:o6] = 1.0
+    return {
+        "mc": jnp.asarray(mc),
+        "anti": jnp.asarray(anti),
+        "pref": jnp.asarray(pref),
+    }

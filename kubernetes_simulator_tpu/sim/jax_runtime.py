@@ -89,12 +89,28 @@ class StepSpec:
             ri = ec.vocab._r.get(rname)
             if ri is not None:
                 rw[ri] = w
+        # Static trace gates: a plugin whose terms never occur in the trace
+        # contributes exactly 0 to every mask and normalized score (its raw
+        # is all-zero → normalize yields 0), so disabling it is exact.
+        na_on = "NodeAffinity" in names
+        ip_on = "InterPodAffinity" in names
+        sp_on = "PodTopologySpread" in names
+        if pods is not None:
+            na_on = na_on and bool(
+                pods.na_has_req.any() or (pods.na_pref >= 0).any()
+            )
+            ip_on = ip_on and bool(
+                (pods.aff_req >= 0).any()
+                or (pods.anti_req >= 0).any()
+                or (pods.pref_aff >= 0).any()
+            )
+            sp_on = sp_on and bool((pods.spread_g >= 0).any())
         return cls(
             fit="NodeResourcesFit" in names,
             taints="TaintToleration" in names,
-            node_affinity="NodeAffinity" in names,
-            interpod="InterPodAffinity" in names,
-            spread="PodTopologySpread" in names,
+            node_affinity=na_on,
+            interpod=ip_on,
+            spread=sp_on,
             fit_strategy=fit_strategy,
             weights=tuple(sorted(weights.items())),
             resource_weights=tuple(float(x) for x in rw),
@@ -211,6 +227,35 @@ def make_chunk_fn(wave_width: int, spec: StepSpec):
     return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
+def make_chunk_fn3(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
+    """v3 twin of make_chunk_fn: xs = (slots, extra). ``rep_slots`` are the
+    toleration/NA class-representative PodSlots (host-gathered once); their
+    [C, N] masks are computed per chunk, not per wave."""
+    from ..ops import tpu3 as V3
+
+    def chunk_fn(dc: T.DevCluster, state, slots, extra):
+        d = T.Derived.build(dc)
+        cmasks = V3.class_masks(dc, d, static3, spec, rep_slots)
+        step = V3.make_wave_step3(
+            dc, d, shared3, static3, wave_width, spec, cmasks
+        )
+        state, choices = jax.lax.scan(step, state, (slots, extra))
+        return state, choices
+
+    return jax.jit(chunk_fn, donate_argnums=(1,))
+
+
+def rep_slots_for(static3, pods: EncodedPods):
+    """(tol_reps, na_reps) PodSlot batches of class representatives. Empty
+    gathers when the class path is off — keeps unused (possibly huge)
+    constants out of the jitted closures."""
+    none = np.zeros(0, np.int32)
+    return (
+        T.gather_slots(pods, static3.tol_rep if static3.use_tol_classes else none),
+        T.gather_slots(pods, static3.na_rep if static3.use_na_classes else none),
+    )
+
+
 class JaxReplayEngine:
     def __init__(
         self,
@@ -219,23 +264,45 @@ class JaxReplayEngine:
         config: Optional[FrameworkConfig] = None,
         wave_width: int = 8,
         chunk_waves: int = 2048,
+        engine: str = "v3",
+        dmax_coarse: int = 128,
     ):
+        """``engine``: "v3" (domain-space state, wave-deferred commits — the
+        fast path) or "v2" (node-space planes; also the whatif fallback when
+        label perturbations change topology domains)."""
+        from ..ops import tpu3 as V3
+
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
         self.wave_width = wave_width
         self.chunk_waves = chunk_waves
+        self.engine = engine
         self.dc = T.DevCluster.from_encoded(ec)
         self.waves = pack_waves(pods, wave_width)
-        self.chunk_fn = make_chunk_fn(wave_width, self.spec)
+        if engine == "v3":
+            self.static3 = V3.V3Static.build(ec, pods, self.spec, dmax_coarse)
+            self.shared3 = V3.Shared3.build(ec, self.static3)
+            self.chunk_fn = make_chunk_fn3(
+                self.static3, self.shared3, rep_slots_for(self.static3, pods),
+                wave_width, self.spec,
+            )
+        else:
+            self.chunk_fn = make_chunk_fn(wave_width, self.spec)
 
-    def _init_dev_state(self) -> T.DevState:
+    def _init_dev_state(self):
+        from ..ops import tpu3 as V3
         from ..ops.cpu import _group_dom_per_node
 
         host = init_state(self.ec, self.pods)  # applies pre-bound pods
         gdom = _group_dom_per_node(self.ec)
         self._gdom = gdom
         self._Dhost = host.match_count.shape[1]
+        if self.engine == "v3":
+            return V3.DevState3.from_host(
+                host.used, host.match_count, host.anti_active, host.pref_wsum,
+                self.ec, self.static3,
+            )
         return T.DevState(
             used=jnp.asarray(host.used),
             match_count=jnp.asarray(T.domain_to_node_space(host.match_count, gdom)),
@@ -243,6 +310,18 @@ class JaxReplayEngine:
             pref_wsum=jnp.asarray(T.domain_to_node_space(host.pref_wsum, gdom)),
             match_total=jnp.asarray(host.match_count.sum(axis=1).astype(np.float32)),
         )
+
+    def _save_checkpoint(self, state, cursor: int, all_choices, path: str) -> None:
+        from .checkpoint import ReplayCheckpoint, state_to_checkpoint
+
+        if self.engine == "v3":
+            used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
+            ReplayCheckpoint(
+                used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
+                chunk_cursor=cursor, outs=[np.asarray(o) for o in all_choices],
+            ).save(path)
+        else:
+            state_to_checkpoint(state, self._gdom, self._Dhost, cursor, all_choices).save(path)
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
         """Arrival time of each wave's first valid pod (for timed events)."""
@@ -288,12 +367,20 @@ class JaxReplayEngine:
             idx = np.concatenate(
                 [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)]
             )
+        from ..ops import tpu3 as V3
+
         state = self._init_dev_state()
         all_choices = []
         start_chunk = 0
         if resume and checkpoint_path:
             ck = ReplayCheckpoint.load(checkpoint_path)
-            state = checkpoint_to_state(ck, self._gdom)
+            if self.engine == "v3":
+                state = V3.DevState3.from_host(
+                    ck.used, ck.match_count, ck.anti_active, ck.pref_wsum,
+                    self.ec, self.static3,
+                )
+            else:
+                state = checkpoint_to_state(ck, self._gdom)
             all_choices = [jnp.asarray(o) for o in ck.outs]
             start_chunk = ck.chunk_cursor
         pending_events = sorted(node_events or [], key=lambda e: e.time)
@@ -310,12 +397,14 @@ class JaxReplayEngine:
                     self._apply_node_events(due, saved_alloc)
                     pending_events = pending_events[len(due):]
             slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
-            state, choices = self.chunk_fn(self.dc, state, slots)
+            if self.engine == "v3":
+                extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
+                state, choices = self.chunk_fn(self.dc, state, slots, extra)
+            else:
+                state, choices = self.chunk_fn(self.dc, state, slots)
             all_choices.append(choices)
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
-                state_to_checkpoint(state, self._gdom, self._Dhost, ci + 1, all_choices).save(
-                    checkpoint_path
-                )
+                self._save_checkpoint(state, ci + 1, all_choices, checkpoint_path)
         choices = jax.block_until_ready(jnp.concatenate(all_choices, axis=0))
         wall = time.perf_counter() - t0
         if node_events:
@@ -332,7 +421,13 @@ class JaxReplayEngine:
         placed = int((flat_choice[valid] >= 0).sum())
         to_schedule = int(valid.sum())
 
-        used = np.asarray(state.used)
+        if self.engine == "v3":
+            used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
+        else:
+            used = np.asarray(state.used)
+            mc = T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost)
+            aa = T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost)
+            pw = T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost)
         util = {}
         for rname in ("cpu", "memory"):
             ri = self.ec.vocab._r.get(rname)
@@ -342,10 +437,7 @@ class JaxReplayEngine:
                     u = np.where(alloc > 0, used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
                 util[rname] = float(u.mean())
         host_state = SchedState(
-            used=used,
-            match_count=T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost),
-            anti_active=T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost),
-            pref_wsum=T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost),
+            used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
             bound=assignments.copy(),
         )
         return ReplayResult(

@@ -146,6 +146,9 @@ class ScenarioSet:
                 nd[si, ti] = np.array([rank.get(int(v), PAD) if p else PAD for v, p in zip(vals, present)], np.int32)
                 ndom[si, ti] = len(uniq)
         self.max_domains = max(int(ndom.max()) if ndom.size else 1, ec.max_domains, 1)
+        # v3 requires scenario-shared node→domain tables; label perturbations
+        # that re-derive domains force the v2 (node-space) engine.
+        self.labels_dirty = bool(labels_dirty.any())
 
         self.dc = T.DevCluster(
             allocatable=jnp.asarray(alloc),
@@ -212,26 +215,58 @@ class WhatIfEngine:
                 raise ValueError(f"num scenarios {self.S} must divide over {ndev} devices")
         self.waves = pack_waves(pods, wave_width)
         self.D = max(self.sset.max_domains, 1)
+        # v3 engine unless label perturbations re-derived topology domains.
+        self.engine = "v2" if self.sset.labels_dirty else "v3"
+        if self.engine == "v3":
+            from ..ops import tpu3 as V3
+            from .jax_runtime import rep_slots_for
+
+            self.static3 = V3.V3Static.build(ec, pods, self.spec)
+            self.shared3 = V3.Shared3.build(ec, self.static3)
+            self.rep_slots = rep_slots_for(self.static3, pods)
         self._chunk_fn = self._build_chunk_fn()
 
     def _build_chunk_fn(self):
         collect = self.collect_assignments
         spec, wave_width = self.spec, self.wave_width
 
-        def per_scenario(dc, state, slots):
-            d = T.Derived.build(dc)
-            wave_step = make_wave_step(dc, d, wave_width, spec)
+        if self.engine == "v3":
+            from ..ops import tpu3 as V3
 
-            def step(st, slot_batch):
-                st, choices = wave_step(st, slot_batch)
-                placed_w = jnp.sum((choices >= 0) & slot_batch.valid).astype(jnp.int32)
-                out = choices if collect else placed_w
-                return st, out
+            st3, sh3, reps = self.static3, self.shared3, self.rep_slots
 
-            state, outs = jax.lax.scan(step, state, slots)
-            return state, outs
+            def per_scenario(dc, state, slots, extra):
+                d = T.Derived.build(dc)
+                cmasks = V3.class_masks(dc, d, st3, spec, reps)
+                wave_step = V3.make_wave_step3(
+                    dc, d, sh3, st3, wave_width, spec, cmasks
+                )
 
-        vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None))
+                def step(st, batch):
+                    st, choices = wave_step(st, batch)
+                    placed_w = jnp.sum((choices >= 0) & batch[0].valid).astype(jnp.int32)
+                    out = choices if collect else placed_w
+                    return st, out
+
+                state, outs = jax.lax.scan(step, state, (slots, extra))
+                return state, outs
+
+            vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None, None))
+        else:
+            def per_scenario(dc, state, slots):
+                d = T.Derived.build(dc)
+                wave_step = make_wave_step(dc, d, wave_width, spec)
+
+                def step(st, slot_batch):
+                    st, choices = wave_step(st, slot_batch)
+                    placed_w = jnp.sum((choices >= 0) & slot_batch.valid).astype(jnp.int32)
+                    out = choices if collect else placed_w
+                    return st, out
+
+                state, outs = jax.lax.scan(step, state, slots)
+                return state, outs
+
+            vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None))
 
         if self.mesh is None:
             return jax.jit(vmapped, donate_argnums=(1,))
@@ -241,13 +276,37 @@ class WhatIfEngine:
         shard = NamedSharding(self.mesh, P(SCENARIO_AXIS))
         repl = NamedSharding(self.mesh, P())
         dc_sh = jax.tree.map(lambda _: shard, self.sset.dc)
+        slots_proto = T.gather_slots(self.pods, self.waves.idx[:1])
+        in_sh = [dc_sh, jax.tree.map(lambda _: shard, self._state_proto()),
+                 jax.tree.map(lambda _: repl, slots_proto)]
+        if self.engine == "v3":
+            from ..ops import tpu3 as V3
+
+            in_sh.append(
+                jax.tree.map(
+                    lambda _: repl, V3.gather_extra(self.static3, self.waves.idx[:1])
+                )
+            )
         return jax.jit(
             vmapped,
-            in_shardings=(dc_sh, jax.tree.map(lambda _: shard, T.DevState.init(self.ec)),
-                          jax.tree.map(lambda _: repl, T.gather_slots(self.pods, self.waves.idx[:1]))),
+            in_shardings=tuple(in_sh),
             out_shardings=(shard, shard),
             donate_argnums=(1,),
         )
+
+    def _state_proto(self):
+        if self.engine == "v3":
+            from ..ops import tpu3 as V3
+
+            # Real domain width: host_part indexes planes with actual
+            # domain ids, so width-1 placeholders would go out of bounds.
+            D = max(self.ec.max_domains, 1)
+            z = np.zeros((self.static3.G, D), np.float32)
+            return V3.DevState3.from_host(
+                np.zeros((self.ec.num_nodes, self.ec.num_resources), np.float32),
+                z, z, z, self.ec, self.static3,
+            )
+        return T.DevState.init(self.ec)
 
     def _init_states(self) -> T.DevState:
         self._fork_waves_done = 0
@@ -270,6 +329,16 @@ class WhatIfEngine:
                 self._fork_choices = fork[: self._fork_waves_done]
         else:
             host = init_state(self.ec, self.pods)  # pre-bound pods
+        if self.engine == "v3":
+            from ..ops import tpu3 as V3
+
+            one = V3.DevState3.from_host(
+                host.used, host.match_count, host.anti_active, host.pref_wsum,
+                self.ec, self.static3,
+            )
+            return jax.tree.map(
+                lambda a: jnp.repeat(jnp.asarray(a)[None], self.S, axis=0), one
+            )
         G, D = host.match_count.shape[0], self.D
         # Domain dim may have grown (label perturbations) → pad.
         mc = np.zeros((G, D), np.float32)
@@ -318,7 +387,15 @@ class WhatIfEngine:
             slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
             if self.mesh is not None:
                 slots = replicate_tree(self.mesh, slots)
-            states, out = self._chunk_fn(dc, states, slots)
+            if self.engine == "v3":
+                from ..ops import tpu3 as V3
+
+                extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
+                if self.mesh is not None:
+                    extra = replicate_tree(self.mesh, extra)
+                states, out = self._chunk_fn(dc, states, slots, extra)
+            else:
+                states, out = self._chunk_fn(dc, states, slots)
             outs.append(out)
         jax.block_until_ready(states)
         wall = time.perf_counter() - t0
@@ -345,7 +422,9 @@ class WhatIfEngine:
             assignments = None
             placed = np.concatenate([np.asarray(o) for o in outs], axis=1).sum(axis=1).astype(np.int32)
 
-        used = np.asarray(states.used)  # [S, N, R]
+        used = np.asarray(states.used)  # [S, N, R] (v3 stores [S, R, N])
+        if self.engine == "v3":
+            used = np.transpose(used, (0, 2, 1))
         util = None
         ri = self.ec.vocab._r.get("cpu")
         if ri is not None:
