@@ -33,6 +33,9 @@ class Simulator:
             plugins=plugins, weights=weights, enable_preemption=enable_preemption
         )
         self.engine_kw = engine_kw
+        from .plugins.builtin import inject_default_spread
+
+        inject_default_spread(self.pods, self.config)
         self.ec, self.ep = encode(cluster, self.pods)
 
     def run(self, **replay_kw):

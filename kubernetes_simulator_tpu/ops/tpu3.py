@@ -271,11 +271,10 @@ def _matched_idx(pmg: np.ndarray, ref: np.ndarray) -> np.ndarray:
 
 def _gdom_table(ec: EncodedCluster, G: int) -> np.ndarray:
     """[G, N] i32 — domain of node n under group g's topology (PAD=-1).
-    The one shared derivation for Shared3 / from_host / to_host."""
-    gt = np.clip(ec.group_topo[:G], 0, None)
-    return np.where(ec.group_topo[:G, None] >= 0, ec.node_domain[gt], PAD).astype(
-        np.int32
-    )
+    One derivation, shared with the CPU kernels."""
+    from .cpu import _group_dom_per_node
+
+    return _group_dom_per_node(ec)[:G]
 
 
 class Shared3(NamedTuple):
@@ -356,9 +355,10 @@ class DevState3(NamedTuple):
             out = np.zeros((st.G, D), np.float32)
             w = min(st.Dcap, D)
             out[:, :w] = np.asarray(dom_arr)[:, :w]
+            host_np = np.asarray(host_arr)  # one device→host transfer
             for li, g in enumerate(ids):
                 out[g] = T2.node_space_to_domain(
-                    np.asarray(host_arr)[li : li + 1], gdom[g : g + 1], D
+                    host_np[li : li + 1], gdom[g : g + 1], D
                 )[0]
             return out
 
@@ -923,9 +923,15 @@ def make_wave_step3(
         def host_commit(plane, vec, ids):
             vh = vec[:, jnp.asarray(ids)]  # [W, H]
             if st.single_g[ids].all():
-                # Singleton domains (hostname): the bound node IS the domain.
+                # Singleton domains (hostname): the bound node IS the domain
+                # — but only when it actually carries the topology label
+                # (v2's node_has_dom gate; a partially-labeled topology must
+                # not credit label-less nodes).
+                has_dom_h = (
+                    jnp.stack(dom_ats)[:, jnp.asarray(ids)] >= 0
+                ).astype(jnp.float32)  # [W, H]
                 return plane + jnp.einsum(
-                    "w,wh,wn->hn", wv, vh, oh_all,
+                    "w,wh,wn->hn", wv, vh * has_dom_h, oh_all,
                     precision=_HI, preferred_element_type=jnp.float32,
                 )
             # General path: credit every node in the bound node's domain.

@@ -157,7 +157,9 @@ class PodTopologySpread(Plugin):
 
     name = "PodTopologySpread"
 
-    def __init__(self, ctx: SchedulingContext):
+    def __init__(self, ctx: SchedulingContext, defaultingType=None, defaultConstraints=None):
+        # Defaulting args are consumed pre-encode by inject_default_spread;
+        # accepted here so the KubeSchedulerConfiguration vocabulary parses.
         pass
 
     def filter(self, ctx, st, p):
@@ -200,3 +202,54 @@ def make_plugins(
         factory = PLUGIN_FACTORIES[entry["name"]]
         out.append(factory(ctx, **entry.get("args", {})))
     return out
+
+
+#: kube-scheduler "System" default spreading (KubeSchedulerConfiguration
+#: PodTopologySpreadArgs when defaultingType=System).
+SYSTEM_DEFAULT_SPREAD = [
+    {"maxSkew": 3, "topologyKey": "kubernetes.io/hostname",
+     "whenUnsatisfiable": "ScheduleAnyway"},
+    {"maxSkew": 5, "topologyKey": "topology.kubernetes.io/zone",
+     "whenUnsatisfiable": "ScheduleAnyway"},
+]
+
+
+def inject_default_spread(pods, config) -> None:
+    """Apply PodTopologySpread cluster-default constraints: pods WITHOUT
+    explicit constraints get the plugin-args defaults, selecting on the
+    pod's own labels (the simulator's stand-in for upstream's
+    controller-selector lookup — pods of one controller share labels).
+
+    Config vocabulary mirrors KubeSchedulerConfiguration:
+        plugins:
+        - name: PodTopologySpread
+          args: {defaultingType: System}             # built-in pair
+        # or explicit: args: {defaultConstraints: [{maxSkew: ..., ...}]}
+    No-op unless the plugin entry asks for defaulting (upstream's List
+    defaulting with an empty list)."""
+    from ..models.core import LabelSelector, TopologySpreadConstraint
+
+    entries = config.plugins if config and config.plugins is not None else []
+    constraints = None
+    for e in entries:
+        if e.get("name") != "PodTopologySpread":
+            continue
+        args = e.get("args", {})
+        if args.get("defaultingType") == "System":
+            constraints = SYSTEM_DEFAULT_SPREAD
+        elif args.get("defaultConstraints"):
+            constraints = args["defaultConstraints"]
+    if not constraints:
+        return
+    for p in pods:
+        if p.topology_spread or not p.labels:
+            continue
+        for c in constraints:
+            p.topology_spread.append(
+                TopologySpreadConstraint(
+                    max_skew=int(c["maxSkew"]),
+                    topology_key=c["topologyKey"],
+                    when_unsatisfiable=c.get("whenUnsatisfiable", "ScheduleAnyway"),
+                    label_selector=LabelSelector.make(dict(p.labels)),
+                )
+            )

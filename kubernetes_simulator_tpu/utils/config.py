@@ -190,4 +190,7 @@ def build_case(cfg: SimConfig):
         gang_fraction=wl.gang_fraction,
         gang_size=wl.gang_size,
     )
+    from ..plugins.builtin import inject_default_spread
+
+    inject_default_spread(pods, cfg.framework)
     return cluster, pods

@@ -294,3 +294,59 @@ class TestPodTopologySpread:
         assert m[0] and m[1]
         s = K.spread_score(ec, st, ep, 1)
         assert s[1] < s[0]  # zb less crowded → lower raw (better after reverse)
+
+
+class TestDefaultSpreadConstraints:
+    def test_system_defaulting_injects_and_spreads(self):
+        from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+        from kubernetes_simulator_tpu.models.encode import encode
+        from kubernetes_simulator_tpu.plugins.builtin import inject_default_spread
+        from kubernetes_simulator_tpu.sim.greedy import greedy_replay
+        from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+        from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+        plugins = [
+            {"name": "NodeResourcesFit"},
+            {"name": "PodTopologySpread", "args": {"defaultingType": "System"}},
+        ]
+        cfg = FrameworkConfig(plugins=plugins)
+        cluster = make_cluster(24, seed=11, num_zones=4)
+        pods, _ = make_workload(60, seed=11)
+        assert not any(p.topology_spread for p in pods)
+        inject_default_spread(pods, cfg)
+        # Every labeled pod got the hostname+zone ScheduleAnyway pair.
+        assert all(len(p.topology_spread) == 2 for p in pods)
+        assert all(
+            c.when_unsatisfiable == "ScheduleAnyway"
+            for p in pods for c in p.topology_spread
+        )
+        ec, ep = encode(cluster, pods)
+        cpu = greedy_replay(ec, ep, cfg)
+        dev = JaxReplayEngine(ec, ep, cfg).replay()
+        np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+
+    def test_no_defaulting_without_config(self):
+        from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+        from kubernetes_simulator_tpu.plugins.builtin import inject_default_spread
+        from kubernetes_simulator_tpu.sim.synthetic import make_workload
+
+        pods, _ = make_workload(10, seed=0)
+        inject_default_spread(pods, FrameworkConfig())  # default plugin list
+        assert not any(p.topology_spread for p in pods)
+
+    def test_explicit_default_constraints(self):
+        from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+        from kubernetes_simulator_tpu.plugins.builtin import inject_default_spread
+        from kubernetes_simulator_tpu.sim.synthetic import make_workload
+
+        plugins = [{
+            "name": "PodTopologySpread",
+            "args": {"defaultConstraints": [
+                {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "DoNotSchedule"},
+            ]},
+        }]
+        pods, _ = make_workload(10, seed=0)
+        inject_default_spread(pods, FrameworkConfig(plugins=plugins))
+        assert all(len(p.topology_spread) == 1 for p in pods)
+        assert pods[0].topology_spread[0].when_unsatisfiable == "DoNotSchedule"

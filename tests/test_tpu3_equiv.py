@@ -57,6 +57,38 @@ def test_v3_class_fallback(monkeypatch):
     _assert_same(ec, ep)
 
 
+def test_v3_host_singleton_partial_labels():
+    """Singleton host topology where some nodes LACK the label: binds onto
+    label-less nodes must not credit the host planes (regression: the
+    singleton commit fast path skipped v2's node_has_dom gate, making the
+    symmetric-anti check wrongly block label-less nodes)."""
+    from kubernetes_simulator_tpu.models.core import (
+        Cluster, LabelSelector, Node, Pod, PodAffinitySpec, PodAffinityTerm,
+    )
+
+    key = "custom/slot"
+    nodes = [
+        Node(
+            f"n{i}",
+            capacity={"cpu": 4.0, "memory": 8 * 2**30, "pods": 20},
+            labels=({key: f"s{i}"} if i % 3 != 0 else {}),  # every 3rd bare
+        )
+        for i in range(12)
+    ]
+    anti = PodAffinitySpec(
+        required=(PodAffinityTerm(LabelSelector.make({"app": "a"}), key),)
+    )
+    pods = [
+        Pod(f"p{i}", labels={"app": "a"}, requests={"cpu": 1.0},
+            arrival_time=float(i), pod_anti_affinity=anti)
+        for i in range(20)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    # dmax_coarse=0 forces every topology onto the host-plane path; the
+    # custom key's domains are singletons.
+    _assert_same(ec, ep, dmax_coarse=0)
+
+
 def test_v3_mesh_with_host_planes():
     """Mesh-sharded what-if on a trace whose anti terms ride a hostname
     topology (>128 domains → real host planes). Regression: the sharding
