@@ -33,9 +33,16 @@ class Simulator:
             plugins=plugins, weights=weights, enable_preemption=enable_preemption
         )
         self.engine_kw = engine_kw
-        from .plugins.builtin import inject_default_spread
+        from .plugins.builtin import inject_default_spread, spread_defaulting_configured
 
-        inject_default_spread(self.pods, self.config)
+        if spread_defaulting_configured(self.config):
+            # Deep-copy before injecting so the caller's Pod objects are
+            # never mutated (a second Simulator from the same pods must not
+            # inherit this config's injected constraints).
+            import copy
+
+            self.pods = copy.deepcopy(self.pods)
+            inject_default_spread(self.pods, self.config)
         self.ec, self.ep = encode(cluster, self.pods)
 
     def run(self, **replay_kw):

@@ -112,6 +112,10 @@ class V3Static:
     tol_rep: np.ndarray  # [Ct] i32 representative pod index per class
     na_class: np.ndarray  # [P] i32
     na_rep: np.ndarray  # [Cn] i32
+    # Tier preemption (opt-in; see sim.greedy docstring for the semantics).
+    preemption: bool = False
+    Tt: int = 0  # number of priority tiers (0 = feature off)
+    pod_tier: np.ndarray = None  # [P] i32
 
     @property
     def KT(self) -> int:
@@ -146,6 +150,8 @@ class V3Static:
         ma = p + self.MA
         return (0, a, b, s, p, ma, ma + self.MP)
 
+    MAX_TIERS = 8
+
     @classmethod
     def build(
         cls,
@@ -153,6 +159,7 @@ class V3Static:
         ep: EncodedPods,
         spec,
         dmax_coarse: int = 128,
+        preemption: bool = False,
     ) -> "V3Static":
         G = max(ec.num_groups, 1)
         gt = ec.group_topo[:G] if ec.group_topo.shape[0] >= G else np.full(G, PAD, np.int32)
@@ -222,9 +229,22 @@ class V3Static:
                 axis=1,
             )
         )
-        return cls(
+        Tt = 0
+        pod_tier = np.zeros(ep.num_pods, np.int32)
+        if preemption:
+            from ..sim.greedy import priority_tiers
+
+            tiers, pod_tier = priority_tiers(ep)
+            Tt = len(tiers)
+            if Tt > cls.MAX_TIERS:
+                raise ValueError(
+                    f"device preemption supports <= {cls.MAX_TIERS} priority "
+                    f"tiers; trace has {Tt}"
+                )
+        out = cls(
             tol_class=tol_class, tol_rep=tol_rep,
             na_class=na_class, na_rep=na_rep,
+            preemption=preemption, Tt=Tt, pod_tier=pod_tier,
             A=A, B=B, SP=SP, PA=PA,
             MA=anti_midx.shape[1], MP=pref_midx.shape[1],
             maintain_mc=bool(mc_ref.any()),
@@ -236,6 +256,13 @@ class V3Static:
             anti_midx=anti_midx, pref_midx=pref_midx,
             has_gangs=spec.has_gangs,
         )
+        if preemption and out.has_host_rows:
+            raise ValueError(
+                "device preemption is not supported together with "
+                "hostname-scale topology terms (host planes); use the CPU "
+                "event engine for full kube PostFilter semantics"
+            )
+        return out
 
 
 def ec_width(arr: np.ndarray) -> int:
@@ -310,13 +337,19 @@ class DevState3(NamedTuple):
     anti_host: jax.Array  # [Ha, N] f32
     pref_host: jax.Array  # [Hp, N] f32
     match_total: jax.Array  # [G] f32
+    # Preemption-only planes ([0, ...] when off): non-gang usage / pod
+    # counts by priority tier.
+    used_tier: jax.Array  # [Tt, R, N] f32
+    npods_tier: jax.Array  # [Tt, N] f32
 
     @classmethod
     def from_host(
         cls, used: np.ndarray, mc: np.ndarray, aa: np.ndarray, pw: np.ndarray,
-        ec: EncodedCluster, st: V3Static,
+        ec: EncodedCluster, st: V3Static, ep: Optional[EncodedPods] = None,
     ) -> "DevState3":
-        """Domain-space host arrays [G, D] (models.state layout) → v3."""
+        """Domain-space host arrays [G, D] (models.state layout) → v3.
+        ``ep`` is required when preemption is on (tier planes rebuild from
+        the pre-bound pods)."""
         G, Dcap = st.G, st.Dcap
 
         def dom_part(arr):
@@ -336,6 +369,15 @@ class DevState3(NamedTuple):
 
         mt = np.zeros(G, np.float32)
         mt[: mc.shape[0]] = mc.sum(axis=1)
+        N, R = ec.num_nodes, ec.num_resources
+        used_tier = np.zeros((st.Tt, R, N), np.float32)
+        npods_tier = np.zeros((st.Tt, N), np.float32)
+        if st.Tt and ep is not None:
+            pre = np.nonzero((ep.bound_node >= 0) & (ep.group_id == PAD))[0]
+            for p in pre:
+                t, n = int(st.pod_tier[p]), int(ep.bound_node[p])
+                used_tier[t, :, n] += ep.requests[p]
+                npods_tier[t, n] += 1.0
         return cls(
             used=jnp.asarray(np.ascontiguousarray(used.T).astype(np.float32)),
             mc_dom=jnp.asarray(dom_part(mc)),
@@ -345,6 +387,8 @@ class DevState3(NamedTuple):
             anti_host=jnp.asarray(host_part(aa, st.anti_h_ids)),
             pref_host=jnp.asarray(host_part(pw, st.pref_h_ids)),
             match_total=jnp.asarray(mt),
+            used_tier=jnp.asarray(used_tier),
+            npods_tier=jnp.asarray(npods_tier),
         )
 
     def to_host(self, ec: EncodedCluster, st: V3Static, D: int):
@@ -377,6 +421,7 @@ class SlotExtra(NamedTuple):
     pref_midx: jax.Array  # [MP] i32
     tol_class: jax.Array  # i32 scalar
     na_class: jax.Array  # i32 scalar
+    tier: jax.Array  # i32 scalar (0 when preemption off)
 
 
 def gather_extra(st: V3Static, idx: np.ndarray) -> SlotExtra:
@@ -384,11 +429,13 @@ def gather_extra(st: V3Static, idx: np.ndarray) -> SlotExtra:
     ok = (idx >= 0)[..., None]
     tol_c = st.tol_class[safe] if st.tol_class.size else np.zeros_like(safe)
     na_c = st.na_class[safe] if st.na_class.size else np.zeros_like(safe)
+    tier = st.pod_tier[safe] if st.Tt else np.zeros_like(safe)
     return SlotExtra(
         anti_midx=jnp.asarray(np.where(ok, st.anti_midx[safe], PAD).astype(np.int32)),
         pref_midx=jnp.asarray(np.where(ok, st.pref_midx[safe], PAD).astype(np.int32)),
         tol_class=jnp.asarray(tol_c.astype(np.int32)),
         na_class=jnp.asarray(na_c.astype(np.int32)),
+        tier=jnp.asarray(tier.astype(np.int32)),
     )
 
 
@@ -668,6 +715,28 @@ def make_wave_step3(
             )  # [W, KT]
         iota_n = jnp.arange(N)
         R = carry.used.shape[0]
+        if st.preemption:
+            # Prefix-over-tiers stacks: [Tt+1, ...]; row t = aggregate over
+            # tiers < t (wave-start values; in-wave corrections per pod).
+            pfx_u = [jnp.zeros((R, N), jnp.float32)]
+            pfx_n = [jnp.zeros((N,), jnp.float32)]
+            mts = [jnp.full((N,), -1.0, jnp.float32)]
+            for t in range(st.Tt):
+                pfx_u.append(pfx_u[-1] + carry.used_tier[t])
+                pfx_n.append(pfx_n[-1] + carry.npods_tier[t])
+                mts.append(
+                    jnp.maximum(mts[-1], jnp.where(carry.npods_tier[t] > 0, float(t), -1.0))
+                )
+            pfx_u = jnp.stack(pfx_u)  # [Tt+1, R, N]
+            pfx_n = jnp.stack(pfx_n)  # [Tt+1, N]
+            mts = jnp.stack(mts)  # [Tt+1, N]
+            preempted = jnp.zeros((), bool)
+            ev_node = jnp.asarray(PAD, jnp.int32)
+            ev_tier = jnp.zeros((), jnp.int32)
+            ev_prior = jnp.zeros((), jnp.float32)
+            ev_total = jnp.zeros((), jnp.float32)
+            eu_acc = [jnp.zeros((), jnp.float32) for _ in range(R)]
+            evicted = []  # per-slot "evicted mid-wave" flags
         choices, placeds, dom_ats = [], [], []
         for k in range(wave_width):
             s = jax.tree.map(lambda a: a[k], sb)
@@ -683,11 +752,25 @@ def make_wave_step3(
             )
             tot_corr = jnp.zeros((st.KT,), jnp.float32) if st.KT else None
             used_corr_r = [jnp.zeros((N,), jnp.float32) for _ in range(R)]
+            if st.preemption and k > 0:
+                # An earlier in-wave eviction frees wave-start usage at the
+                # evicted node (evicted slots are excluded below).
+                oh_e = (
+                    preempted.astype(jnp.float32)
+                    * (iota_n == ev_node).astype(jnp.float32)
+                )
+                for r in range(R):
+                    used_corr_r[r] = used_corr_r[r] - eu_acc[r] * oh_e
             for j in range(k):
                 wj = placeds[j].astype(jnp.float32)
-                oh_j = wj * (iota_n == choices[j]).astype(jnp.float32)
+                if st.preemption:
+                    wj_used = wj * (1.0 - evicted[j].astype(jnp.float32))
+                else:
+                    wj_used = wj
+                oh_j = (iota_n == choices[j]).astype(jnp.float32)
                 for r in range(R):
-                    used_corr_r[r] = used_corr_r[r] + oh_j * sb.req[j, r]
+                    used_corr_r[r] = used_corr_r[r] + wj_used * oh_j * sb.req[j, r]
+                # Count corrections below keep evicted slots (phantom rule).
                 if st.KT:
                     # domain of j's bound node under row (k, r)'s group
                     domat_r = jnp.einsum(
@@ -723,10 +806,14 @@ def make_wave_step3(
                 carry.used[r] + used_corr_r[r] + s.req[r] for r in range(R)
             ]
             alloc_r = [dc.allocatable[:, r] for r in range(R)]
+            # Non-fit filters tracked separately: preemption candidacy
+            # reuses them with the fit check replaced by fit-after-evict.
             feasible = jnp.ones(N, bool)
             if spec.fit:
                 for r in range(R):
                     feasible = feasible & (used1_r[r] <= alloc_r[r] + 1e-6)
+            fit_ok = feasible
+            nonfit = jnp.ones(N, bool)
             if spec.taints:
                 if st.use_tol_classes:
                     oh_c = (
@@ -738,7 +825,7 @@ def make_wave_step3(
                     traw_k = jnp.einsum("c,cn->n", oh_c, cmasks["tol_raw"], precision=_HI)
                 else:
                     tok_k, traw_k = pre.taint_ok[k], pre.taint_raw[k]
-                feasible = feasible & tok_k
+                nonfit = nonfit & tok_k
             if spec.node_affinity:
                 if st.use_na_classes:
                     oh_c = (
@@ -750,7 +837,7 @@ def make_wave_step3(
                     naraw_k = jnp.einsum("c,cn->n", oh_c, cmasks["na_raw"], precision=_HI)
                 else:
                     naok_k, naraw_k = pre.na_ok[k], pre.na_raw[k]
-                feasible = feasible & naok_k
+                nonfit = nonfit & naok_k
 
             # Materialize the shared [N]-planes once: stops XLA from
             # re-deriving used1/feasible inside every reduce-rooted kernel.
@@ -769,16 +856,16 @@ def make_wave_step3(
                 term_ok = (cnt >= 1) & gvalid[o0:o1]
                 boot = (totals[o0:o1] == 0) & pre.aff_selfm[k]
                 valid = (pre.row_g[k, o0:o1] >= 0)[:, None]
-                feasible = feasible & jnp.all(
+                nonfit = nonfit & jnp.all(
                     jnp.where(valid, term_ok | boot[:, None], True), axis=0
                 )
             if spec.interpod and st.B:
                 viol = (vals[o1:o2] >= 1) & gvalid[o1:o2]
                 valid = (pre.row_g[k, o1:o2] >= 0)[:, None]
-                feasible = feasible & jnp.all(jnp.where(valid, ~viol, True), axis=0)
+                nonfit = nonfit & jnp.all(jnp.where(valid, ~viol, True), axis=0)
             if spec.interpod and st.MA:
                 blocked = jnp.sum(vals[o4:o5], axis=0) > 0.5
-                feasible = feasible & ~blocked
+                nonfit = nonfit & ~blocked
             if spec.spread and st.SP:
                 cnts = vals[o2:o3]
                 gval = gvalid[o2:o3]
@@ -808,10 +895,11 @@ def make_wave_step3(
                        - jnp.where(has, minv, 0.0)[:, None]
                        <= pre.sp_skew[k][:, None])
                 )
-                feasible = feasible & jnp.all(
+                nonfit = nonfit & jnp.all(
                     jnp.where(pre.sp_dns[k][:, None], c_ok, True), axis=0
                 )
 
+            feasible = fit_ok & nonfit
             any_f = None  # derived from the hi reduce when rows exist
             total = jnp.zeros(N, jnp.float32)
             if spec.fit and w_cfg.get("NodeResourcesFit", 1.0) != 0:
@@ -861,6 +949,72 @@ def make_wave_step3(
 
             node, _ = select_node(total, feasible)
             placed = any_f & s.valid
+            if st.preemption:
+                tier_k = sx.tier[k]  # shared scalar
+                lt_u = jax.lax.dynamic_index_in_dim(
+                    pfx_u, tier_k, axis=0, keepdims=False
+                )  # [R, N] usage of tiers < tier_k (wave start)
+                lt_np = jax.lax.dynamic_index_in_dim(pfx_n, tier_k, 0, False)
+                mt0 = jax.lax.dynamic_index_in_dim(mts, tier_k, 0, False)
+                lt_u_eff = [lt_u[r] for r in range(R)]
+                lt_np_eff = lt_np
+                mt_eff = mt0
+                for j in range(k):
+                    lowmask = (
+                        placeds[j].astype(jnp.float32)
+                        * (sx.tier[j] < tier_k).astype(jnp.float32)
+                        * (sb.group[j] == PAD).astype(jnp.float32)
+                    )
+                    oh_j = lowmask * (iota_n == choices[j]).astype(jnp.float32)
+                    for r in range(R):
+                        lt_u_eff[r] = lt_u_eff[r] + oh_j * sb.req[j, r]
+                    lt_np_eff = lt_np_eff + oh_j
+                    mt_eff = jnp.maximum(
+                        mt_eff, jnp.where(oh_j > 0, sx.tier[j].astype(jnp.float32), -1.0)
+                    )
+                prefit = jnp.ones(N, bool)
+                for r in range(R):
+                    prefit = prefit & (
+                        used1_r[r] - lt_u_eff[r] <= alloc_r[r] + 1e-6
+                    )
+                cand = (
+                    prefit
+                    & nonfit
+                    & (lt_np_eff >= 1)
+                    & ~preempted
+                    & ~any_f
+                    & s.valid
+                    & (s.group == PAD)
+                    & (tier_k > 0)
+                )
+                # Rank (fewest victims, lowest max victim tier, lowest
+                # index) — exact small ints in f32; mirrors sim.greedy.
+                score = lt_np_eff * np.float32(1024.0) + mt_eff
+                pnode = jnp.argmax(jnp.where(cand, -score, -jnp.inf)).astype(jnp.int32)
+                p_ok = jnp.any(cand)
+                evict_k = p_ok & ~any_f & s.valid
+                node = jnp.where(evict_k, pnode, node)
+                placed = placed | evict_k
+                oh_p = evict_k.astype(jnp.float32) * (iota_n == node).astype(jnp.float32)
+                for r in range(R):
+                    eu_acc[r] = jnp.where(
+                        evict_k, jnp.sum(lt_u[r] * oh_p), eu_acc[r]
+                    )
+                ev_prior = jnp.where(evict_k, jnp.sum(lt_np * oh_p), ev_prior)
+                ev_total = jnp.where(evict_k, jnp.sum(lt_np_eff * oh_p), ev_total)
+                ev_node = jnp.where(evict_k, node, ev_node)
+                ev_tier = jnp.where(evict_k, tier_k, ev_tier)
+                preempted = preempted | evict_k
+                # Mark lower-tier non-gang slots already bound there evicted.
+                for j in range(k):
+                    evicted[j] = evicted[j] | (
+                        evict_k
+                        & (choices[j] == node)
+                        & placeds[j]
+                        & (sx.tier[j] < tier_k)
+                        & (sb.group[j] == PAD)
+                    )
+                evicted.append(jnp.zeros((), bool))
             if maintain_dom:
                 oh_n = ((iota_n == node) & (node >= 0)).astype(jnp.float32)
                 dom_at = jnp.einsum("gn,n->g", sh.gdom_f, oh_n, precision=_HI)
@@ -876,23 +1030,58 @@ def make_wave_step3(
             groups = sb.group
             same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
             fail = jnp.any(same & ~placed[None, :], axis=1)
-            final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
             commit = placed & ~fail
         else:
-            final = jnp.where(placed, choice, PAD).astype(jnp.int32)
             commit = placed
+        if st.preemption:
+            evicted_w = jnp.stack(evicted)  # [W]
+            # Phantom rule: counts commit for evicted slots too; usage and
+            # the reported placement do not.
+            commit_used = commit & ~evicted_w
+        else:
+            commit_used = commit
+        final = jnp.where(commit_used, choice, PAD).astype(jnp.int32)
 
         # --- wave-end commit (gang rollback folded into the mask) --------
         wv = commit.astype(jnp.float32)  # [W]
+        wv_used = commit_used.astype(jnp.float32)  # [W]
         # One-hots rebuilt from chosen-node indices, bf16 operands: exact
         # (0/1 values), half the einsum traffic of stacked f32 planes.
         oh_all = (
             (iota_n[None, :] == choice[:, None]) & (choice[:, None] >= 0)
         ).astype(jnp.bfloat16)  # [W, N]
         used = carry.used + jnp.einsum(
-            "w,wn,wr->rn", wv, oh_all, sb.req,
+            "w,wn,wr->rn", wv_used, oh_all, sb.req,
             precision=_HI, preferred_element_type=jnp.float32,
         )
+        used_tier, npods_tier = carry.used_tier, carry.npods_tier
+        if st.preemption:
+            # Eviction: free the wave-start lower-tier usage at the node.
+            oh_e = (
+                preempted.astype(jnp.float32)
+                * (iota_n == ev_node).astype(jnp.float32)
+            )  # [N]
+            used = used - jnp.stack([eu_acc[r] * oh_e for r in range(R)])
+            nong = (sb.group == PAD).astype(jnp.float32)  # [W]
+            tiers_w = sx.tier  # [W] shared
+            new_ut, new_np = [], []
+            for t in range(st.Tt):
+                zmask = (
+                    preempted & (jnp.asarray(t) < ev_tier)
+                ).astype(jnp.float32) * (iota_n == ev_node).astype(jnp.float32)
+                w_t = wv_used * nong * (tiers_w == t).astype(jnp.float32)
+                du = jnp.einsum(
+                    "w,wn,wr->rn", w_t, oh_all, sb.req,
+                    precision=_HI, preferred_element_type=jnp.float32,
+                )
+                dn = jnp.einsum(
+                    "w,wn->n", w_t, oh_all,
+                    precision=_HI, preferred_element_type=jnp.float32,
+                )
+                new_ut.append(carry.used_tier[t] * (1.0 - zmask)[None, :] + du)
+                new_np.append(carry.npods_tier[t] * (1.0 - zmask) + dn)
+            used_tier = jnp.stack(new_ut) if st.Tt else carry.used_tier
+            npods_tier = jnp.stack(new_np) if st.Tt else carry.npods_tier
         mc_dom, anti_dom, pref_dom = carry.mc_dom, carry.anti_dom, carry.pref_dom
         mc_host, anti_host, pref_host = carry.mc_host, carry.anti_host, carry.pref_host
         match_total = carry.match_total
@@ -950,14 +1139,20 @@ def make_wave_step3(
             anti_host = host_commit(carry.anti_host, pre.anti_g, st.anti_h_ids)
         if len(st.pref_h_ids):
             pref_host = host_commit(carry.pref_host, pre.pref_g, st.pref_h_ids)
-        return (
-            DevState3(
-                used=used, mc_dom=mc_dom, anti_dom=anti_dom, pref_dom=pref_dom,
-                mc_host=mc_host, anti_host=anti_host, pref_host=pref_host,
-                match_total=match_total,
-            ),
-            final,
+        new_state = DevState3(
+            used=used, mc_dom=mc_dom, anti_dom=anti_dom, pref_dom=pref_dom,
+            mc_host=mc_host, anti_host=anti_host, pref_host=pref_host,
+            match_total=match_total, used_tier=used_tier, npods_tier=npods_tier,
         )
+        if st.preemption:
+            # Eviction event for the host fix-up walk: victims from PRIOR
+            # waves (ev_prior) are reconstructed deterministically from the
+            # choice log; in-wave victims are already PAD in `final`.
+            return new_state, (
+                final, ev_node, ev_tier,
+                ev_prior.astype(jnp.int32), ev_total.astype(jnp.int32),
+            )
+        return new_state, final
 
     return wave_step
 

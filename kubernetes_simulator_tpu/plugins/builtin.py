@@ -214,6 +214,16 @@ SYSTEM_DEFAULT_SPREAD = [
 ]
 
 
+def spread_defaulting_configured(config) -> bool:
+    """True iff the PodTopologySpread plugin entry asks for defaulting."""
+    for e in (config.plugins if config and config.plugins is not None else []):
+        if e.get("name") == "PodTopologySpread":
+            args = e.get("args", {})
+            if args.get("defaultingType") == "System" or args.get("defaultConstraints"):
+                return True
+    return False
+
+
 def inject_default_spread(pods, config) -> None:
     """Apply PodTopologySpread cluster-default constraints: pods WITHOUT
     explicit constraints get the plugin-args defaults, selecting on the

@@ -2,10 +2,22 @@
 
 Implements EXACTLY the algorithm the JAX engine compiles — arrival-order
 waves, sequential slots with speculative binds, wave-boundary gang
-commit/rollback, no queue/backoff/preemption — but on the host, reusing the
-tested CPU plugin path. This is the parity anchor for the device scan
-(SURVEY.md §4.2): for any workload, `greedy_replay` and the `jax` strategy
-must produce identical placements.
+commit/rollback — but on the host, reusing the tested CPU plugin path.
+This is the parity anchor for the device scan (SURVEY.md §4.2): for any
+workload, `greedy_replay` and the `jax` strategy must produce identical
+placements.
+
+``preemption=True`` adds the greedy engines' TIER preemption (the device
+semantics — NOT kube's minimal-victims PostFilter, which lives in the CPU
+event engine): when a pod is unschedulable, a node may be chosen where
+evicting ALL lower-priority non-gang pods makes it fit (resource fit +
+taint/node-affinity + the count-based masks at their CURRENT, pre-eviction
+values); candidates rank by (fewest victims, lowest max victim tier,
+lowest index). Evicted pods become unplaced and are NOT re-queued, and
+their affinity/spread count contributions are NOT rewound ("phantom
+counts") — aggregate state can't attribute counts to individual victims.
+At most one preemption fires per wave; gang pods neither preempt nor get
+evicted.
 """
 
 from __future__ import annotations
@@ -22,34 +34,108 @@ from .runtime import ReplayResult
 from .waves import WaveBatch, pack_waves
 
 
+def priority_tiers(ep: EncodedPods):
+    """(tiers [T] ascending distinct priorities, pod_tier [P] i32)."""
+    tiers, inv = np.unique(ep.priority, return_inverse=True)
+    return tiers.astype(np.int64), inv.astype(np.int32)
+
+
+def _try_tier_preempt(fw, ec, ep, st, p, pod_tier):
+    """The anchor's preemption decision. Returns (node, victims) or None.
+    Mirrors ops.tpu3's device arithmetic exactly (see module docstring)."""
+    tp = int(pod_tier[p])
+    if ep.group_id[p] != PAD or tp == 0:
+        return None
+    bound = st.bound
+    lower = np.nonzero(
+        (bound >= 0) & (pod_tier < tp) & (ep.group_id == PAD)
+    )[0]
+    if lower.size == 0:
+        return None
+    N = ec.num_nodes
+    victims_n = np.zeros(N, np.int64)
+    np.add.at(victims_n, bound[lower], 1)
+    lower_used = np.zeros((N, ec.num_resources), np.float32)
+    np.add.at(lower_used, bound[lower], ep.requests[lower])
+    # Fit after evict-all-lower (same eps form as ops.cpu.fit_mask).
+    pre_fit = np.all(
+        st.used - lower_used + ep.requests[p][None, :] <= ec.allocatable + 1e-6,
+        axis=1,
+    )
+    # All non-fit filters at their current (pre-eviction) values.
+    masks = np.ones(N, bool)
+    for pl in fw.plugins:
+        if pl.name == "NodeResourcesFit":
+            continue
+        m = pl.filter(fw.ctx, st, p)
+        if m is not None:
+            masks &= m
+    cand = pre_fit & masks & (victims_n > 0)
+    if not cand.any():
+        return None
+    maxtier_n = np.full(N, -1, np.int64)
+    np.maximum.at(maxtier_n, bound[lower], pod_tier[lower].astype(np.int64))
+    score = victims_n * 1024 + maxtier_n
+    score = np.where(cand, score, np.iinfo(np.int64).max)
+    n = int(np.argmin(score))  # lowest index on ties
+    victims = lower[bound[lower] == n]
+    return n, victims
+
+
 def greedy_replay(
     ec: EncodedCluster,
     ep: EncodedPods,
     config: Optional[FrameworkConfig] = None,
     waves: Optional[WaveBatch] = None,
     wave_width: int = 8,
+    preemption: bool = False,
 ) -> ReplayResult:
     config = config or FrameworkConfig()
-    config.enable_preemption = False  # greedy semantics: no PostFilter
+    config.enable_preemption = False  # greedy semantics: no kube PostFilter
     fw = SchedulerFramework(ec, ep, config)
     if waves is None:
         waves = pack_waves(ep, wave_width)
     st = init_state(ec, ep)
-    assignments = np.full(ep.num_pods, PAD, dtype=np.int32)
+    _, pod_tier = priority_tiers(ep)
+    # Pre-bound pods appear in assignments (matching the device engines)
+    # but never count toward placed_total (they were not scheduled here).
+    assignments = np.where(ep.bound_node >= 0, ep.bound_node, PAD).astype(np.int32)
     placed_total = 0
+    preemptions = 0
     t0 = time.perf_counter()
     for wave in waves.idx:
         slot_choice: List[int] = []
         slot_pods: List[int] = []
+        evicted_in_wave: set = set()
+        preempted_this_wave = False
         for p in wave:
             if p < 0:
                 continue
             p = int(p)
             res = fw.schedule_one(st, p)
-            if res.node != PAD:
-                bind(ec, ep, st, p, res.node)
+            node = res.node
+            if node == PAD and preemption and not preempted_this_wave:
+                hit = _try_tier_preempt(fw, ec, ep, st, p, pod_tier)
+                if hit is not None:
+                    node, victims = hit
+                    preempted_this_wave = True
+                    preemptions += len(victims)
+                    for v in victims:
+                        v = int(v)
+                        vn = int(st.bound[v])
+                        # Resources-only unbind: counts stay (phantom).
+                        st.used[vn] -= ep.requests[v]
+                        st.bound[v] = PAD
+                        if assignments[v] >= 0:
+                            assignments[v] = PAD
+                            if ep.bound_node[v] == PAD:  # scheduled here
+                                placed_total -= 1
+                        elif v in slot_pods:
+                            evicted_in_wave.add(v)
+            if node != PAD:
+                bind(ec, ep, st, p, node)
             slot_pods.append(p)
-            slot_choice.append(res.node)
+            slot_choice.append(node)
         # Gang commit: a group fails if ANY member slot went unplaced.
         failed_groups = {
             int(ep.group_id[p])
@@ -57,6 +143,8 @@ def greedy_replay(
             if c == PAD and ep.group_id[p] != PAD
         }
         for p, c in zip(slot_pods, slot_choice):
+            if p in evicted_in_wave:
+                continue  # evicted mid-wave: never committed
             g = int(ep.group_id[p])
             if c != PAD and g in failed_groups:
                 unbind(ec, ep, st, p)
@@ -77,7 +165,7 @@ def greedy_replay(
         assignments=assignments,
         placed=placed_total,
         unschedulable=to_schedule - placed_total,
-        preemptions=0,
+        preemptions=preemptions,
         attempts=to_schedule,
         wall_clock_s=wall,
         placements_per_sec=placed_total / wall if wall > 0 else 0.0,

@@ -245,6 +245,24 @@ def make_chunk_fn3(static3, shared3, rep_slots, wave_width: int, spec: StepSpec)
     return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
+def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray,
+                    ev_node: np.ndarray, ev_tier: np.ndarray,
+                    pod_tier: np.ndarray, nongang: np.ndarray) -> None:
+    """Reconstruct assignments under tier evictions, in place: walk waves
+    in order, unassigning prior-wave lower-tier non-gang victims at each
+    eviction event, then applying the wave's choices (in-wave victims are
+    already PAD in the device output). Shared by the replay engine and the
+    what-if collect path."""
+    for w in range(idx.shape[0]):
+        e = int(ev_node[w])
+        if e >= 0:
+            vict = (assignments == e) & (pod_tier < int(ev_tier[w])) & nongang
+            assignments[vict] = PAD
+        ids = idx[w]
+        ok = ids >= 0
+        assignments[ids[ok]] = finals[w][ok]
+
+
 def rep_slots_for(static3, pods: EncodedPods):
     """(tol_reps, na_reps) PodSlot batches of class representatives. Empty
     gathers when the class path is off — keeps unused (possibly huge)
@@ -266,22 +284,29 @@ class JaxReplayEngine:
         chunk_waves: int = 2048,
         engine: str = "v3",
         dmax_coarse: int = 128,
+        preemption: bool = False,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
-        label perturbations change topology domains)."""
+        label perturbations change topology domains). ``preemption``: the
+        greedy engines' tier preemption (sim.greedy docstring), v3 only."""
         from ..ops import tpu3 as V3
 
+        if preemption and engine != "v3":
+            raise ValueError("device preemption requires engine='v3'")
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
         self.wave_width = wave_width
         self.chunk_waves = chunk_waves
         self.engine = engine
+        self.preemption = preemption
         self.dc = T.DevCluster.from_encoded(ec)
         self.waves = pack_waves(pods, wave_width)
         if engine == "v3":
-            self.static3 = V3.V3Static.build(ec, pods, self.spec, dmax_coarse)
+            self.static3 = V3.V3Static.build(
+                ec, pods, self.spec, dmax_coarse, preemption=preemption
+            )
             self.shared3 = V3.Shared3.build(ec, self.static3)
             self.chunk_fn = make_chunk_fn3(
                 self.static3, self.shared3, rep_slots_for(self.static3, pods),
@@ -301,7 +326,7 @@ class JaxReplayEngine:
         if self.engine == "v3":
             return V3.DevState3.from_host(
                 host.used, host.match_count, host.anti_active, host.pref_wsum,
-                self.ec, self.static3,
+                self.ec, self.static3, ep=self.pods,
             )
         return T.DevState(
             used=jnp.asarray(host.used),
@@ -322,6 +347,18 @@ class JaxReplayEngine:
             ).save(path)
         else:
             state_to_checkpoint(state, self._gdom, self._Dhost, cursor, all_choices).save(path)
+
+    def _preemption_walk(self, idx: np.ndarray, finals: np.ndarray,
+                         ev_node: np.ndarray, ev_tier: np.ndarray):
+        ep = self.pods
+        assignments = np.where(ep.bound_node >= 0, ep.bound_node, PAD).astype(np.int32)
+        preemption_walk(
+            assignments, idx, finals, ev_node, ev_tier,
+            self.static3.pod_tier, ep.group_id == PAD,
+        )
+        scheduled = ep.bound_node == PAD
+        placed = int((assignments[scheduled] >= 0).sum())
+        return assignments, placed
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
         """Arrival time of each wave's first valid pod (for timed events)."""
@@ -359,6 +396,12 @@ class JaxReplayEngine:
         arrival time is past the event time (granularity = chunk_waves; use
         smaller chunks for finer timing)."""
         from .checkpoint import ReplayCheckpoint, checkpoint_to_state, state_to_checkpoint
+
+        if self.preemption and (checkpoint_path or resume):
+            raise ValueError(
+                "checkpoint/resume is not supported with device preemption "
+                "(tier planes are not checkpointed)"
+            )
 
         idx = self.waves.idx
         C = min(self.chunk_waves, max(idx.shape[0], 1))
@@ -405,21 +448,32 @@ class JaxReplayEngine:
             all_choices.append(choices)
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
                 self._save_checkpoint(state, ci + 1, all_choices, checkpoint_path)
-        choices = jax.block_until_ready(jnp.concatenate(all_choices, axis=0))
+        jax.block_until_ready(all_choices[-1] if all_choices else state)
         wall = time.perf_counter() - t0
         if node_events:
             self.dc = self.dc._replace(allocatable=jnp.asarray(saved_alloc))
 
-        choices_np = np.asarray(choices)
-        assignments = np.where(self.pods.bound_node >= 0, self.pods.bound_node, PAD).astype(
-            np.int32
-        )
-        flat_idx = idx.reshape(-1)
-        flat_choice = choices_np.reshape(-1)
-        valid = flat_idx >= 0
-        assignments[flat_idx[valid]] = flat_choice[valid]
-        placed = int((flat_choice[valid] >= 0).sum())
-        to_schedule = int(valid.sum())
+        preemptions = 0
+        to_schedule = int((idx >= 0).sum())
+        if self.preemption:
+            finals = np.concatenate([np.asarray(c[0]) for c in all_choices])
+            ev_node = np.concatenate([np.asarray(c[1]) for c in all_choices])
+            ev_tier = np.concatenate([np.asarray(c[2]) for c in all_choices])
+            ev_total = np.concatenate([np.asarray(c[4]) for c in all_choices])
+            assignments, placed = self._preemption_walk(
+                idx, finals, ev_node, ev_tier
+            )
+            preemptions = int(ev_total.sum())
+        else:
+            choices_np = np.asarray(jnp.concatenate(all_choices, axis=0))
+            assignments = np.where(
+                self.pods.bound_node >= 0, self.pods.bound_node, PAD
+            ).astype(np.int32)
+            flat_idx = idx.reshape(-1)
+            flat_choice = choices_np.reshape(-1)
+            valid = flat_idx >= 0
+            assignments[flat_idx[valid]] = flat_choice[valid]
+            placed = int((flat_choice[valid] >= 0).sum())
 
         if self.engine == "v3":
             used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
@@ -444,7 +498,7 @@ class JaxReplayEngine:
             assignments=assignments,
             placed=placed,
             unschedulable=to_schedule - placed,
-            preemptions=0,
+            preemptions=preemptions,
             attempts=to_schedule,
             wall_clock_s=wall,
             placements_per_sec=placed / wall if wall > 0 else 0.0,

@@ -194,6 +194,7 @@ class WhatIfEngine:
         mesh=None,
         collect_assignments: bool = False,
         fork_checkpoint: Optional[str] = None,
+        preemption: bool = False,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -217,11 +218,23 @@ class WhatIfEngine:
         self.D = max(self.sset.max_domains, 1)
         # v3 engine unless label perturbations re-derived topology domains.
         self.engine = "v2" if self.sset.labels_dirty else "v3"
+        self.preemption = preemption
+        if preemption and (self.engine != "v3" or fork_checkpoint):
+            raise ValueError(
+                "what-if preemption requires the v3 engine (no label "
+                "perturbations) and no fork checkpoint"
+            )
+        if preemption and bool((pods.bound_node >= 0).any()):
+            # The aggregate tally cannot distinguish pre-bound victims from
+            # replay placements; use JaxReplayEngine for that combination.
+            raise ValueError(
+                "what-if preemption does not support pre-bound pods"
+            )
         if self.engine == "v3":
             from ..ops import tpu3 as V3
             from .jax_runtime import rep_slots_for
 
-            self.static3 = V3.V3Static.build(ec, pods, self.spec)
+            self.static3 = V3.V3Static.build(ec, pods, self.spec, preemption=preemption)
             self.shared3 = V3.Shared3.build(ec, self.static3)
             self.rep_slots = rep_slots_for(self.static3, pods)
         self._chunk_fn = self._build_chunk_fn()
@@ -235,6 +248,8 @@ class WhatIfEngine:
 
             st3, sh3, reps = self.static3, self.shared3, self.rep_slots
 
+            pre_on = self.preemption
+
             def per_scenario(dc, state, slots, extra):
                 d = T.Derived.build(dc)
                 cmasks = V3.class_masks(dc, d, st3, spec, reps)
@@ -243,10 +258,21 @@ class WhatIfEngine:
                 )
 
                 def step(st, batch):
-                    st, choices = wave_step(st, batch)
+                    st, out = wave_step(st, batch)
+                    if pre_on:
+                        choices, ev_node, ev_tier, ev_prior, ev_total = out
+                        placed_w = (
+                            jnp.sum((choices >= 0) & batch[0].valid) - ev_prior
+                        ).astype(jnp.int32)
+                        out = (
+                            (choices, ev_node, ev_tier)
+                            if collect
+                            else placed_w
+                        )
+                        return st, out
+                    choices = out
                     placed_w = jnp.sum((choices >= 0) & batch[0].valid).astype(jnp.int32)
-                    out = choices if collect else placed_w
-                    return st, out
+                    return st, (choices if collect else placed_w)
 
                 state, outs = jax.lax.scan(step, state, (slots, extra))
                 return state, outs
@@ -334,7 +360,7 @@ class WhatIfEngine:
 
             one = V3.DevState3.from_host(
                 host.used, host.match_count, host.anti_active, host.pref_wsum,
-                self.ec, self.static3,
+                self.ec, self.static3, ep=self.pods,
             )
             return jax.tree.map(
                 lambda a: jnp.repeat(jnp.asarray(a)[None], self.S, axis=0), one
@@ -401,7 +427,23 @@ class WhatIfEngine:
         wall = time.perf_counter() - t0
 
         to_schedule = int((idx >= 0).sum())
-        if self.collect_assignments:
+        if self.collect_assignments and self.preemption:
+            choices = np.concatenate([np.asarray(o[0]) for o in outs], axis=1)
+            ev_node = np.concatenate([np.asarray(o[1]) for o in outs], axis=1)
+            ev_tier = np.concatenate([np.asarray(o[2]) for o in outs], axis=1)
+            from .jax_runtime import preemption_walk
+
+            assignments = np.full((self.S, self.pods.num_pods), PAD, np.int32)
+            prebound = self.pods.bound_node >= 0
+            assignments[:, prebound] = self.pods.bound_node[prebound]
+            for s in range(self.S):
+                preemption_walk(
+                    assignments[s], idx, choices[s], ev_node[s], ev_tier[s],
+                    self.static3.pod_tier, self.pods.group_id == PAD,
+                )
+            scheduled = ~prebound
+            placed = (assignments[:, scheduled] >= 0).sum(axis=1).astype(np.int32)
+        elif self.collect_assignments:
             choices = np.concatenate([np.asarray(o) for o in outs], axis=1)  # [S, Cw, W]
             flat_idx = idx.reshape(-1)
             valid = flat_idx >= 0
