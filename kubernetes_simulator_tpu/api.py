@@ -33,15 +33,19 @@ class Simulator:
             plugins=plugins, weights=weights, enable_preemption=enable_preemption
         )
         self.engine_kw = engine_kw
-        from .plugins.builtin import inject_default_spread, spread_defaulting_configured
+        from .plugins.builtin import inject_default_spread, resolved_default_constraints
 
-        if spread_defaulting_configured(self.config):
-            # Deep-copy before injecting so the caller's Pod objects are
-            # never mutated (a second Simulator from the same pods must not
-            # inherit this config's injected constraints).
-            import copy
+        if resolved_default_constraints(self.config):
+            # Shallow-copy each pod with a fresh topology_spread list (the
+            # only field the injector appends to) so the caller's Pod
+            # objects are never mutated — a second Simulator built from
+            # the same pods must not inherit these constraints.
+            import dataclasses
 
-            self.pods = copy.deepcopy(self.pods)
+            self.pods = [
+                dataclasses.replace(p, topology_spread=list(p.topology_spread))
+                for p in self.pods
+            ]
             inject_default_spread(self.pods, self.config)
         self.ec, self.ep = encode(cluster, self.pods)
 

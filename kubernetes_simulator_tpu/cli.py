@@ -29,7 +29,8 @@ def cmd_run(args) -> int:
     factory = get_strategy(cfg.strategy)
     kw = {}
     if cfg.strategy == "jax":
-        kw = {"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves}
+        kw = {"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves,
+              "preemption": cfg.device_preemption}
     engine = factory(ec, ep, cfg.framework, **kw)
     with device_trace(args.profile_dir):
         res = engine.replay()
@@ -73,6 +74,7 @@ def cmd_whatif(args) -> int:
         wave_width=cfg.wave_width,
         chunk_waves=cfg.chunk_waves,
         mesh=mesh,
+        preemption=cfg.device_preemption,
     )
     with device_trace(args.profile_dir):
         res = eng.run()

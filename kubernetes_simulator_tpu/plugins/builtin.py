@@ -214,14 +214,20 @@ SYSTEM_DEFAULT_SPREAD = [
 ]
 
 
-def spread_defaulting_configured(config) -> bool:
-    """True iff the PodTopologySpread plugin entry asks for defaulting."""
+def resolved_default_constraints(config):
+    """The PodTopologySpread defaulting constraint list from config, or
+    None when not configured — the single source for both the predicate
+    and the injector."""
+    constraints = None
     for e in (config.plugins if config and config.plugins is not None else []):
-        if e.get("name") == "PodTopologySpread":
-            args = e.get("args", {})
-            if args.get("defaultingType") == "System" or args.get("defaultConstraints"):
-                return True
-    return False
+        if e.get("name") != "PodTopologySpread":
+            continue
+        args = e.get("args", {})
+        if args.get("defaultingType") == "System":
+            constraints = SYSTEM_DEFAULT_SPREAD
+        elif args.get("defaultConstraints"):
+            constraints = args["defaultConstraints"]
+    return constraints
 
 
 def inject_default_spread(pods, config) -> None:
@@ -239,16 +245,7 @@ def inject_default_spread(pods, config) -> None:
     defaulting with an empty list)."""
     from ..models.core import LabelSelector, TopologySpreadConstraint
 
-    entries = config.plugins if config and config.plugins is not None else []
-    constraints = None
-    for e in entries:
-        if e.get("name") != "PodTopologySpread":
-            continue
-        args = e.get("args", {})
-        if args.get("defaultingType") == "System":
-            constraints = SYSTEM_DEFAULT_SPREAD
-        elif args.get("defaultConstraints"):
-            constraints = args["defaultConstraints"]
+    constraints = resolved_default_constraints(config)
     if not constraints:
         return
     for p in pods:

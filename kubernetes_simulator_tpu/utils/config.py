@@ -93,6 +93,8 @@ class SimConfig:
     output: Optional[str] = None
     wave_width: int = 8
     chunk_waves: int = 1024
+    # Device tier preemption (jax strategy / what-if; sim.greedy docstring).
+    device_preemption: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "SimConfig":
@@ -150,6 +152,7 @@ class SimConfig:
         cfg.output = d.get("output")
         cfg.wave_width = int(d.get("waveWidth", 8))
         cfg.chunk_waves = int(d.get("chunkWaves", 1024))
+        cfg.device_preemption = bool(d.get("devicePreemption", False))
         return cfg
 
     @classmethod
