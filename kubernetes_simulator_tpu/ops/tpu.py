@@ -779,7 +779,7 @@ def eval_pod_fused(
     # (raw, weight, minmax?, reverse?) rows, in the reference accumulation
     # order: taint, node-affinity, interpod, spread.
     rows = []
-    if spec.taints and w.get("TaintToleration", 1.0) != 0:
+    if spec.taints and spec.taint_score and w.get("TaintToleration", 1.0) != 0:
         rows.append((p.taint_raw, w.get("TaintToleration", 1.0), False, True))
     if spec.node_affinity and w.get("NodeAffinity", 1.0) != 0:
         rows.append((p.na_raw, w.get("NodeAffinity", 1.0), False, False))

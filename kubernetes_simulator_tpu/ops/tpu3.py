@@ -672,6 +672,12 @@ def make_wave_step3(
     kmask = kind_masks(st)
     # Bound-node domain vectors are only needed when some plane is carried.
     maintain_dom = st.maintain_mc or st.maintain_anti or st.maintain_pref
+    # Single coarse spread constraint: its raw score takes one value per
+    # domain (+ one for label-less nodes), so the normalize extrema reduce
+    # over [Dcap+1] buckets instead of [N] nodes — with the taint row
+    # statically gone (no PreferNoSchedule), the whole [S, K, N] hi/lo
+    # pass disappears from Borg-shaped traces.
+    spread_dom_hilo = bool(spec.spread and st.SP == 1 and not st.has_host_rows)
 
     def wave_step(carry: DevState3, batch):
         sb, sx = batch
@@ -708,6 +714,12 @@ def make_wave_step3(
             dom_oh = (
                 pre.dmap[..., None] == jnp.arange(Dcap, dtype=jnp.float32)
             ).astype(jnp.float32)  # [W, KT, N, Dcap]
+            if spread_dom_hilo:
+                # [W, N, Dcap+1]: spread-row domain one-hot + no-domain col.
+                domoh2 = jnp.concatenate(
+                    [dom_oh[:, o2], (pre.dmap[:, o2] < 0)[..., None].astype(jnp.float32)],
+                    axis=-1,
+                )
             # #domains per row (for the domain-space spread min).
             nd_row = jnp.einsum(
                 "wkg,g->wk", pre.oh_row, jnp.asarray(st.nd_g, jnp.float32),
@@ -909,7 +921,7 @@ def make_wave_step3(
                 )
                 total = total + w_cfg.get("NodeResourcesFit", 1.0) * raw
             rows_n = []
-            if spec.taints and w_cfg.get("TaintToleration", 1.0) != 0:
+            if spec.taints and spec.taint_score and w_cfg.get("TaintToleration", 1.0) != 0:
                 rows_n.append((traw_k, w_cfg.get("TaintToleration", 1.0), False, True))
             if spec.node_affinity and w_cfg.get("NodeAffinity", 1.0) != 0:
                 rows_n.append((naraw_k, w_cfg.get("NodeAffinity", 1.0), False, False))
@@ -922,6 +934,7 @@ def make_wave_step3(
                 if st.MP:
                     raw = raw + jnp.sum(vals[o5:o6], axis=0)
                 rows_n.append((raw, w_cfg.get("InterPodAffinity", 1.0), True, False))
+            sp_dom_row = None
             if spec.spread and w_cfg.get("PodTopologySpread", 1.0) != 0:
                 if st.SP:
                     raw = jnp.sum(
@@ -934,7 +947,10 @@ def make_wave_step3(
                     )
                 else:
                     raw = jnp.zeros(dc.allocatable.shape[0], jnp.float32)
-                rows_n.append((raw, w_cfg.get("PodTopologySpread", 1.0), True, True))
+                if spread_dom_hilo:
+                    sp_dom_row = (raw, w_cfg.get("PodTopologySpread", 1.0))
+                else:
+                    rows_n.append((raw, w_cfg.get("PodTopologySpread", 1.0), True, True))
             if rows_n:
                 stack = jnp.stack([r[0] for r in rows_n])
                 hi, lo = _masked_hi_lo(stack, feasible)
@@ -945,6 +961,32 @@ def make_wave_step3(
                         raw, lo[i], hi[i], any_f, minmax, reverse
                     )
             else:
+                any_f = None
+            if sp_dom_row is not None:
+                # Domain-space extrema: raw takes vals_d[d] on domain-d
+                # nodes and selfm on label-less nodes — max/min over the
+                # buckets that contain a feasible node equal the node-space
+                # extrema exactly.
+                raw_sp, wt = sp_dom_row
+                domfeas = (
+                    jnp.einsum(
+                        "n,nd->d", feasible.astype(jnp.float32), domoh2[k],
+                        precision=_HI,
+                    )
+                    > 0.5
+                )  # [Dcap+1]
+                selfm0 = pre.sp_selfm[k, 0]
+                validrow = pre.row_g[k, o2] >= 0
+                vals_d = jnp.concatenate([rows_k[o2] + selfm0, selfm0[None]])
+                vals_d = jnp.where(validrow, vals_d, 0.0)
+                hi_sp = jnp.max(jnp.where(domfeas, vals_d, -jnp.inf))
+                lo_sp = jnp.min(jnp.where(domfeas, vals_d, jnp.inf))
+                if any_f is None:
+                    any_f = hi_sp > -jnp.inf
+                total = total + np.float32(wt) * _normalize_row(
+                    raw_sp, lo_sp, hi_sp, any_f, True, True
+                )
+            if any_f is None:
                 any_f = jnp.any(feasible)
 
             node, _ = select_node(total, feasible)

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..framework.framework import FrameworkConfig
 from ..framework.registry import register_strategy
+from ..models.core import Effect
 from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import SchedState, init_state
 from ..ops import tpu as T
@@ -60,6 +61,10 @@ class StepSpec:
     # Static trace properties: gate work the trace can never trigger.
     has_symmetric_pref: bool = True  # any preferred (anti-)affinity terms
     has_gangs: bool = True  # any pod-group membership (gang rollback)
+    # Any PreferNoSchedule taint can exist (cluster or injected): when
+    # False the taint score row is a constant 100 on every node (raw ≡ 0 →
+    # reverse max-normalize), which never changes the argmax — dropped.
+    taint_score: bool = True
 
     @classmethod
     def from_config(
@@ -108,6 +113,7 @@ class StepSpec:
         return cls(
             fit="NodeResourcesFit" in names,
             taints="TaintToleration" in names,
+            taint_score=bool((ec.taint_effect == int(Effect.PREFER_NO_SCHEDULE)).any()),
             node_affinity=na_on,
             interpod=ip_on,
             spread=sp_on,
@@ -152,7 +158,7 @@ def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec:
                 dc, st, s, rw, spec.shape_x, spec.shape_y
             )
         total = total + w.get("NodeResourcesFit", 1.0) * raw
-    if spec.taints and w.get("TaintToleration", 1.0) != 0:
+    if spec.taints and spec.taint_score and w.get("TaintToleration", 1.0) != 0:
         raw = T.taint_prefer_count(dc, s)
         total = total + w.get("TaintToleration", 1.0) * T.normalize_max(raw, feasible, reverse=True)
     if spec.node_affinity and w.get("NodeAffinity", 1.0) != 0:

@@ -19,7 +19,7 @@ node-side tensors are stacked ``[S, ...]``.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -149,6 +149,14 @@ class ScenarioSet:
         # v3 requires scenario-shared node→domain tables; label perturbations
         # that re-derive domains force the v2 (node-space) engine.
         self.labels_dirty = bool(labels_dirty.any())
+        # Injected PreferNoSchedule taints re-enable the taint score row
+        # (StepSpec.taint_score is derived from the base cluster only).
+        self.injected_prefer_taint = any(
+            pt.op == "add_taint"
+            and int(Effect.parse(pt.effect)) == int(Effect.PREFER_NO_SCHEDULE)
+            for sc in scenarios
+            for pt in sc.perturbations
+        )
 
         self.dc = T.DevCluster(
             allocatable=jnp.asarray(alloc),
@@ -210,6 +218,8 @@ class WhatIfEngine:
         self.fork_checkpoint = fork_checkpoint
         self.sset = ScenarioSet(ec, scenarios)
         self.S = self.sset.num_scenarios
+        if self.sset.injected_prefer_taint and not self.spec.taint_score:
+            self.spec = dc_replace(self.spec, taint_score=True)
         if mesh is not None:
             ndev = mesh.devices.size
             if self.S % ndev != 0:

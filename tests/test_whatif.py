@@ -134,3 +134,33 @@ def test_scenario_count_must_divide_devices():
     ec, ep = small_case(seed=2, n=5, p=10)
     with pytest.raises(ValueError):
         WhatIfEngine(ec, ep, [Scenario()] * 3, mesh=make_mesh())
+
+
+def test_injected_prefer_taint_reenables_score_row():
+    """The taint score row is statically dropped when the base cluster has
+    no PreferNoSchedule taints; a what-if scenario that injects one must
+    re-enable it (scores change where the taint lands)."""
+    from kubernetes_simulator_tpu.models.core import Taint
+
+    cluster = make_cluster(12, seed=9)  # no taints in the base cluster
+    pods, _ = make_workload(80, seed=9)
+    ec, ep = encode(cluster, pods)
+    from kubernetes_simulator_tpu.sim.jax_runtime import StepSpec
+
+    assert not StepSpec.from_config(ec, FrameworkConfig(), ep).taint_score
+    scen = [
+        Scenario(),
+        Scenario([Perturbation("add_taint", nodes=np.arange(6), key="soft",
+                               value="x", effect="PreferNoSchedule")]),
+    ]
+    eng = WhatIfEngine(ec, ep, scen, FrameworkConfig(), collect_assignments=True)
+    assert eng.spec.taint_score  # re-enabled by the injection
+    res = eng.run()
+
+    # Reference: from-scratch replay on the equivalently tainted cluster.
+    cluster_t = make_cluster(12, seed=9)
+    for n in cluster_t.nodes[:6]:
+        n.taints.append(Taint("soft", "x", "PreferNoSchedule"))
+    ec_t, ep_t = encode(cluster_t, pods)
+    ref = JaxReplayEngine(ec_t, ep_t, FrameworkConfig()).replay()
+    np.testing.assert_array_equal(res.assignments[1], ref.assignments)
