@@ -13,8 +13,7 @@ import json
 import sys
 
 from .framework.registry import get_strategy
-from .models.encode import encode
-from .utils.config import SimConfig, build_case
+from .utils.config import SimConfig, build_encoded_case
 from .utils.metrics import JsonlWriter, log, replay_row, whatif_rows
 from .utils.profiling import device_trace
 
@@ -23,9 +22,8 @@ def cmd_run(args) -> int:
     cfg = SimConfig.load(args.config)
     if args.strategy:
         cfg.strategy = args.strategy
-    cluster, pods = build_case(cfg)
-    log.info("encoding %d nodes / %d pods", len(cluster.nodes), len(pods))
-    ec, ep = encode(cluster, pods)
+    ec, ep = build_encoded_case(cfg)
+    log.info("encoded %d nodes / %d pods", ec.num_nodes, ep.num_pods)
     factory = get_strategy(cfg.strategy)
     kw = {}
     if cfg.strategy == "jax":
@@ -55,8 +53,7 @@ def cmd_whatif(args) -> int:
     if cfg.whatif.scenarios <= 0:
         log.error("config has no whatIf.scenarios")
         return 2
-    cluster, pods = build_case(cfg)
-    ec, ep = encode(cluster, pods)
+    ec, ep = build_encoded_case(cfg)
     scen = uniform_scenarios(
         ec,
         cfg.whatif.scenarios,

@@ -57,6 +57,21 @@ class BorgSpec:
     toleration_fraction: float = 0.3
     mean_duration: float = 3600.0
 
+    @classmethod
+    def from_spec(cls, spec) -> "BorgSpec":
+        """From any spec-like object (BorgSpec or
+        utils.config.BorgWorkloadSpec) — the one conversion site."""
+        if isinstance(spec, cls):
+            return spec
+        return cls(
+            nodes=spec.nodes,
+            tasks=spec.tasks,
+            seed=spec.seed,
+            gang_fraction=spec.gang_fraction,
+            max_gang=spec.max_gang,
+            num_apps=getattr(spec, "num_apps", 48),
+        )
+
 
 def _make_templates(spec: BorgSpec) -> List[Pod]:
     """One template per (app-term-class, cpu bucket, mem bucket, tier) cell
@@ -273,13 +288,7 @@ def load_trace_csv(path, spec: BorgSpec) -> Tuple[EncodedCluster, EncodedPods, d
 def make_borg_trace(spec) -> Tuple[Cluster, List[Pod]]:
     """Object-model variant for SMALL task counts (CPU-engine tests).
     ``spec`` may be a BorgSpec or utils.config.BorgWorkloadSpec."""
-    bspec = BorgSpec(
-        nodes=spec.nodes,
-        tasks=spec.tasks,
-        seed=spec.seed,
-        gang_fraction=spec.gang_fraction,
-        max_gang=spec.max_gang,
-    )
+    bspec = BorgSpec.from_spec(spec)
     if bspec.tasks > 200_000:
         raise ValueError("object-model borg trace capped at 200k tasks; use make_borg_encoded")
     rng = np.random.default_rng(bspec.seed)

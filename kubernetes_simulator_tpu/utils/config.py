@@ -70,6 +70,8 @@ class BorgWorkloadSpec:
     seed: int = 0
     gang_fraction: float = 0.08
     max_gang: int = 8
+    num_apps: int = 48  # template/app vocabulary (clip bound for app_id)
+    trace_path: Optional[str] = None  # external task-event CSV (sim.borg)
 
 
 @dataclass
@@ -118,6 +120,8 @@ class SimConfig:
                 seed=int(b.get("seed", 0)),
                 gang_fraction=float(b.get("gangFraction", 0.08)),
                 max_gang=int(b.get("maxGang", 8)),
+                num_apps=int(b.get("numApps", 48)),
+                trace_path=b.get("tracePath"),
             )
         else:
             syn = wl.get("synthetic", wl) or {}
@@ -197,3 +201,29 @@ def build_case(cfg: SimConfig):
 
     inject_default_spread(pods, cfg.framework)
     return cluster, pods
+
+
+def build_encoded_case(cfg: SimConfig):
+    """(EncodedCluster, EncodedPods) for any SimConfig. Borg workloads use
+    the vectorized template-expansion fast path (the object-model builder
+    caps at 200k tasks), optionally ingesting an external task-event trace
+    file (``workload.borg.tracePath``); everything else goes through
+    build_case + encode.
+
+    Note: the fast path samples the trace columns vectorized, so a seeded
+    borg config yields a DIFFERENT (equally Borg-shaped) trace than the
+    pre-CLI object-model generator did — determinism holds per generator,
+    not across them."""
+    from ..models.encode import encode
+
+    if cfg.borg is not None:
+        from ..sim.borg import BorgSpec, load_trace_csv, make_borg_encoded
+
+        spec = BorgSpec.from_spec(cfg.borg)
+        if cfg.borg.trace_path:
+            ec, ep, _ = load_trace_csv(cfg.borg.trace_path, spec)
+        else:
+            ec, ep, _ = make_borg_encoded(spec)
+        return ec, ep
+    cluster, pods = build_case(cfg)
+    return encode(cluster, pods)

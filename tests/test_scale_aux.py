@@ -130,3 +130,45 @@ whatIf:
         eng.replay()
         assert any(k.startswith("Filter/") for k in eng.fw.plugin_time)
         assert any(k.startswith("Score/") for k in eng.fw.plugin_time)
+
+
+class TestEncodedCli:
+    def test_borg_config_uses_encoded_fast_path(self):
+        # 250k tasks exceeds the object-model cap — the CLI must take the
+        # template-expansion fast path (regression: config4_borg_1m.yaml
+        # raised through build_case).
+        from kubernetes_simulator_tpu.utils.config import SimConfig, build_encoded_case
+
+        cfg = SimConfig.from_dict({
+            "strategy": "jax",
+            "workload": {"borg": {"nodes": 300, "tasks": 250_000, "seed": 1}},
+        })
+        ec, ep = build_encoded_case(cfg)
+        assert ep.num_pods == 250_000 and ec.num_nodes == 300
+
+    def test_borg_trace_path_config(self, tmp_path):
+        from kubernetes_simulator_tpu.sim.borg import BorgSpec, export_trace_csv
+        from kubernetes_simulator_tpu.utils.config import SimConfig, build_encoded_case
+
+        path = tmp_path / "t.csv"
+        export_trace_csv(BorgSpec(nodes=40, tasks=500, seed=2), path)
+        cfg = SimConfig.from_dict({
+            "workload": {"borg": {"nodes": 40, "tasks": 500, "seed": 2,
+                                  "tracePath": str(path)}},
+        })
+        ec, ep = build_encoded_case(cfg)
+        assert ep.num_pods == 500
+
+    def test_cli_run_small_borg(self, tmp_path, capsys):
+        import yaml
+
+        from kubernetes_simulator_tpu.cli import main
+
+        cfgp = tmp_path / "b.yaml"
+        cfgp.write_text(yaml.safe_dump({
+            "strategy": "jax",
+            "workload": {"borg": {"nodes": 50, "tasks": 2000, "seed": 0}},
+        }))
+        assert main(["run", str(cfgp)]) == 0
+        out = capsys.readouterr().out
+        assert '"kind": "replay-jax"' in out
