@@ -91,8 +91,14 @@ def cmd_whatif(args) -> int:
 
 def cmd_validate(args) -> int:
     cfg = SimConfig.load(args.config)
-    print(json.dumps({"strategy": cfg.strategy, "nodes": cfg.cluster.nodes,
+    nodes = cfg.borg.nodes if cfg.borg else cfg.cluster.nodes
+    tasks = (
+        cfg.borg.tasks if cfg.borg
+        else (cfg.workload.pods if cfg.workload else 1000)
+    )
+    print(json.dumps({"strategy": cfg.strategy, "nodes": nodes, "tasks": tasks,
                       "workload": "borg" if cfg.borg else "synthetic",
+                      "devicePreemption": cfg.device_preemption,
                       "whatif_scenarios": cfg.whatif.scenarios}, indent=2))
     return 0
 
