@@ -116,6 +116,11 @@ class V3Static:
     preemption: bool = False
     Tt: int = 0  # number of priority tiers (0 = feature off)
     pod_tier: np.ndarray = None  # [P] i32
+    # bf16 host planes: exact when every plane value is an integer ≤ 256,
+    # i.e. singleton (hostname) domains with bounded pods-per-node. Halves
+    # the dominant host-read/commit traffic. pref stays f32 (fractional).
+    mc_h_bf16: bool = False
+    anti_h_bf16: bool = False
 
     @property
     def KT(self) -> int:
@@ -160,6 +165,7 @@ class V3Static:
         spec,
         dmax_coarse: int = 128,
         preemption: bool = False,
+        allow_bf16_host: bool = True,
     ) -> "V3Static":
         G = max(ec.num_groups, 1)
         gt = ec.group_topo[:G] if ec.group_topo.shape[0] >= G else np.full(G, PAD, np.int32)
@@ -241,10 +247,33 @@ class V3Static:
                     f"device preemption supports <= {cls.MAX_TIERS} priority "
                     f"tiers; trace has {Tt}"
                 )
+        # bf16 exactness bound: integers ≤ 256. Counts at singleton
+        # (hostname) domains are bounded by pods-per-node; anti activations
+        # additionally by the per-pod anti-term width. Callers that mutate
+        # capacity at runtime (node events / what-if perturbations scaling
+        # the "pods" resource) must pass allow_bf16_host=False — the bound
+        # is baked into the jitted kernel.
+        pods_ri = ec.vocab._r.get("pods")
+        max_pods = (
+            float(ec.allocatable[:, pods_ri].max())
+            if (pods_ri is not None and ec.num_nodes)
+            else np.inf
+        )
+        mc_h_bf16 = bool(
+            allow_bf16_host
+            and len(mc_h_ids) and single_g[mc_h_ids].all() and max_pods <= 256
+        )
+        anti_h_bf16 = bool(
+            allow_bf16_host
+            and len(anti_h_ids)
+            and single_g[anti_h_ids].all()
+            and max_pods * max(B, 1) <= 256
+        )
         out = cls(
             tol_class=tol_class, tol_rep=tol_rep,
             na_class=na_class, na_rep=na_rep,
             preemption=preemption, Tt=Tt, pod_tier=pod_tier,
+            mc_h_bf16=mc_h_bf16, anti_h_bf16=anti_h_bf16,
             A=A, B=B, SP=SP, PA=PA,
             MA=anti_midx.shape[1], MP=pref_midx.shape[1],
             maintain_mc=bool(mc_ref.any()),
@@ -383,8 +412,8 @@ class DevState3(NamedTuple):
             mc_dom=jnp.asarray(dom_part(mc)),
             anti_dom=jnp.asarray(dom_part(aa)),
             pref_dom=jnp.asarray(dom_part(pw)),
-            mc_host=jnp.asarray(host_part(mc, st.mc_h_ids)),
-            anti_host=jnp.asarray(host_part(aa, st.anti_h_ids)),
+            mc_host=_host_plane(host_part(mc, st.mc_h_ids), st.mc_h_bf16),
+            anti_host=_host_plane(host_part(aa, st.anti_h_ids), st.anti_h_bf16),
             pref_host=jnp.asarray(host_part(pw, st.pref_h_ids)),
             match_total=jnp.asarray(mt),
             used_tier=jnp.asarray(used_tier),
@@ -412,6 +441,21 @@ class DevState3(NamedTuple):
             back(self.anti_dom, self.anti_host, st.anti_h_ids),
             back(self.pref_dom, self.pref_host, st.pref_h_ids),
         )
+
+
+def _host_plane(vals: np.ndarray, bf16: bool) -> jax.Array:
+    """Host plane → device, validating the bf16 exactness bound before a
+    lossy cast (resumed/trace-provided state could exceed it)."""
+    if bf16:
+        if vals.size and not (
+            (vals <= 256).all() and (vals == np.round(vals)).all()
+        ):
+            raise ValueError(
+                "host-plane values exceed the bf16 exactness bound "
+                "(integers <= 256); rebuild with allow_bf16_host=False"
+            )
+        return jnp.asarray(vals, dtype=jnp.bfloat16)
+    return jnp.asarray(vals)
 
 
 class SlotExtra(NamedTuple):
@@ -696,14 +740,20 @@ def make_wave_step3(
                              carry.pref_dom, precision=_HI)
             )  # [W, KT, Dcap]
             if st.has_host_rows:
+                # One-hot LHS cast to the plane dtype: bf16×bf16 einsums
+                # with f32 accumulation stay exact (0/1 × small ints).
                 vals_h0 = jnp.zeros((wave_width, st.KT, N), jnp.float32)
                 if len(st.mc_h_ids):
                     vals_h0 = vals_h0 + jnp.einsum(
-                        "wkh,hn->wkn", pre.oh_mc_h, carry.mc_host, precision=_HI
+                        "wkh,hn->wkn", pre.oh_mc_h.astype(carry.mc_host.dtype),
+                        carry.mc_host, precision=_HI,
+                        preferred_element_type=jnp.float32,
                     )
                 if len(st.anti_h_ids):
                     vals_h0 = vals_h0 + jnp.einsum(
-                        "wkh,hn->wkn", pre.oh_anti_h, carry.anti_host, precision=_HI
+                        "wkh,hn->wkn", pre.oh_anti_h.astype(carry.anti_host.dtype),
+                        carry.anti_host, precision=_HI,
+                        preferred_element_type=jnp.float32,
                     )
                 if len(st.pref_h_ids):
                     vals_h0 = vals_h0 + jnp.einsum(
@@ -1161,10 +1211,13 @@ def make_wave_step3(
                 has_dom_h = (
                     jnp.stack(dom_ats)[:, jnp.asarray(ids)] >= 0
                 ).astype(jnp.float32)  # [W, H]
-                return plane + jnp.einsum(
+                delta = jnp.einsum(
                     "w,wh,wn->hn", wv, vh * has_dom_h, oh_all,
                     precision=_HI, preferred_element_type=jnp.float32,
                 )
+                # Cast back to the carry dtype: bf16 planes hold small
+                # integers, exact through the add.
+                return (plane.astype(jnp.float32) + delta).astype(plane.dtype)
             # General path: credit every node in the bound node's domain.
             gdom_h = sh.gdom_f[jnp.asarray(ids)]  # [H, N] (static row select)
             dom_at_h = jnp.stack(dom_ats)[:, jnp.asarray(ids)]  # [W, H]

@@ -408,6 +408,25 @@ class JaxReplayEngine:
                 "checkpoint/resume is not supported with device preemption "
                 "(tier planes are not checkpointed)"
             )
+        if (
+            node_events
+            and self.engine == "v3"
+            and (self.static3.mc_h_bf16 or self.static3.anti_h_bf16)
+            and any(e.kind == "capacity_scale" for e in node_events)
+        ):
+            # Capacity scaling can push per-node pod counts past the bf16
+            # exactness bound baked into the kernel — rebuild without it.
+            from ..ops import tpu3 as V3
+
+            self.static3 = V3.V3Static.build(
+                self.ec, self.pods, self.spec,
+                preemption=self.preemption, allow_bf16_host=False,
+            )
+            self.chunk_fn = make_chunk_fn3(
+                self.static3, self.shared3,
+                rep_slots_for(self.static3, self.pods),
+                self.wave_width, self.spec,
+            )
 
         idx = self.waves.idx
         C = min(self.chunk_waves, max(idx.shape[0], 1))

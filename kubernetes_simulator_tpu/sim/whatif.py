@@ -244,7 +244,17 @@ class WhatIfEngine:
             from ..ops import tpu3 as V3
             from .jax_runtime import rep_slots_for
 
-            self.static3 = V3.V3Static.build(ec, pods, self.spec, preemption=preemption)
+            # Perturbations that scale the "pods" capacity can exceed the
+            # bf16 host-plane exactness bound.
+            scales_pods = any(
+                pt.op == "scale_capacity" and pt.resource == "pods" and pt.factor > 1
+                for sc in scenarios
+                for pt in sc.perturbations
+            )
+            self.static3 = V3.V3Static.build(
+                ec, pods, self.spec, preemption=preemption,
+                allow_bf16_host=not scales_pods,
+            )
             self.shared3 = V3.Shared3.build(ec, self.static3)
             self.rep_slots = rep_slots_for(self.static3, pods)
         self._chunk_fn = self._build_chunk_fn()

@@ -123,3 +123,20 @@ def test_v3_checkpoint_resume_identical(tmp_path):
         checkpoint_path=path, resume=True
     )
     np.testing.assert_array_equal(full.assignments, resumed.assignments)
+
+
+def test_bf16_host_planes_disabled_under_capacity_events():
+    """capacity_scale node events can push per-node pod counts past the
+    bf16 exactness bound — the engine must rebuild without bf16 planes."""
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+
+    cluster = make_cluster(150, seed=7)
+    pods, _ = make_workload(300, seed=7, with_affinity=True)
+    ec, ep = encode(cluster, pods)
+    eng = JaxReplayEngine(ec, ep, FrameworkConfig())
+    if not (eng.static3.mc_h_bf16 or eng.static3.anti_h_bf16):
+        pytest.skip("trace has no bf16 host planes")
+    ev = [NodeEvent(time=1.0, kind="capacity_scale", node=0, scale=3.0)]
+    res = eng.replay(node_events=ev)
+    assert not (eng.static3.mc_h_bf16 or eng.static3.anti_h_bf16)
+    assert res.placed > 0
