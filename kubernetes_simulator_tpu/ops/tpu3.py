@@ -254,9 +254,12 @@ class V3Static:
         # the "pods" resource) must pass allow_bf16_host=False — the bound
         # is baked into the jitted kernel.
         pods_ri = ec.vocab._r.get("pods")
+        # The per-node count bound only holds if NodeResourcesFit actually
+        # enforces the "pods" resource (spec.fit); otherwise counts are
+        # unbounded and bf16 would round silently past 256.
         max_pods = (
             float(ec.allocatable[:, pods_ri].max())
-            if (pods_ri is not None and ec.num_nodes)
+            if (spec.fit and pods_ri is not None and ec.num_nodes)
             else np.inf
         )
         mc_h_bf16 = bool(

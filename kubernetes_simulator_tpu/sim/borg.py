@@ -162,7 +162,19 @@ def encoded_from_cols(spec: BorgSpec, cols: dict) -> Tuple[EncodedCluster, Encod
     ec, tmpl_ep = enc.encode(cluster, templates)
 
     P = len(cols["arrival"])
-    app = np.clip(np.asarray(cols["app_id"], np.int64), 0, spec.num_apps - 1)
+    # Real Borg app/logical-collection ids are sparse 64-bit values far past
+    # num_apps; remap to contiguous ids in first-appearance order (mirrors
+    # the group_id remap below) so tasks spread across template classes
+    # instead of all clipping into the top one. Apps past num_apps wrap.
+    app_raw = np.asarray(cols["app_id"], np.int64)
+    if app_raw.size and app_raw.max(initial=0) >= spec.num_apps:
+        uniq_a, first_a, inv_a = np.unique(
+            app_raw, return_index=True, return_inverse=True
+        )
+        rank_a = np.empty(len(uniq_a), dtype=np.int64)
+        rank_a[np.argsort(first_a)] = np.arange(len(uniq_a), dtype=np.int64)
+        app_raw = rank_a[inv_a] % spec.num_apps
+    app = np.clip(app_raw, 0, spec.num_apps - 1)
     tol = np.asarray(cols["tolerates"], np.int64).clip(0, 1)
     tidx = app * 2 + tol
 

@@ -306,6 +306,7 @@ class JaxReplayEngine:
         self.wave_width = wave_width
         self.chunk_waves = chunk_waves
         self.engine = engine
+        self.dmax_coarse = dmax_coarse
         self.preemption = preemption
         self.dc = T.DevCluster.from_encoded(ec)
         self.waves = pack_waves(pods, wave_width)
@@ -419,9 +420,10 @@ class JaxReplayEngine:
             from ..ops import tpu3 as V3
 
             self.static3 = V3.V3Static.build(
-                self.ec, self.pods, self.spec,
+                self.ec, self.pods, self.spec, self.dmax_coarse,
                 preemption=self.preemption, allow_bf16_host=False,
             )
+            self.shared3 = V3.Shared3.build(self.ec, self.static3)
             self.chunk_fn = make_chunk_fn3(
                 self.static3, self.shared3,
                 rep_slots_for(self.static3, self.pods),

@@ -217,8 +217,18 @@ def build_encoded_case(cfg: SimConfig):
     from ..models.encode import encode
 
     if cfg.borg is not None:
+        from ..plugins.builtin import resolved_default_constraints
         from ..sim.borg import BorgSpec, load_trace_csv, make_borg_encoded
 
+        if resolved_default_constraints(cfg.framework):
+            import warnings
+
+            warnings.warn(
+                "PodTopologySpread cluster-default constraints apply only to "
+                "object-model workloads; the encoded Borg fast path ignores "
+                "them (Borg tasks carry no controller labels to select on).",
+                stacklevel=2,
+            )
         spec = BorgSpec.from_spec(cfg.borg)
         if cfg.borg.trace_path:
             ec, ep, _ = load_trace_csv(cfg.borg.trace_path, spec)
