@@ -92,12 +92,19 @@ class SchedulerFramework:
                 self.plugin_time[key] = self.plugin_time.get(key, 0.0) + _time.perf_counter() - t0
         return total
 
-    def schedule_one(self, st: SchedState, p: int) -> ScheduleResult:
+    def schedule_one(
+        self, st: SchedState, p: int, allow_preemption: bool = True
+    ) -> ScheduleResult:
         """One scheduling cycle (SURVEY.md §3.3). Does NOT bind — the caller
-        (runtime) owns Reserve/Permit/Bind so gang commit stays transactional."""
+        (runtime) owns Reserve/Permit/Bind so gang commit stays transactional.
+
+        ``allow_preemption=False`` skips PostFilter: the runtime disables it
+        for gang members because a speculative reserve must be cheaply
+        revertible, and evicting victims for a reservation that later rolls
+        back cannot be undone."""
         feasible = self.feasible_mask(st, p)
         if not feasible.any():
-            if self.config.enable_preemption:
+            if self.config.enable_preemption and allow_preemption:
                 res = self._post_filter_preempt(st, p)
                 if res is not None:
                     return res

@@ -33,6 +33,7 @@ class SchedulingQueue:
         self._backoff: List[Tuple[float, Tuple[int, int], _Entry]] = []
         self._unschedulable: Dict[int, _Entry] = {}
         self._attempts: Dict[int, int] = {}
+        self._fail_time: Dict[int, float] = {}
         self._seq = 0
 
     def push(self, pod: int, priority: int) -> None:
@@ -55,15 +56,34 @@ class SchedulingQueue:
         self._seq += 1
         heapq.heappush(self._backoff, (now + delay, e.sort_key(), e))
 
-    def mark_unschedulable(self, pod: int, priority: int) -> None:
+    def mark_unschedulable(self, pod: int, priority: int, now: Optional[float] = None) -> None:
+        """Record a failed scheduling attempt. With ``now``, the failure
+        time and attempt count feed the backoff computed at flush time
+        ([K8S]: pods moved out of unschedulableQ go through backoffQ until
+        their per-pod backoff expires)."""
         e = _Entry(pod, priority, self._seq)
         self._seq += 1
         self._unschedulable[pod] = e
+        if now is not None:
+            self._attempts[pod] = self._attempts.get(pod, 0) + 1
+            self._fail_time[pod] = now
 
-    def flush_unschedulable(self) -> None:
+    def _backoff_expiry(self, pod: int) -> float:
+        n = max(self._attempts.get(pod, 1) - 1, 0)
+        delay = min(INITIAL_BACKOFF * (2**n), MAX_BACKOFF)
+        return self._fail_time.get(pod, 0.0) + delay
+
+    def flush_unschedulable(self, now: Optional[float] = None) -> None:
         """A cluster event occurred (binding freed resources, node change) —
-        move unschedulable pods back to active ([K8S] MoveAllToActiveQueue)."""
+        move unschedulable pods back toward active ([K8S]
+        MoveAllToActiveOrBackoffQueue). With ``now``, pods whose backoff has
+        not yet expired land in the backoff queue instead of active."""
         for e in self._unschedulable.values():
+            if now is not None:
+                exp = self._backoff_expiry(e.pod)
+                if exp > now:
+                    heapq.heappush(self._backoff, (exp, e.sort_key(), e))
+                    continue
             heapq.heappush(self._heap, (e.sort_key(), e))
         self._unschedulable.clear()
 

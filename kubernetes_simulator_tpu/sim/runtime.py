@@ -105,10 +105,12 @@ class CpuReplayEngine:
         events: List[Tuple[float, int, int, int]] = []  # (time, kind, seq, payload)
         seq = 0
 
-        def push_event(t: float, kind: int, payload: int):
+        def push_event(t: float, kind: int, payload: int) -> int:
             nonlocal seq
-            heapq.heappush(events, (t, kind, seq, payload))
+            s = seq
+            heapq.heappush(events, (t, kind, s, payload))
             seq += 1
+            return s
 
         to_schedule = np.nonzero(pods.bound_node == PAD)[0]
         for p in to_schedule:
@@ -125,36 +127,68 @@ class CpuReplayEngine:
         reserved: Dict[int, List[int]] = {}
         failed_groups: Dict[int, float] = {}  # group → virtual time of failure
         gang_timeout_seq: Dict[int, int] = {}
+        failed_groups_ver: Dict[int, int] = {}  # group → progress_ver at failure
 
         placed = preemptions = attempts = 0
         now = 0.0
+        # Committed cluster progress (commits, completions, evictions, node
+        # events) — NOT speculative gang reserves. Gates timed gang retries
+        # so a gang that cannot complete doesn't spin the virtual clock.
+        progress_ver = 0
         saved_alloc = ec.allocatable.copy()
         t0 = time.perf_counter()
 
-        def rollback_group(g: int):
-            nonlocal placed
+        def rollback_group(g: int, park: bool):
+            # ``park=False`` (permit timeout): members were placeable and the
+            # gang just failed to assemble in time → backoff retry ([K8S]
+            # coscheduling rejects waiting pods back through the backoff
+            # queue) — but only if committed progress happened since the
+            # last failure, else retrying cannot help and would spin the
+            # virtual clock. ``park=True`` (a member failed): assembling
+            # again needs a cluster event → everyone waits for one.
+            retry = (not park) and failed_groups_ver.get(g) != progress_ver
             for m in reserved.pop(g, []):
                 unbind(ec, pods, st, m)
-                q.mark_unschedulable(m, int(pods.priority[m]))
+                if retry:
+                    q.requeue_backoff(m, int(pods.priority[m]), now)
+                else:
+                    q.mark_unschedulable(m, int(pods.priority[m]), now)
+            gang_timeout_seq.pop(g, None)
             failed_groups[g] = now
+            failed_groups_ver[g] = progress_ver
 
         def evict(p: int, requeue: bool = True):
             unbind(ec, pods, st, int(p))
+            # An evicted reserved gang member returns to the queue
+            # unreserved — drop it from the reservation so a later re-bind
+            # cannot enter the members list twice.
+            g = int(pods.group_id[p])
+            if g != PAD and g in reserved and int(p) in reserved[g]:
+                reserved[g].remove(int(p))
+                if not reserved[g]:
+                    reserved.pop(g)
+                    gang_timeout_seq.pop(g, None)
             if requeue:
                 q.push(int(p), int(pods.priority[p]))
 
         while events or len(q):
             if events:
-                now = max(now, events[0][0])
+                # Advance to the next event OR the next backoff expiry,
+                # whichever is first — a 1s backoff must not stretch to the
+                # next event's timestamp.
+                nb = q.next_backoff_time()
+                t_next = events[0][0]
+                now = max(now, min(t_next, nb) if nb is not None else t_next)
                 progressed_cluster = False
                 while events and events[0][0] <= now:
-                    _, kind, _, payload = heapq.heappop(events)
+                    _, kind, ev_seq, payload = heapq.heappop(events)
                     if kind == EV_ARRIVAL:
                         q.push(payload, int(pods.priority[payload]))
                     elif kind == EV_FINISH:
                         if st.bound[payload] != PAD:
                             unbind(ec, pods, st, payload)
                             progressed_cluster = True
+                            progress_ver += 1
                     elif kind == EV_NODE:
                         ev = node_events[payload]
                         if ev.kind == "node_down":
@@ -167,12 +201,15 @@ class CpuReplayEngine:
                         elif ev.kind == "capacity_scale":
                             ec.allocatable[ev.node] = saved_alloc[ev.node] * ev.scale
                         progressed_cluster = True
+                        progress_ver += 1
                     elif kind == EV_PERMIT_TIMEOUT:
                         g = payload
-                        if g in reserved and gang_timeout_seq.get(g) is not None:
-                            rollback_group(g)
+                        # Seq must match: stale timeouts from a rolled-back
+                        # reservation cycle must not cancel a fresh one.
+                        if g in reserved and gang_timeout_seq.get(g) == ev_seq:
+                            rollback_group(g, park=False)
                 if progressed_cluster:
-                    q.flush_unschedulable()
+                    q.flush_unschedulable(now)
             q.flush_backoff(now)
 
             made_bind = False
@@ -183,42 +220,49 @@ class CpuReplayEngine:
                 g = int(pods.group_id[p])
                 if g != PAD and g in failed_groups and failed_groups[g] == now:
                     # Group already failed at this instant; retry later.
+                    # No ``now``: this was not a real scheduling attempt, so
+                    # it must not inflate the pod's exponential backoff.
                     q.mark_unschedulable(p, int(pods.priority[p]))
                     continue
                 attempts += 1
-                res = self.fw.schedule_one(st, p)
+                res = self.fw.schedule_one(st, p, allow_preemption=g == PAD)
                 if res.node == PAD:
                     if g != PAD and g in reserved:
-                        rollback_group(g)
-                    q.mark_unschedulable(p, int(pods.priority[p]))
+                        rollback_group(g, park=True)
+                    q.mark_unschedulable(p, int(pods.priority[p]), now)
                     continue
                 for v in res.victims:
                     evict(v)
                     preemptions += 1
+                    progress_ver += 1
                 bind(ec, pods, st, p, res.node)
                 if g != PAD:
                     members = reserved.setdefault(g, [])
                     if not members:
-                        push_event(now + self.permit_timeout, EV_PERMIT_TIMEOUT, g)
-                        gang_timeout_seq[g] = seq
+                        gang_timeout_seq[g] = push_event(
+                            now + self.permit_timeout, EV_PERMIT_TIMEOUT, g
+                        )
                     members.append(p)
                     if len(members) >= int(pods.pg_min_member[g]):
                         # Permit: whole gang reserved → commit.
                         for m in reserved.pop(g):
                             placed += 1
                             made_bind = True
+                            progress_ver += 1
                             if np.isfinite(pods.duration[m]):
                                 push_event(now + float(pods.duration[m]), EV_FINISH, m)
                         gang_timeout_seq.pop(g, None)
                         failed_groups.pop(g, None)
+                        failed_groups_ver.pop(g, None)
                 else:
                     placed += 1
                     made_bind = True
+                    progress_ver += 1
                     if np.isfinite(pods.duration[p]):
                         push_event(now + float(pods.duration[p]), EV_FINISH, p)
                 if made_bind and q.num_unschedulable:
                     # Binding is a cluster event for affinity/spread waiters.
-                    q.flush_unschedulable()
+                    q.flush_unschedulable(now)
             # Idle until the next event (or backoff expiry).
             nb = q.next_backoff_time()
             if not events and len(q) == 0 and nb is not None:
@@ -229,7 +273,7 @@ class CpuReplayEngine:
 
         # Any still-reserved gang at trace end never completed → roll back.
         for g in list(reserved):
-            rollback_group(g)
+            rollback_group(g, park=True)
 
         wall = time.perf_counter() - t0
         ec.allocatable[:] = saved_alloc

@@ -43,6 +43,22 @@ class TestQueue:
         q.flush_backoff(1.5)
         assert q.pop() == 1
 
+    def test_flush_unschedulable_routes_through_backoff(self):
+        # [K8S] MoveAllToActiveOrBackoffQueue: a flushed pod whose backoff
+        # has not expired lands in the backoff queue, not active.
+        q = SchedulingQueue()
+        q.mark_unschedulable(3, priority=0, now=10.0)  # attempt 1 → 1s
+        q.flush_unschedulable(10.5)
+        assert q.pop() is None and q.num_backoff == 1
+        q.flush_backoff(11.0)
+        assert q.pop() == 3
+
+    def test_flush_unschedulable_expired_backoff_goes_active(self):
+        q = SchedulingQueue()
+        q.mark_unschedulable(3, priority=0, now=10.0)
+        q.flush_unschedulable(11.5)
+        assert q.pop() == 3 and q.num_backoff == 0
+
 
 class TestNormalize:
     def test_normalize_max_basic(self):

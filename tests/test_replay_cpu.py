@@ -126,3 +126,67 @@ def test_priority_order_in_queue():
     res = eng.replay()
     assert res.assignments[1] == 0
     assert res.assignments[0] == PAD
+
+
+def test_backoff_delays_retry_changing_outcome():
+    # [K8S] backoff semantics (SURVEY.md §2 L3): pod a fails at t=0 (its
+    # affinity target is absent) and starts a 1s backoff; when b's binding
+    # at t=0.5 flushes the unschedulable set, a goes to the backoff queue —
+    # not straight to active — so c (arriving t=0.9) takes the last cpu
+    # before a's retry at t=1.0. Without backoff routing, a would retry at
+    # t=0.5 and win the slot instead of c.
+    from kubernetes_simulator_tpu.models.core import (
+        LabelSelector,
+        PodAffinitySpec,
+        PodAffinityTerm,
+    )
+
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 2})])
+    aff = PodAffinitySpec(
+        required=(
+            PodAffinityTerm(LabelSelector.make({"app": "b"}), "kubernetes.io/hostname"),
+        )
+    )
+    pods = [
+        Pod("a", labels={"app": "a"}, requests={"cpu": 1}, arrival_time=0.0,
+            pod_affinity=aff),
+        Pod("b", labels={"app": "b"}, requests={"cpu": 1}, arrival_time=0.5),
+        Pod("c", requests={"cpu": 1}, arrival_time=0.9),
+    ]
+    res, _, _ = run(cluster, pods)
+    assert res.assignments[1] == 0 and res.assignments[2] == 0
+    assert res.assignments[0] == PAD
+    assert res.placed == 2 and res.unschedulable == 1
+
+
+def test_gang_no_progress_terminates():
+    # A gang that can never complete must not spin the virtual clock: the
+    # first rollback retries members through backoff, the second (with no
+    # committed cluster progress in between) parks them for good.
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod(f"g{i}", requests={"cpu": 1}, arrival_time=0.0, pod_group="gang")
+        for i in range(2)
+    ]
+    res, _, _ = run(cluster, pods, permit_timeout=50.0)
+    assert res.placed == 0
+    assert np.allclose(res.state.used, 0.0)
+    assert res.virtual_makespan < 1000.0
+
+
+def test_gang_members_do_not_preempt():
+    # Speculative gang reserves must be cheaply revertible, so PostFilter
+    # preemption is disabled for gang members: a gang that only fits by
+    # evicting a victim does not place, and the victim stays bound.
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod("victim", requests={"cpu": 1}, priority=0, arrival_time=0.0),
+        Pod("ga", requests={"cpu": 1}, priority=1000, arrival_time=1.0,
+            pod_group="gang"),
+        Pod("gb", requests={"cpu": 1}, priority=1000, arrival_time=1.0,
+            pod_group="gang"),
+    ]
+    res, _, _ = run(cluster, pods)
+    assert res.assignments[0] == 0  # victim still on the node
+    assert res.preemptions == 0
+    assert res.placed == 1
