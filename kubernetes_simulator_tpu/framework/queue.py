@@ -48,10 +48,12 @@ class SchedulingQueue:
 
     def requeue_backoff(self, pod: int, priority: int, now: float) -> None:
         """Pod failed a scheduling attempt for a transient reason — retry
-        after exponential backoff."""
+        after exponential backoff. The exponent is capped: the delay
+        saturates at MAX_BACKOFF by n=4, and an uncapped 2**n overflows
+        float for pods that fail thousands of times in a long trace."""
         n = self._attempts.get(pod, 0)
         self._attempts[pod] = n + 1
-        delay = min(INITIAL_BACKOFF * (2**n), MAX_BACKOFF)
+        delay = min(INITIAL_BACKOFF * (2 ** min(n, 8)), MAX_BACKOFF)
         e = _Entry(pod, priority, self._seq)
         self._seq += 1
         heapq.heappush(self._backoff, (now + delay, e.sort_key(), e))
@@ -69,9 +71,13 @@ class SchedulingQueue:
             self._fail_time[pod] = now
 
     def _backoff_expiry(self, pod: int) -> float:
-        n = max(self._attempts.get(pod, 1) - 1, 0)
+        if pod not in self._fail_time:
+            # No recorded failed attempt (e.g. parked without an attempt) —
+            # no backoff to serve: eligible for active immediately.
+            return float("-inf")
+        n = min(max(self._attempts.get(pod, 1) - 1, 0), 8)
         delay = min(INITIAL_BACKOFF * (2**n), MAX_BACKOFF)
-        return self._fail_time.get(pod, 0.0) + delay
+        return self._fail_time[pod] + delay
 
     def flush_unschedulable(self, now: Optional[float] = None) -> None:
         """A cluster event occurred (binding freed resources, node change) —

@@ -92,3 +92,55 @@ def unbind(ec: EncodedCluster, pods: EncodedPods, st: SchedState, p: int) -> Non
         return
     _apply(ec, pods, st, p, n, -1.0)
     st.bound[p] = PAD
+
+
+def release_delta(
+    ec: EncodedCluster, pods: EncodedPods, idx: np.ndarray, nodes: np.ndarray
+):
+    """Aggregate state contribution of pods ``idx`` bound at ``nodes`` —
+    the vectorized sum of per-pod ``_apply(sign=+1)`` effects, in the host
+    state layout. The device engines subtract it from the carried planes
+    when completed pods free their resources at chunk boundaries
+    (SURVEY.md §2 L4: completions are the other half of the binding
+    contract). Returns (used [N,R], match_count [G,D], anti_active [G,D],
+    pref_wsum [G,D])."""
+    N, R = ec.num_nodes, ec.num_resources
+    G = max(ec.num_groups, 1)
+    D = max(ec.max_domains, 1)
+    used = np.zeros((N, R), np.float32)
+    mc = np.zeros((G, D), np.float32)
+    aa = np.zeros((G, D), np.float32)
+    pw = np.zeros((G, D), np.float32)
+    if len(idx) == 0:
+        return used, mc, aa, pw
+    idx = np.asarray(idx)
+    nodes = np.asarray(nodes)
+    np.add.at(used, nodes, pods.requests[idx])
+    gt = ec.group_topo[:G]
+    # dom[g, k] = domain of pod k's node under group g's topology.
+    dom = np.where(
+        (gt >= 0)[:, None], ec.node_domain[np.clip(gt, 0, None)][:, nodes], PAD
+    )  # [G, K]
+    sel = (dom >= 0) & pods.pod_matches_group[idx].T[:G]
+    gg, kk = np.nonzero(sel)
+    np.add.at(mc, (gg, dom[gg, kk]), 1.0)
+    for col in range(pods.anti_req.shape[1]):
+        g = pods.anti_req[idx, col]
+        ok = (g >= 0) & (dom[np.clip(g, 0, None), np.arange(len(idx))] >= 0)
+        if ok.any():
+            np.add.at(
+                aa,
+                (g[ok], dom[g[ok], np.nonzero(ok)[0]]),
+                1.0,
+            )
+    for col in range(pods.pref_aff.shape[1]):
+        g = pods.pref_aff[idx, col]
+        w = pods.pref_aff_w[idx, col]
+        ok = (g >= 0) & (dom[np.clip(g, 0, None), np.arange(len(idx))] >= 0)
+        if ok.any():
+            np.add.at(
+                pw,
+                (g[ok], dom[g[ok], np.nonzero(ok)[0]]),
+                w[ok].astype(np.float32),
+            )
+    return used, mc, aa, pw

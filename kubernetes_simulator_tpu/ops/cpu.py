@@ -13,6 +13,8 @@ the object-model level.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from ..models.encode import PAD, TOL_PAD, TOL_WILDCARD, EncodedCluster, EncodedPods
@@ -313,18 +315,63 @@ def spread_filter_mask(
     return ok
 
 
-def spread_score(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) -> np.ndarray:
-    """Lower resulting match count → better (raw; reverse-normalized by the
-    caller). Simplified vs upstream's two-pass normalization; both paths use
-    the same formula so parity holds."""
+def spread_weight(ec: EncodedCluster, g: int) -> np.float32:
+    """Upstream topologyNormalizingWeight for match-group ``g``'s topology:
+    ``log(size + 2)`` with size = number of distinct domains of the key
+    ([K8S] podtopologyspread/scoring.go). f64 log cast once to f32 so every
+    backend sees the identical value."""
+    ti = ec.group_topo[g]
+    nd = int(ec.num_domains[ti]) if ti >= 0 else 0
+    return np.float32(np.log(np.float64(nd) + 2.0))
+
+
+def spread_score(
+    ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int
+) -> Optional[np.ndarray]:
+    """Upstream podtopologyspread scoring ([K8S] scoring.go): per
+    ScheduleAnyway constraint, ``score += cnt·log(size+2) + (maxSkew−1)``
+    over existing matching pods in the node's domain (no self term),
+    truncated to an integer per node. Nodes missing any scored topology key
+    are ignored — sentinel −1 (they normalize to 0). Returns None when the
+    pod has no ScheduleAnyway constraints (PreScore Skip)."""
     gdom = _group_dom_per_node(ec)
     cnt = _counts_at_nodes(st.match_count, gdom)
     raw = np.zeros(ec.num_nodes, dtype=np.float32)
-    for g, dns in zip(pods.spread_g[p], pods.spread_dns[p]):
-        if g < 0:
+    ignored = np.zeros(ec.num_nodes, dtype=bool)
+    any_scored = False
+    for g, skew, dns in zip(pods.spread_g[p], pods.spread_skew[p], pods.spread_dns[p]):
+        if g < 0 or dns:
             continue
-        raw += cnt[g] + float(pods.pod_matches_group[p, g])
-    return raw
+        any_scored = True
+        raw = raw + (cnt[g] * spread_weight(ec, g) + np.float32(int(skew) - 1))
+        ignored |= gdom[g] < 0
+    if not any_scored:
+        return None
+    # int64(math.Round(score)) upstream — half away from zero; scores are
+    # non-negative so floor(x + 0.5), in f32 on every backend.
+    raw = np.floor(raw + np.float32(0.5))
+    return np.where(ignored, np.float32(-1.0), raw)
+
+
+def spread_normalize(raw: np.ndarray, feasible: np.ndarray) -> np.ndarray:
+    """Upstream two-pass NormalizeScore ([K8S] podtopologyspread):
+    ``100·(max+min−s) // max`` with min/max over non-ignored feasible
+    nodes; ignored nodes (sentinel −1) → 0; max == 0 → 100. Integer (int32)
+    arithmetic, exact while ``100·(max+min) < 2³¹`` — mirrored bit-for-bit
+    on the device paths."""
+    out = np.zeros_like(raw, dtype=np.float32)
+    scored = feasible & (raw >= 0)
+    if not scored.any():
+        return out
+    hi = np.int32(raw[scored].max())
+    lo = np.int32(raw[scored].min())
+    nz = raw >= 0
+    if hi <= 0:
+        out[nz] = np.float32(MAX_NODE_SCORE)
+        return out
+    vals = (np.int32(MAX_NODE_SCORE) * (hi + lo - raw.astype(np.int32))) // hi
+    out[nz] = vals[nz].astype(np.float32)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -371,16 +371,54 @@ def spread_filter_mask(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
     return jnp.all(jnp.where(((s.spread_g >= 0) & s.spread_dns)[:, None], c_ok, True), axis=0)
 
 
-def spread_score(d: Derived, st: DevState, s: PodSlot) -> jax.Array:
+def spread_score_upstream(d: Derived, st: DevState, s: PodSlot, w_g) -> tuple:
+    """Upstream podtopologyspread raw score (mirrors ops.cpu.spread_score):
+    ``floor(Σ_scored cnt·log(size+2) + (maxSkew−1))`` per node over the
+    ScheduleAnyway constraints, plus the ignored mask (node missing a
+    scored key) and the dynamic any-scored flag (PreScore Skip). ``w_g`` is
+    the static [G] weight table."""
     G = st.match_count.shape[0]
     N = d.gdom_f.shape[1]
     ohS = _term_onehot(s.spread_g, G)
     if not ohS.shape[0]:
-        return jnp.zeros(N, dtype=jnp.float32)
+        return (
+            jnp.zeros(N, jnp.float32),
+            jnp.zeros(N, bool),
+            jnp.zeros((), bool),
+        )
     cnt = _term_rows(st.match_count, ohS)  # [A, N]
-    selfm = jnp.einsum("ag,g->a", ohS, s.pmg.astype(jnp.float32), precision=_HI)
-    valid = (s.spread_g >= 0)[:, None]
-    return jnp.sum(jnp.where(valid, cnt + selfm[:, None], 0.0), axis=0)
+    gvalid = (
+        jnp.einsum("ag,gn->an", ohS, (d.gdom_f >= 0).astype(jnp.float32), precision=_HI)
+        > 0.5
+    )
+    scored = (s.spread_g >= 0) & ~s.spread_dns  # [A]
+    wrow = jnp.einsum("ag,g->a", ohS, jnp.asarray(w_g, jnp.float32), precision=_HI)
+    raw = jnp.zeros(N, jnp.float32)
+    ignored = jnp.zeros(N, bool)
+    for i in range(ohS.shape[0]):
+        contrib = cnt[i] * wrow[i] + (s.spread_skew[i].astype(jnp.float32) - 1.0)
+        raw = raw + jnp.where(scored[i], contrib, 0.0)
+        ignored = ignored | (scored[i] & ~gvalid[i])
+    # Upstream int64(math.Round(score)): floor(x+0.5), non-negative x.
+    return jnp.floor(raw + 0.5), ignored, jnp.any(scored)
+
+
+def spread_upstream_normalize(raw, ignored, feasible, any_scored) -> jax.Array:
+    """Upstream two-pass NormalizeScore (mirrors ops.cpu.spread_normalize
+    bit-for-bit): int32-exact ``100·(max+min−s) // max`` with extrema over
+    non-ignored feasible nodes; ignored → 0; max == 0 → 100; no scored
+    constraints → all 0."""
+    okn = feasible & ~ignored
+    hi = jnp.max(jnp.where(okn, raw, -jnp.inf))
+    lo = jnp.min(jnp.where(okn, raw, jnp.inf))
+    has = hi > -jnp.inf
+    hi_i = jnp.where(has, hi, 0.0).astype(jnp.int32)
+    lo_i = jnp.where(has, lo, 0.0).astype(jnp.int32)
+    vals = (np.int32(MAX_NODE_SCORE) * (hi_i + lo_i - raw.astype(jnp.int32))) // jnp.where(
+        hi_i > 0, hi_i, 1
+    )
+    out = jnp.where(hi_i > 0, vals.astype(jnp.float32), np.float32(MAX_NODE_SCORE))
+    return jnp.where(ignored | ~has | ~any_scored, 0.0, out)
 
 
 # ---------------------------------------------------------------------------
@@ -611,9 +649,21 @@ class WavePre(NamedTuple):
     anti_valid: jax.Array  # [W, B] bool
     sp_valid: jax.Array  # [W, SP] bool
     sp_dns: jax.Array  # [W, SP] bool (valid & DoNotSchedule)
+    sp_scored: jax.Array  # [W, SP] bool (valid & ScheduleAnyway — scoring rows)
     sp_selfm: jax.Array  # [W, SP] f32
     sp_skew: jax.Array  # [W, SP] f32
+    sp_w: jax.Array  # [W, SP] f32 (upstream log(size+2) topology weights)
     pmg_f: jax.Array  # [W, G] f32
+
+
+def _padded_w_table(sp_w_g, G: int) -> np.ndarray:
+    """Static [G] spread-weight table from spec.sp_w_g, padded/clipped to
+    the one-hot group axis width."""
+    tab = np.zeros(G, np.float32)
+    arr = np.asarray(sp_w_g, np.float32)
+    n = min(G, arr.shape[0])
+    tab[:n] = arr[:n]
+    return tab
 
 
 def wave_widths(s: "PodSlot", spec) -> tuple:
@@ -691,8 +741,14 @@ def build_wave_pre(dc: DevCluster, d: Derived, sb: PodSlot, spec) -> WavePre:
         anti_valid=sb.anti_req[:, :B] >= 0,
         sp_valid=sb.spread_g[:, :SP] >= 0,
         sp_dns=(sb.spread_g[:, :SP] >= 0) & sb.spread_dns[:, :SP],
+        sp_scored=(sb.spread_g[:, :SP] >= 0) & ~sb.spread_dns[:, :SP],
         sp_selfm=jnp.einsum("wag,wg->wa", ohS, pmg_f, precision=_HI),
         sp_skew=sb.spread_skew[:, :SP].astype(jnp.float32),
+        sp_w=jnp.einsum(
+            "wag,g->wa", ohS, _padded_w_table(spec.sp_w_g, G), precision=_HI
+        )
+        if SP
+        else jnp.zeros((W, 0), jnp.float32),
         pmg_f=pmg_f,
     )
 
@@ -788,20 +844,6 @@ def eval_pod_fused(
         if spec.has_symmetric_pref:
             raw = raw + jnp.einsum("g,gn->n", p.pmg_f, st.pref_wsum, precision=_HI)
         rows.append((raw, w.get("InterPodAffinity", 1.0), True, False))
-    if spec.spread and w.get("PodTopologySpread", 1.0) != 0:
-        if SP:
-            raw = jnp.sum(
-                jnp.where(
-                    p.sp_valid[:, None],
-                    reads[A + B : A + B + SP] + p.sp_selfm[:, None],
-                    0.0,
-                ),
-                axis=0,
-            )
-        else:
-            raw = jnp.zeros(N, jnp.float32)
-        rows.append((raw, w.get("PodTopologySpread", 1.0), True, True))
-
     if rows:
         stack = jnp.stack([r[0] for r in rows])  # [Kn, N]
         hi = jnp.max(jnp.where(feasible[None, :], stack, -jnp.inf), axis=1)
@@ -809,6 +851,21 @@ def eval_pod_fused(
         for i, (raw, wt, minmax, reverse) in enumerate(rows):
             out = _normalize_row(raw, lo[i], hi[i], any_f, minmax, reverse)
             total = total + np.float32(wt) * out
+    if spec.spread and w.get("PodTopologySpread", 1.0) != 0 and SP:
+        # Upstream scoring: own extrema (non-ignored feasible nodes) and the
+        # two-pass integer normalize — cannot share the stacked hi/lo pass.
+        cnts = reads[A + B : A + B + SP]
+        gval = p.gvalid[A + B : A + B + SP]
+        raw_sp = jnp.zeros(N, jnp.float32)
+        ignored = jnp.zeros(N, bool)
+        for i in range(SP):
+            contrib = cnts[i] * p.sp_w[i] + (p.sp_skew[i] - 1.0)
+            raw_sp = raw_sp + jnp.where(p.sp_scored[i], contrib, 0.0)
+            ignored = ignored | (p.sp_scored[i] & ~gval[i])
+        out = spread_upstream_normalize(
+            jnp.floor(raw_sp + 0.5), ignored, feasible, jnp.any(p.sp_scored)
+        )
+        total = total + np.float32(w.get("PodTopologySpread", 1.0)) * out
     return feasible, total, any_f
 
 

@@ -507,6 +507,8 @@ class WavePre3(NamedTuple):
     sp_selfm: jax.Array  # [W, SP] f32
     sp_skew: jax.Array  # [W, SP] f32
     sp_dns: jax.Array  # [W, SP] bool
+    sp_scored: jax.Array  # [W, SP] bool (valid & ScheduleAnyway)
+    sp_w: jax.Array  # [W, SP] f32 (upstream log(size+2) weights)
     pmg_f: jax.Array  # [W, G] f32
     anti_g: jax.Array  # [W, G] f32 (required-anti term one-hot sums)
     pref_g: jax.Array  # [W, G] f32 (preferred term weight sums)
@@ -601,10 +603,17 @@ def build_wave_pre3(
         sp_selfm = jnp.einsum("wag,wg->wa", ohS, pmg_f, precision=_HI)
         sp_skew = sb.spread_skew[:, : st.SP].astype(jnp.float32)
         sp_dns = (sb.spread_g[:, : st.SP] >= 0) & sb.spread_dns[:, : st.SP]
+        sp_scored = (sb.spread_g[:, : st.SP] >= 0) & ~sb.spread_dns[:, : st.SP]
+        # One source of truth for the upstream topologyNormalizingWeight
+        # table: spec.sp_w_g (jax_runtime._spread_w_table).
+        w_tab = T2._padded_w_table(spec.sp_w_g, G)
+        sp_w = jnp.einsum("wag,g->wa", ohS, jnp.asarray(w_tab), precision=_HI)
     else:
         sp_selfm = jnp.zeros((W, 0), jnp.float32)
         sp_skew = jnp.zeros((W, 0), jnp.float32)
         sp_dns = jnp.zeros((W, 0), bool)
+        sp_scored = jnp.zeros((W, 0), bool)
+        sp_w = jnp.zeros((W, 0), jnp.float32)
 
     # Taint/NA per-wave tensors only exist on the non-class fallback path;
     # with classes the per-chunk [C, N] masks are read via tiny one-hots.
@@ -626,6 +635,7 @@ def build_wave_pre3(
         oh_mc_h=oh_mc_h, oh_anti_h=oh_anti_h, oh_pref_h=oh_pref_h,
         row_w=row_w, aff_selfm=aff_selfm,
         sp_selfm=sp_selfm, sp_skew=sp_skew, sp_dns=sp_dns,
+        sp_scored=sp_scored, sp_w=sp_w,
         pmg_f=pmg_f, anti_g=anti_g, pref_g=pref_g,
         taint_ok=taint_ok, taint_raw=taint_raw, na_ok=na_ok, na_raw=na_raw,
     )
@@ -987,23 +997,6 @@ def make_wave_step3(
                 if st.MP:
                     raw = raw + jnp.sum(vals[o5:o6], axis=0)
                 rows_n.append((raw, w_cfg.get("InterPodAffinity", 1.0), True, False))
-            sp_dom_row = None
-            if spec.spread and w_cfg.get("PodTopologySpread", 1.0) != 0:
-                if st.SP:
-                    raw = jnp.sum(
-                        jnp.where(
-                            (pre.row_g[k, o2:o3] >= 0)[:, None],
-                            vals[o2:o3] + pre.sp_selfm[k][:, None],
-                            0.0,
-                        ),
-                        axis=0,
-                    )
-                else:
-                    raw = jnp.zeros(dc.allocatable.shape[0], jnp.float32)
-                if spread_dom_hilo:
-                    sp_dom_row = (raw, w_cfg.get("PodTopologySpread", 1.0))
-                else:
-                    rows_n.append((raw, w_cfg.get("PodTopologySpread", 1.0), True, True))
             if rows_n:
                 stack = jnp.stack([r[0] for r in rows_n])
                 hi, lo = _masked_hi_lo(stack, feasible)
@@ -1015,30 +1008,70 @@ def make_wave_step3(
                     )
             else:
                 any_f = None
-            if sp_dom_row is not None:
-                # Domain-space extrema: raw takes vals_d[d] on domain-d
-                # nodes and selfm on label-less nodes — max/min over the
-                # buckets that contain a feasible node equal the node-space
-                # extrema exactly.
-                raw_sp, wt = sp_dom_row
-                domfeas = (
-                    jnp.einsum(
-                        "n,nd->d", feasible.astype(jnp.float32), domoh2[k],
-                        precision=_HI,
+            if spec.spread and w_cfg.get("PodTopologySpread", 1.0) != 0 and st.SP:
+                # Upstream scoring ([K8S] scoring.go): cnt·log(size+2) +
+                # (maxSkew−1), floored, two-pass integer normalize — own
+                # extrema over non-ignored feasible nodes (mirrors
+                # ops.cpu.spread_score/spread_normalize bit-for-bit).
+                wt = w_cfg.get("PodTopologySpread", 1.0)
+                if spread_dom_hilo:
+                    # Domain-space form (SP == 1, coarse row): raw takes one
+                    # value per existing domain; label-less nodes are the
+                    # ignored set (the extra bucket), excluded from extrema
+                    # and normalized to 0.
+                    scored0 = pre.sp_scored[k, 0]
+                    raw_d = jnp.floor(
+                        rows_k[o2] * pre.sp_w[k, 0] + (pre.sp_skew[k, 0] - 1.0) + 0.5
+                    )  # [Dcap] — floor(x+0.5) = upstream math.Round, x ≥ 0
+                    dval = (
+                        jnp.arange(Dcap, dtype=jnp.float32) < nd_row[k, o2]
+                    )  # existing domains
+                    domfeas = (
+                        jnp.einsum(
+                            "n,nd->d", feasible.astype(jnp.float32), domoh2[k],
+                            precision=_HI,
+                        )
+                        > 0.5
+                    )  # [Dcap+1]
+                    okd = dval & domfeas[:Dcap]
+                    hi_sp = jnp.max(jnp.where(okd, raw_d, -jnp.inf))
+                    lo_sp = jnp.min(jnp.where(okd, raw_d, jnp.inf))
+                    has = hi_sp > -jnp.inf
+                    hi_i = jnp.where(has, hi_sp, 0.0).astype(jnp.int32)
+                    lo_i = jnp.where(has, lo_sp, 0.0).astype(jnp.int32)
+                    vals_d = (
+                        np.int32(T2.MAX_NODE_SCORE)
+                        * (hi_i + lo_i - raw_d.astype(jnp.int32))
+                    ) // jnp.where(hi_i > 0, hi_i, 1)
+                    out_d = jnp.where(
+                        hi_i > 0,
+                        vals_d.astype(jnp.float32),
+                        np.float32(T2.MAX_NODE_SCORE),
                     )
-                    > 0.5
-                )  # [Dcap+1]
-                selfm0 = pre.sp_selfm[k, 0]
-                validrow = pre.row_g[k, o2] >= 0
-                vals_d = jnp.concatenate([rows_k[o2] + selfm0, selfm0[None]])
-                vals_d = jnp.where(validrow, vals_d, 0.0)
-                hi_sp = jnp.max(jnp.where(domfeas, vals_d, -jnp.inf))
-                lo_sp = jnp.min(jnp.where(domfeas, vals_d, jnp.inf))
-                if any_f is None:
-                    any_f = hi_sp > -jnp.inf
-                total = total + np.float32(wt) * _normalize_row(
-                    raw_sp, lo_sp, hi_sp, any_f, True, True
-                )
+                    out_d = jnp.where(dval & has & scored0, out_d, 0.0)
+                    out = jnp.einsum(
+                        "nd,d->n", domoh2[k][:, :Dcap], out_d, precision=_HI
+                    )
+                    if any_f is None:
+                        any_f = jnp.any(domfeas)
+                else:
+                    cnts = vals[o2:o3]
+                    gval = gvalid[o2:o3]
+                    raw_sp = jnp.zeros(N, jnp.float32)
+                    ignored = jnp.zeros(N, bool)
+                    for i in range(st.SP):
+                        contrib = cnts[i] * pre.sp_w[k, i] + (
+                            pre.sp_skew[k, i] - 1.0
+                        )
+                        raw_sp = raw_sp + jnp.where(
+                            pre.sp_scored[k, i], contrib, 0.0
+                        )
+                        ignored = ignored | (pre.sp_scored[k, i] & ~gval[i])
+                    out = T2.spread_upstream_normalize(
+                        jnp.floor(raw_sp + 0.5), ignored, feasible,
+                        jnp.any(pre.sp_scored[k]),
+                    )
+                total = total + np.float32(wt) * out
             if any_f is None:
                 any_f = jnp.any(feasible)
 

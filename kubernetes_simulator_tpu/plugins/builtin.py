@@ -166,10 +166,12 @@ class PodTopologySpread(Plugin):
         return K.spread_filter_mask(ctx.ec, st, ctx.pods, p)
 
     def score(self, ctx, st, p):
+        # None when the pod has no ScheduleAnyway constraints ([K8S]
+        # PreScore Skip) — the framework then contributes nothing.
         return K.spread_score(ctx.ec, st, ctx.pods, p)
 
     def normalize(self, raw, feasible):
-        return K.normalize_min_max(raw, feasible, reverse=True)
+        return K.spread_normalize(raw, feasible)
 
 
 PLUGIN_FACTORIES = {

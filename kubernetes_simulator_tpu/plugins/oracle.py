@@ -214,15 +214,41 @@ def interpod_score(st: OracleState, pod: Pod, node: Node) -> float:
     return raw
 
 
-def spread_score(st: OracleState, pod: Pod, node: Node) -> float:
-    raw = 0.0
+def spread_score(st: OracleState, pod: Pod, node: Node) -> Optional[float]:
+    """Upstream podtopologyspread scoring ([K8S] scoring.go): for each
+    ScheduleAnyway constraint, ``cnt·log(size+2) + (maxSkew−1)`` over
+    existing matching pods in the node's domain (no self term), truncated
+    to an integer. A node missing any scored key is ignored → −1; no
+    ScheduleAnyway constraints → None (PreScore Skip). f32 arithmetic in
+    constraint order, matching ops.cpu.spread_score bit-for-bit."""
+    import numpy as np
+
+    raw = np.float32(0.0)
+    any_scored = False
+    ignored = False
     for c in pod.topology_spread:
+        if c.when_unsatisfiable == "DoNotSchedule":
+            continue
+        any_scored = True
+        domains = {
+            n.labels[c.topology_key]
+            for n in st.cluster.nodes
+            if c.topology_key in n.labels
+        }
         dom = node.labels.get(c.topology_key)
         if dom is None:
+            ignored = True
             continue
-        raw += sum(
+        w = np.float32(np.log(np.float64(len(domains)) + 2.0))
+        cnt = sum(
             1
             for q in st.pods_on_domain(c.topology_key, dom)
             if q.namespace == pod.namespace and c.label_selector.matches(q.labels)
-        ) + (1 if c.label_selector.matches(pod.labels) else 0)
-    return raw
+        )
+        raw = np.float32(raw + (np.float32(cnt) * w + np.float32(c.max_skew - 1)))
+    if not any_scored:
+        return None
+    if ignored:
+        return -1.0
+    # Upstream int64(math.Round(score)): floor(x+0.5) for non-negative x.
+    return float(np.floor(raw + np.float32(0.5)))

@@ -89,7 +89,13 @@ def greedy_replay(
     waves: Optional[WaveBatch] = None,
     wave_width: int = 8,
     preemption: bool = False,
+    completions_chunk_waves: Optional[int] = None,
 ) -> ReplayResult:
+    """``completions_chunk_waves``: mirror the device engines' chunk-granular
+    completions — before each chunk of that many waves, pods whose
+    ``arrival + duration`` is at or before the chunk's start time release
+    their resources and count contributions (they stay in ``assignments``:
+    a completed pod ran to completion, it is not unschedulable)."""
     config = config or FrameworkConfig()
     config.enable_preemption = False  # greedy semantics: no kube PostFilter
     fw = SchedulerFramework(ec, ep, config)
@@ -102,8 +108,23 @@ def greedy_replay(
     assignments = np.where(ep.bound_node >= 0, ep.bound_node, PAD).astype(np.int32)
     placed_total = 0
     preemptions = 0
+    rel_time = ep.arrival + np.where(np.isfinite(ep.duration), ep.duration, np.inf)
+    released = np.zeros(ep.num_pods, bool)
     t0 = time.perf_counter()
-    for wave in waves.idx:
+    for wi, wave in enumerate(waves.idx):
+        if completions_chunk_waves and wi % completions_chunk_waves == 0:
+            first = int(wave[0]) if wave.shape[0] else -1
+            t_chunk = float(ep.arrival[first]) if first >= 0 else np.inf
+            if np.isfinite(t_chunk):
+                due = np.nonzero(
+                    (st.bound >= 0)
+                    & ~released
+                    & np.isfinite(rel_time)
+                    & (rel_time <= t_chunk)
+                )[0]
+                for p in due:
+                    unbind(ec, ep, st, int(p))  # assignments keep the node
+                    released[p] = True
         slot_choice: List[int] = []
         slot_pods: List[int] = []
         evicted_in_wave: set = set()

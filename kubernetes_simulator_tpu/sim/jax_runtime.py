@@ -65,6 +65,9 @@ class StepSpec:
     # False the taint score row is a constant 100 on every node (raw ≡ 0 →
     # reverse max-normalize), which never changes the argmax — dropped.
     taint_score: bool = True
+    # [G] upstream PodTopologySpread topologyNormalizingWeight table:
+    # log(size + 2) per match-group's topology ([K8S] scoring.go).
+    sp_w_g: Tuple[float, ...] = ()
 
     @classmethod
     def from_config(
@@ -126,7 +129,23 @@ class StepSpec:
                 bool((pods.pref_aff >= 0).any()) if pods is not None else True
             ),
             has_gangs=(bool((pods.group_id >= 0).any()) if pods is not None else True),
+            sp_w_g=_spread_w_table(ec),
         )
+
+
+def _spread_w_table(ec: EncodedCluster) -> Tuple[float, ...]:
+    """[G] upstream topologyNormalizingWeight (log(size + 2)) per
+    match-group, matching ops.cpu.spread_weight value-for-value: f64 log
+    cast once to f32."""
+    G = max(ec.num_groups, 1)
+    gt = (
+        ec.group_topo[:G]
+        if ec.group_topo.shape[0] >= G
+        else np.full(G, PAD, np.int32)
+    )
+    nd_g = np.where(gt >= 0, ec.num_domains[np.clip(gt, 0, None)], 0)
+    w = np.log(nd_g.astype(np.float64) + 2.0).astype(np.float32)
+    return tuple(float(x) for x in w)
 
 
 def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec: StepSpec):
@@ -168,9 +187,11 @@ def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec:
         raw = T.interpod_score(d, st, s, spec.has_symmetric_pref)
         total = total + w.get("InterPodAffinity", 1.0) * T.normalize_min_max(raw, feasible)
     if spec.spread and w.get("PodTopologySpread", 1.0) != 0:
-        raw = T.spread_score(d, st, s)
-        total = total + w.get("PodTopologySpread", 1.0) * T.normalize_min_max(
-            raw, feasible, reverse=True
+        raw, ignored, any_sp = T.spread_score_upstream(
+            d, st, s, T._padded_w_table(spec.sp_w_g, d.gdom_f.shape[0])
+        )
+        total = total + w.get("PodTopologySpread", 1.0) * T.spread_upstream_normalize(
+            raw, ignored, feasible, any_sp
         )
     return feasible, total
 
@@ -291,11 +312,19 @@ class JaxReplayEngine:
         engine: str = "v3",
         dmax_coarse: int = 128,
         preemption: bool = False,
+        completions: bool = True,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
         label perturbations change topology domains). ``preemption``: the
-        greedy engines' tier preemption (sim.greedy docstring), v3 only."""
+        greedy engines' tier preemption (sim.greedy docstring), v3 only.
+        ``completions``: chunk-granular pod completions — before each chunk,
+        placed pods whose ``arrival + duration`` is at or before the chunk
+        start release their resources and count contributions (host-computed
+        delta planes subtracted from the carry). Active when the trace has
+        finite durations; not supported together with ``preemption`` (tier
+        planes cannot attribute releases) — preemption keeps the
+        no-completions semantics."""
         from ..ops import tpu3 as V3
 
         if preemption and engine != "v3":
@@ -308,6 +337,7 @@ class JaxReplayEngine:
         self.engine = engine
         self.dmax_coarse = dmax_coarse
         self.preemption = preemption
+        self.completions = completions
         self.dc = T.DevCluster.from_encoded(ec)
         self.waves = pack_waves(pods, wave_width)
         if engine == "v3":
@@ -366,6 +396,31 @@ class JaxReplayEngine:
         scheduled = ep.bound_node == PAD
         placed = int((assignments[scheduled] >= 0).sum())
         return assignments, placed
+
+    def _apply_release(self, state, rel_idx: np.ndarray, rel_nodes: np.ndarray):
+        """Subtract the completed pods' aggregate contribution (resources +
+        count planes) from the carried device state — the device twin of
+        models.state.unbind, applied at a chunk boundary."""
+        from ..models.state import release_delta
+        from ..ops import tpu3 as V3
+
+        used_d, mc_d, aa_d, pw_d = release_delta(
+            self.ec, self.pods, rel_idx, rel_nodes
+        )
+        if self.engine == "v3":
+            delta = V3.DevState3.from_host(
+                used_d, mc_d, aa_d, pw_d, self.ec, self.static3
+            )
+        else:
+            gdom = self._gdom
+            delta = T.DevState(
+                used=jnp.asarray(used_d),
+                match_count=jnp.asarray(T.domain_to_node_space(mc_d, gdom)),
+                anti_active=jnp.asarray(T.domain_to_node_space(aa_d, gdom)),
+                pref_wsum=jnp.asarray(T.domain_to_node_space(pw_d, gdom)),
+                match_total=jnp.asarray(mc_d.sum(axis=1)),
+            )
+        return jax.tree.map(jnp.subtract, state, delta)
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
         """Arrival time of each wave's first valid pod (for timed events)."""
@@ -454,7 +509,49 @@ class JaxReplayEngine:
             all_choices = [jnp.asarray(o) for o in ck.outs]
             start_chunk = ck.chunk_cursor
         pending_events = sorted(node_events or [], key=lambda e: e.time)
-        wave_times = self._wave_start_times(idx) if pending_events else None
+        rel_time = self.pods.arrival + np.where(
+            np.isfinite(self.pods.duration), self.pods.duration, np.inf
+        )
+        completions_on = bool(
+            self.completions
+            and not self.preemption
+            and np.isfinite(rel_time).any()
+        )
+        wave_times = (
+            self._wave_start_times(idx)
+            if (pending_events or completions_on)
+            else None
+        )
+        if completions_on:
+            host_assign = np.where(
+                self.pods.bound_node >= 0, self.pods.bound_node, PAD
+            ).astype(np.int32)
+            released = np.zeros(self.pods.num_pods, bool)
+            if start_chunk:
+                # Resume: rebuild placements from the saved outs, then mark
+                # every release an uninterrupted run would have applied at
+                # boundaries 0..start_chunk-1 (due at boundary b = placed in
+                # a chunk < b with release time ≤ the boundary's start).
+                # Pre-bound pods never appear in waves: chunk −1 so every
+                # boundary can release them (else resume re-subtracts).
+                chunk_of = np.where(
+                    self.pods.bound_node >= 0, -1, 1 << 30
+                ).astype(np.int64)
+                for cj in range(start_chunk):
+                    rows = idx[cj * C : (cj + 1) * C]
+                    ch = np.asarray(all_choices[cj]).reshape(rows.shape)
+                    v = rows >= 0
+                    host_assign[rows[v]] = ch[v]
+                    chunk_of[rows[v]] = cj
+                for b in range(start_chunk):
+                    tb = wave_times[b * C]
+                    if np.isfinite(tb):
+                        released |= (
+                            (host_assign != PAD)
+                            & (chunk_of < b)
+                            & np.isfinite(rel_time)
+                            & (rel_time <= tb)
+                        )
         saved_alloc = np.asarray(self.dc.allocatable).copy()
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
@@ -466,6 +563,20 @@ class JaxReplayEngine:
                 if due:
                     self._apply_node_events(due, saved_alloc)
                     pending_events = pending_events[len(due):]
+            if completions_on:
+                t_chunk = wave_times[c0]
+                if np.isfinite(t_chunk):
+                    due_p = np.nonzero(
+                        (host_assign != PAD)
+                        & ~released
+                        & np.isfinite(rel_time)
+                        & (rel_time <= t_chunk)
+                    )[0]
+                    if due_p.size:
+                        state = self._apply_release(
+                            state, due_p, host_assign[due_p]
+                        )
+                        released[due_p] = True
             slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
             if self.engine == "v3":
                 extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
@@ -473,6 +584,11 @@ class JaxReplayEngine:
             else:
                 state, choices = self.chunk_fn(self.dc, state, slots)
             all_choices.append(choices)
+            if completions_on:
+                rows = idx[c0 : c0 + C]
+                ch = np.asarray(choices).reshape(rows.shape)
+                v = rows >= 0
+                host_assign[rows[v]] = ch[v]
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
                 self._save_checkpoint(state, ci + 1, all_choices, checkpoint_path)
         jax.block_until_ready(all_choices[-1] if all_choices else state)

@@ -118,10 +118,17 @@ class CpuReplayEngine:
         node_events = node_events or []
         for i, ev in enumerate(node_events):
             push_event(ev.time, EV_NODE, i)
+        # Per-pod seq of the CURRENT finish timer: an eviction + re-bind
+        # re-arms the timer, and the stale event must not complete the pod
+        # early (same staleness class as gang permit timeouts).
+        finish_seq: Dict[int, int] = {}
+
         # Completions of pre-bound pods.
         for p in np.nonzero(pods.bound_node >= 0)[0]:
             if np.isfinite(pods.duration[p]):
-                push_event(float(pods.arrival[p] + pods.duration[p]), EV_FINISH, int(p))
+                finish_seq[int(p)] = push_event(
+                    float(pods.arrival[p] + pods.duration[p]), EV_FINISH, int(p)
+                )
 
         # Gang bookkeeping ([K8S] coscheduling Permit; SURVEY.md §3.3).
         reserved: Dict[int, List[int]] = {}
@@ -130,6 +137,12 @@ class CpuReplayEngine:
         failed_groups_ver: Dict[int, int] = {}  # group → progress_ver at failure
 
         placed = preemptions = attempts = 0
+        # Last successful placement per pod: a COMPLETED pod keeps its node
+        # (it ran; it is not unschedulable), unlike st.bound which goes PAD
+        # at EV_FINISH. Evictions clear it until re-placed.
+        assignments = np.where(pods.bound_node >= 0, pods.bound_node, PAD).astype(
+            np.int32
+        )
         now = 0.0
         # Committed cluster progress (commits, completions, evictions, node
         # events) — NOT speculative gang reserves. Gates timed gang retries
@@ -159,6 +172,7 @@ class CpuReplayEngine:
 
         def evict(p: int, requeue: bool = True):
             unbind(ec, pods, st, int(p))
+            assignments[int(p)] = PAD
             # An evicted reserved gang member returns to the queue
             # unreserved — drop it from the reservation so a later re-bind
             # cannot enter the members list twice.
@@ -185,8 +199,9 @@ class CpuReplayEngine:
                     if kind == EV_ARRIVAL:
                         q.push(payload, int(pods.priority[payload]))
                     elif kind == EV_FINISH:
-                        if st.bound[payload] != PAD:
+                        if st.bound[payload] != PAD and finish_seq.get(payload) == ev_seq:
                             unbind(ec, pods, st, payload)
+                            finish_seq.pop(payload, None)
                             progressed_cluster = True
                             progress_ver += 1
                     elif kind == EV_NODE:
@@ -249,8 +264,11 @@ class CpuReplayEngine:
                             placed += 1
                             made_bind = True
                             progress_ver += 1
+                            assignments[m] = st.bound[m]
                             if np.isfinite(pods.duration[m]):
-                                push_event(now + float(pods.duration[m]), EV_FINISH, m)
+                                finish_seq[m] = push_event(
+                                    now + float(pods.duration[m]), EV_FINISH, m
+                                )
                         gang_timeout_seq.pop(g, None)
                         failed_groups.pop(g, None)
                         failed_groups_ver.pop(g, None)
@@ -258,8 +276,11 @@ class CpuReplayEngine:
                     placed += 1
                     made_bind = True
                     progress_ver += 1
+                    assignments[p] = res.node
                     if np.isfinite(pods.duration[p]):
-                        push_event(now + float(pods.duration[p]), EV_FINISH, p)
+                        finish_seq[p] = push_event(
+                            now + float(pods.duration[p]), EV_FINISH, p
+                        )
                 if made_bind and q.num_unschedulable:
                     # Binding is a cluster event for affinity/spread waiters.
                     q.flush_unschedulable(now)
@@ -285,9 +306,9 @@ class CpuReplayEngine:
                 with np.errstate(invalid="ignore", divide="ignore"):
                     u = np.where(alloc > 0, st.used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
                 util[rname] = float(u.mean())
-        unsched = int((st.bound[to_schedule] == PAD).sum())
+        unsched = int((assignments[to_schedule] == PAD).sum())
         return ReplayResult(
-            assignments=st.bound.copy(),
+            assignments=assignments,
             placed=placed,
             unschedulable=unsched,
             preemptions=preemptions,

@@ -180,7 +180,11 @@ def test_scores_match_oracle(seed):
             )
             assert naw_np[n_idx] == pytest.approx(O.node_affinity_score(pod, node))
             assert ipa_np[n_idx] == pytest.approx(O.interpod_score(ost, pod, node)), (p_idx, n_idx)
-            assert spr_np[n_idx] == pytest.approx(O.spread_score(ost, pod, node))
+            spr_o = O.spread_score(ost, pod, node)
+            if spr_np is None:
+                assert spr_o is None, (p_idx, n_idx)
+            else:
+                assert spr_np[n_idx] == pytest.approx(spr_o), (p_idx, n_idx)
             assert tt_np[n_idx] == O.prefer_no_schedule_count(pod, node)
         mask = K.fit_mask(ec, st, ep, p_idx) & K.taint_mask(ec, ep, p_idx)
         if mask.any():

@@ -295,6 +295,65 @@ class TestPodTopologySpread:
         s = K.spread_score(ec, st, ep, 1)
         assert s[1] < s[0]  # zb less crowded → lower raw (better after reverse)
 
+    def test_upstream_scoring_values(self):
+        # [K8S] podtopologyspread scoring.go: raw = round(cnt·log(size+2) +
+        # (maxSkew−1)) (int64(math.Round)); NormalizeScore =
+        # 100·(max+min−s)//max.
+        import math
+
+        sel = LabelSelector.make({"app": "web"})
+        pods = [
+            Pod("w1", labels={"app": "web"}),
+            Pod("w2", labels={"app": "web"}),
+            Pod("w3", labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(2, "zone", "ScheduleAnyway", sel)
+                ]),
+        ]
+        ec, ep, st, _ = masks_for(
+            self._cluster(), pods, prebind=[(0, 0), (1, 0)]
+        )
+        s = K.spread_score(ec, st, ep, 2)
+        w = math.log(2 + 2)  # 2 zone domains
+        # za: 2 matching pods → 2·log4 + 1 = 3.77 → ROUNDS to 4 (a floor
+        # would give 3 — this case discriminates round from truncate).
+        assert s[0] == math.floor(2 * w + 1 + 0.5) == 4
+        assert s[1] == math.floor(0 * w + 1 + 0.5) == 1  # zb: empty
+        assert s[2] == -1.0  # missing key → ignored sentinel
+        out = K.spread_normalize(s, np.ones(3, bool))
+        hi, lo = int(s[0]), int(s[1])
+        assert out[0] == (100 * (hi + lo - hi)) // hi == 25
+        assert out[1] == (100 * (hi + lo - lo)) // hi == 100
+        assert out[2] == 0.0  # ignored normalizes to 0
+
+    def test_dns_only_constraints_skip_scoring(self):
+        # Only DoNotSchedule constraints → PreScore Skip (None): the
+        # plugin contributes nothing to the weighted sum.
+        sel = LabelSelector.make({"app": "web"})
+        pods = [
+            Pod("w1", labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(1, "zone", "DoNotSchedule", sel)
+                ]),
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods)
+        assert K.spread_score(ec, st, ep, 0) is None
+
+    def test_max_zero_normalizes_to_100(self):
+        # Empty cluster state: all raw 0 (skew 1 → maxSkew−1 = 0) → every
+        # non-ignored node scores MaxNodeScore, upstream maxScore==0 rule.
+        sel = LabelSelector.make({"app": "web"})
+        pods = [
+            Pod("w1", labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(1, "zone", "ScheduleAnyway", sel)
+                ]),
+        ]
+        ec, ep, st, _ = masks_for(self._cluster(), pods)
+        s = K.spread_score(ec, st, ep, 0)
+        out = K.spread_normalize(s, np.ones(3, bool))
+        assert out[0] == 100 and out[1] == 100 and out[2] == 0
+
 
 class TestDefaultSpreadConstraints:
     def test_system_defaulting_injects_and_spreads(self):
