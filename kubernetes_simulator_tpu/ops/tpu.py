@@ -403,15 +403,28 @@ def spread_score_upstream(d: Derived, st: DevState, s: PodSlot, w_g) -> tuple:
     return jnp.floor(raw + 0.5), ignored, jnp.any(scored)
 
 
-def spread_upstream_normalize(raw, ignored, feasible, any_scored) -> jax.Array:
-    """Upstream two-pass NormalizeScore (mirrors ops.cpu.spread_normalize
-    bit-for-bit): int32-exact ``100·(max+min−s) // max`` with extrema over
-    non-ignored feasible nodes; ignored → 0; max == 0 → 100; no scored
-    constraints → all 0."""
-    okn = feasible & ~ignored
-    hi = jnp.max(jnp.where(okn, raw, -jnp.inf))
-    lo = jnp.min(jnp.where(okn, raw, jnp.inf))
+def spread_norm_from_extrema(raw, ignored, hi, lo, any_scored, f32ok=False) -> jax.Array:
+    """The normalize half of :func:`spread_upstream_normalize`, with the
+    extrema (over feasible & ~ignored nodes, ±inf-masked reductions)
+    supplied by the caller — so they can ride a shared stacked reduce.
+
+    ``f32ok`` (static): when the trace bound guarantees raw ≤ 83886,
+    ``floor((100·(hi+lo−s)) / hi)`` computed in f32 equals the integer
+    division exactly (numerator ≤ 200·83886 < 2²⁴ is exactly
+    representable, and a misround needs hi·quotient > 2²⁴ — impossible
+    under the bound), so the slow int32 floordiv (no hardware int div on
+    TPU) is skipped."""
     has = hi > -jnp.inf
+    if f32ok:
+        hi_f = jnp.where(has, hi, 0.0)
+        lo_f = jnp.where(has, lo, 0.0)
+        pos = hi_f > 0
+        vals = jnp.floor(
+            (np.float32(MAX_NODE_SCORE) * (hi_f + lo_f - raw))
+            / jnp.where(pos, hi_f, 1.0)
+        )
+        out = jnp.where(pos, vals, np.float32(MAX_NODE_SCORE))
+        return jnp.where(ignored | ~has | ~any_scored, 0.0, out)
     hi_i = jnp.where(has, hi, 0.0).astype(jnp.int32)
     lo_i = jnp.where(has, lo, 0.0).astype(jnp.int32)
     vals = (np.int32(MAX_NODE_SCORE) * (hi_i + lo_i - raw.astype(jnp.int32))) // jnp.where(
@@ -419,6 +432,17 @@ def spread_upstream_normalize(raw, ignored, feasible, any_scored) -> jax.Array:
     )
     out = jnp.where(hi_i > 0, vals.astype(jnp.float32), np.float32(MAX_NODE_SCORE))
     return jnp.where(ignored | ~has | ~any_scored, 0.0, out)
+
+
+def spread_upstream_normalize(raw, ignored, feasible, any_scored, f32ok=False) -> jax.Array:
+    """Upstream two-pass NormalizeScore (mirrors ops.cpu.spread_normalize
+    bit-for-bit): int32-exact ``100·(max+min−s) // max`` with extrema over
+    non-ignored feasible nodes; ignored → 0; max == 0 → 100; no scored
+    constraints → all 0."""
+    okn = feasible & ~ignored
+    hi = jnp.max(jnp.where(okn, raw, -jnp.inf))
+    lo = jnp.min(jnp.where(okn, raw, jnp.inf))
+    return spread_norm_from_extrema(raw, ignored, hi, lo, any_scored, f32ok)
 
 
 # ---------------------------------------------------------------------------
@@ -844,16 +868,11 @@ def eval_pod_fused(
         if spec.has_symmetric_pref:
             raw = raw + jnp.einsum("g,gn->n", p.pmg_f, st.pref_wsum, precision=_HI)
         rows.append((raw, w.get("InterPodAffinity", 1.0), True, False))
-    if rows:
-        stack = jnp.stack([r[0] for r in rows])  # [Kn, N]
-        hi = jnp.max(jnp.where(feasible[None, :], stack, -jnp.inf), axis=1)
-        lo = jnp.min(jnp.where(feasible[None, :], stack, jnp.inf), axis=1)
-        for i, (raw, wt, minmax, reverse) in enumerate(rows):
-            out = _normalize_row(raw, lo[i], hi[i], any_f, minmax, reverse)
-            total = total + np.float32(wt) * out
+    sp_pack = None
     if spec.spread and w.get("PodTopologySpread", 1.0) != 0 and SP:
-        # Upstream scoring: own extrema (non-ignored feasible nodes) and the
-        # two-pass integer normalize — cannot share the stacked hi/lo pass.
+        # Upstream scoring: raw + ignored mask computed here; the extrema
+        # (over feasible & ~ignored) ride the shared stacked reduce below
+        # as an extra row with the ignored nodes pre-masked to ±inf.
         cnts = reads[A + B : A + B + SP]
         gval = p.gvalid[A + B : A + B + SP]
         raw_sp = jnp.zeros(N, jnp.float32)
@@ -862,10 +881,28 @@ def eval_pod_fused(
             contrib = cnts[i] * p.sp_w[i] + (p.sp_skew[i] - 1.0)
             raw_sp = raw_sp + jnp.where(p.sp_scored[i], contrib, 0.0)
             ignored = ignored | (p.sp_scored[i] & ~gval[i])
-        out = spread_upstream_normalize(
-            jnp.floor(raw_sp + 0.5), ignored, feasible, jnp.any(p.sp_scored)
-        )
-        total = total + np.float32(w.get("PodTopologySpread", 1.0)) * out
+        sp_pack = (jnp.floor(raw_sp + 0.5), ignored)
+    if rows or sp_pack is not None:
+        hi_rows = [r[0] for r in rows]
+        lo_rows = list(hi_rows)
+        if sp_pack is not None:
+            raw_sp, ignored = sp_pack
+            hi_rows.append(jnp.where(ignored, -jnp.inf, raw_sp))
+            lo_rows.append(jnp.where(ignored, jnp.inf, raw_sp))
+        hi_stack = jnp.where(feasible[None, :], jnp.stack(hi_rows), -jnp.inf)
+        lo_stack = jnp.where(feasible[None, :], jnp.stack(lo_rows), jnp.inf)
+        hi = jnp.max(hi_stack, axis=1)
+        lo = jnp.min(lo_stack, axis=1)
+        for i, (raw, wt, minmax, reverse) in enumerate(rows):
+            out = _normalize_row(raw, lo[i], hi[i], any_f, minmax, reverse)
+            total = total + np.float32(wt) * out
+        if sp_pack is not None:
+            raw_sp, ignored = sp_pack
+            out = spread_norm_from_extrema(
+                raw_sp, ignored, hi[-1], lo[-1], jnp.any(p.sp_scored),
+                getattr(spec, "sp_norm_f32", False),
+            )
+            total = total + np.float32(w.get("PodTopologySpread", 1.0)) * out
     return feasible, total, any_f
 
 

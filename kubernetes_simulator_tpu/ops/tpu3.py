@@ -671,20 +671,26 @@ def _fit_score_r(used1_r, alloc_r, weights, strategy, shape_x, shape_y) -> jax.A
     return jnp.floor(acc / np.float32(wsum))
 
 
-def _masked_hi_lo(stack: jax.Array, feasible: jax.Array):
-    """(hi, lo) over feasible nodes per row — ONE variadic reduce kernel
-    instead of two passes over the stack."""
+def _hi_lo_premasked(hi_in: jax.Array, lo_in: jax.Array):
+    """(hi, lo) per row from caller-masked inputs (−inf/+inf at excluded
+    nodes) — ONE variadic reduce kernel instead of two passes."""
 
     def comb(a, b):
         return jnp.maximum(a[0], b[0]), jnp.minimum(a[1], b[1])
 
-    hi_in = jnp.where(feasible[None, :], stack, -jnp.inf)
-    lo_in = jnp.where(feasible[None, :], stack, jnp.inf)
     return jax.lax.reduce(
         (hi_in, lo_in),
         (np.float32(-np.inf), np.float32(np.inf)),
         comb,
         dimensions=(1,),
+    )
+
+
+def _masked_hi_lo(stack: jax.Array, feasible: jax.Array):
+    """(hi, lo) over feasible nodes per row."""
+    return _hi_lo_premasked(
+        jnp.where(feasible[None, :], stack, -jnp.inf),
+        jnp.where(feasible[None, :], stack, jnp.inf),
     )
 
 
@@ -997,80 +1003,107 @@ def make_wave_step3(
                 if st.MP:
                     raw = raw + jnp.sum(vals[o5:o6], axis=0)
                 rows_n.append((raw, w_cfg.get("InterPodAffinity", 1.0), True, False))
-            if rows_n:
-                stack = jnp.stack([r[0] for r in rows_n])
-                hi, lo = _masked_hi_lo(stack, feasible)
+            sp_pack = None
+            if (
+                spec.spread
+                and w_cfg.get("PodTopologySpread", 1.0) != 0
+                and st.SP
+                and not spread_dom_hilo
+            ):
+                # Upstream scoring raw + ignored mask; extrema ride the
+                # shared stacked reduce as an extra ±inf-pre-masked row.
+                cnts = vals[o2:o3]
+                gval = gvalid[o2:o3]
+                raw_sp = jnp.zeros(N, jnp.float32)
+                sp_ign = jnp.zeros(N, bool)
+                for i in range(st.SP):
+                    contrib = cnts[i] * pre.sp_w[k, i] + (
+                        pre.sp_skew[k, i] - 1.0
+                    )
+                    raw_sp = raw_sp + jnp.where(
+                        pre.sp_scored[k, i], contrib, 0.0
+                    )
+                    sp_ign = sp_ign | (pre.sp_scored[k, i] & ~gval[i])
+                sp_pack = (jnp.floor(raw_sp + 0.5), sp_ign)
+            if rows_n or sp_pack is not None:
+                hi_rows = [jnp.where(feasible, r[0], -jnp.inf) for r in rows_n]
+                lo_rows = [jnp.where(feasible, r[0], jnp.inf) for r in rows_n]
+                if sp_pack is not None:
+                    # Spread extrema run over feasible & ~ignored: its row
+                    # is pre-masked with its own validity, then rides the
+                    # same variadic reduce as the other score rows.
+                    okn = feasible & ~sp_pack[1]
+                    hi_rows.append(jnp.where(okn, sp_pack[0], -jnp.inf))
+                    lo_rows.append(jnp.where(okn, sp_pack[0], jnp.inf))
+                hi, lo = _hi_lo_premasked(
+                    jnp.stack(hi_rows), jnp.stack(lo_rows)
+                )
                 # hi > -inf ⟺ some node is feasible: any() comes free.
-                any_f = hi[0] > -jnp.inf
+                any_f = (
+                    hi[0] > -jnp.inf if rows_n else jnp.any(feasible)
+                )
                 for i, (raw, wt, minmax, reverse) in enumerate(rows_n):
                     total = total + np.float32(wt) * _normalize_row(
                         raw, lo[i], hi[i], any_f, minmax, reverse
                     )
+                if sp_pack is not None:
+                    total = total + np.float32(
+                        w_cfg.get("PodTopologySpread", 1.0)
+                    ) * T2.spread_norm_from_extrema(
+                        sp_pack[0], sp_pack[1], hi[-1], lo[-1],
+                        jnp.any(pre.sp_scored[k]),
+                        getattr(spec, "sp_norm_f32", False),
+                    )
             else:
                 any_f = None
-            if spec.spread and w_cfg.get("PodTopologySpread", 1.0) != 0 and st.SP:
+            if (
+                spec.spread
+                and w_cfg.get("PodTopologySpread", 1.0) != 0
+                and st.SP
+                and spread_dom_hilo
+            ):
                 # Upstream scoring ([K8S] scoring.go): cnt·log(size+2) +
-                # (maxSkew−1), floored, two-pass integer normalize — own
-                # extrema over non-ignored feasible nodes (mirrors
-                # ops.cpu.spread_score/spread_normalize bit-for-bit).
+                # (maxSkew−1), rounded, two-pass integer normalize.
                 wt = w_cfg.get("PodTopologySpread", 1.0)
-                if spread_dom_hilo:
-                    # Domain-space form (SP == 1, coarse row): raw takes one
-                    # value per existing domain; label-less nodes are the
-                    # ignored set (the extra bucket), excluded from extrema
-                    # and normalized to 0.
-                    scored0 = pre.sp_scored[k, 0]
-                    raw_d = jnp.floor(
-                        rows_k[o2] * pre.sp_w[k, 0] + (pre.sp_skew[k, 0] - 1.0) + 0.5
-                    )  # [Dcap] — floor(x+0.5) = upstream math.Round, x ≥ 0
-                    dval = (
-                        jnp.arange(Dcap, dtype=jnp.float32) < nd_row[k, o2]
-                    )  # existing domains
-                    domfeas = (
-                        jnp.einsum(
-                            "n,nd->d", feasible.astype(jnp.float32), domoh2[k],
-                            precision=_HI,
-                        )
-                        > 0.5
-                    )  # [Dcap+1]
-                    okd = dval & domfeas[:Dcap]
-                    hi_sp = jnp.max(jnp.where(okd, raw_d, -jnp.inf))
-                    lo_sp = jnp.min(jnp.where(okd, raw_d, jnp.inf))
-                    has = hi_sp > -jnp.inf
-                    hi_i = jnp.where(has, hi_sp, 0.0).astype(jnp.int32)
-                    lo_i = jnp.where(has, lo_sp, 0.0).astype(jnp.int32)
-                    vals_d = (
-                        np.int32(T2.MAX_NODE_SCORE)
-                        * (hi_i + lo_i - raw_d.astype(jnp.int32))
-                    ) // jnp.where(hi_i > 0, hi_i, 1)
-                    out_d = jnp.where(
-                        hi_i > 0,
-                        vals_d.astype(jnp.float32),
-                        np.float32(T2.MAX_NODE_SCORE),
+                # Domain-space form (SP == 1, coarse row): raw takes one
+                # value per existing domain; label-less nodes are the
+                # ignored set (the extra bucket), excluded from extrema
+                # and normalized to 0.
+                scored0 = pre.sp_scored[k, 0]
+                raw_d = jnp.floor(
+                    rows_k[o2] * pre.sp_w[k, 0] + (pre.sp_skew[k, 0] - 1.0) + 0.5
+                )  # [Dcap] — floor(x+0.5) = upstream math.Round, x ≥ 0
+                dval = (
+                    jnp.arange(Dcap, dtype=jnp.float32) < nd_row[k, o2]
+                )  # existing domains
+                domfeas = (
+                    jnp.einsum(
+                        "n,nd->d", feasible.astype(jnp.float32), domoh2[k],
+                        precision=_HI,
                     )
-                    out_d = jnp.where(dval & has & scored0, out_d, 0.0)
-                    out = jnp.einsum(
-                        "nd,d->n", domoh2[k][:, :Dcap], out_d, precision=_HI
-                    )
-                    if any_f is None:
-                        any_f = jnp.any(domfeas)
-                else:
-                    cnts = vals[o2:o3]
-                    gval = gvalid[o2:o3]
-                    raw_sp = jnp.zeros(N, jnp.float32)
-                    ignored = jnp.zeros(N, bool)
-                    for i in range(st.SP):
-                        contrib = cnts[i] * pre.sp_w[k, i] + (
-                            pre.sp_skew[k, i] - 1.0
-                        )
-                        raw_sp = raw_sp + jnp.where(
-                            pre.sp_scored[k, i], contrib, 0.0
-                        )
-                        ignored = ignored | (pre.sp_scored[k, i] & ~gval[i])
-                    out = T2.spread_upstream_normalize(
-                        jnp.floor(raw_sp + 0.5), ignored, feasible,
-                        jnp.any(pre.sp_scored[k]),
-                    )
+                    > 0.5
+                )  # [Dcap+1]
+                okd = dval & domfeas[:Dcap]
+                hi_sp = jnp.max(jnp.where(okd, raw_d, -jnp.inf))
+                lo_sp = jnp.min(jnp.where(okd, raw_d, jnp.inf))
+                has = hi_sp > -jnp.inf
+                hi_i = jnp.where(has, hi_sp, 0.0).astype(jnp.int32)
+                lo_i = jnp.where(has, lo_sp, 0.0).astype(jnp.int32)
+                vals_d = (
+                    np.int32(T2.MAX_NODE_SCORE)
+                    * (hi_i + lo_i - raw_d.astype(jnp.int32))
+                ) // jnp.where(hi_i > 0, hi_i, 1)
+                out_d = jnp.where(
+                    hi_i > 0,
+                    vals_d.astype(jnp.float32),
+                    np.float32(T2.MAX_NODE_SCORE),
+                )
+                out_d = jnp.where(dval & has & scored0, out_d, 0.0)
+                out = jnp.einsum(
+                    "nd,d->n", domoh2[k][:, :Dcap], out_d, precision=_HI
+                )
+                if any_f is None:
+                    any_f = jnp.any(domfeas)
                 total = total + np.float32(wt) * out
             if any_f is None:
                 any_f = jnp.any(feasible)

@@ -68,6 +68,10 @@ class StepSpec:
     # [G] upstream PodTopologySpread topologyNormalizingWeight table:
     # log(size + 2) per match-group's topology ([K8S] scoring.go).
     sp_w_g: Tuple[float, ...] = ()
+    # Static guarantee that every possible spread raw ≤ 83886, making the
+    # f32 form of the normalize division exactly equal to integer division
+    # (see ops.tpu.spread_norm_from_extrema).
+    sp_norm_f32: bool = False
 
     @classmethod
     def from_config(
@@ -129,8 +133,30 @@ class StepSpec:
                 bool((pods.pref_aff >= 0).any()) if pods is not None else True
             ),
             has_gangs=(bool((pods.group_id >= 0).any()) if pods is not None else True),
-            sp_w_g=_spread_w_table(ec),
+            sp_w_g=(sp_w := _spread_w_table(ec)),
+            sp_norm_f32=_spread_norm_f32_ok(sp_w, pods) if sp_on else False,
         )
+
+
+def _spread_norm_f32_ok(sp_w, pods: Optional[EncodedPods]) -> bool:
+    """True when NO trace state can push a spread raw score past 83886 —
+    the bound under which the f32 normalize division is exactly the
+    integer division (ops.tpu.spread_norm_from_extrema). Conservative:
+    per-group counts are bounded by the total pods matching the group
+    (plus a wave-correction margin), summed over the pod's constraint
+    width at the largest weight/skew."""
+    if pods is None:
+        return False
+    SPw = pods.spread_g.shape[1]
+    if SPw == 0:
+        return True
+    pmg_tot = pods.pod_matches_group.sum(axis=0).astype(np.float64)
+    w = np.asarray(sp_w, np.float64)
+    L = min(len(pmg_tot), len(w))
+    gm = float((pmg_tot[:L] * w[:L]).max()) if L else 0.0
+    skew_max = float(pods.spread_skew.max()) if pods.spread_skew.size else 0.0
+    bound = SPw * (gm + 64.0 * w.max(initial=0.0) + max(skew_max - 1.0, 0.0))
+    return bound <= 80_000.0
 
 
 def _spread_w_table(ec: EncodedCluster) -> Tuple[float, ...]:
@@ -191,7 +217,7 @@ def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec:
             d, st, s, T._padded_w_table(spec.sp_w_g, d.gdom_f.shape[0])
         )
         total = total + w.get("PodTopologySpread", 1.0) * T.spread_upstream_normalize(
-            raw, ignored, feasible, any_sp
+            raw, ignored, feasible, any_sp, spec.sp_norm_f32
         )
     return feasible, total
 
