@@ -1,0 +1,574 @@
+"""v4 engine: a whole chunk of waves as ONE Pallas kernel (coarse shapes).
+
+The v3 wave scan is HBM-bound: every per-pod XLA op re-reads its [S, N]
+operand planes from HBM — ~240 plane passes per 8-pod wave on the Borg
+north-star shape (10k nodes), which at v5e HBM bandwidth IS the wall
+clock (~183s of pure traffic for 10k×1M×128). This kernel keeps the
+mutable state resident in VMEM for an entire chunk and streams only the
+tiny slot data, leaving the VPU work as the bound.
+
+Design notes (learned the slow way — the first cut was scalar-heavy and
+LOST to v3 by 2.8×):
+- Slot scalars live in SMEM (scalar-prefetch style BlockSpecs): VMEM
+  vector→scalar extracts cost ~100 cycles each through memory.
+- Spread counts are read from a node-space plane ``mc_node [G, Np]``
+  derived IN-KERNEL from the carried ``mc_dom [G, Dcap]`` once per chunk,
+  so the per-pod read is a plain row — no per-domain gathers.
+- The per-pod blocks that most pods don't need (spread constraint, match
+  -group updates, gang revert) are predicated with ``pl.when``.
+- Everything vector-wise is lane-oriented; the only transposes are tiny
+  (1, G) → (G, 1) columns guarded behind the same predicates.
+
+Scope (static gate, :func:`eligible`): NodeResourcesFit (LeastAllocated)
++ TaintToleration via toleration classes (no PreferNoSchedule scoring) +
+PodTopologySpread with at most ONE coarse constraint per pod (no host
+rows), no InterPodAffinity / NodeAffinity terms, no preemption. Gangs
+ARE handled (wave-deferred commit, in-kernel revert). Anything else
+falls back to v3.
+
+Parity: semantics mirror sim.greedy.greedy_replay (the anchor) — pod k
+sees speculative binds of j<k, wave-end gang rollback, lowest-index
+argmax tie-break, ops.tpu's exact LeastAllocated floor chain, and the
+upstream spread scoring (node-space extrema are exactly the dom_hilo
+extrema: every existing domain with a feasible node is represented).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.encode import PAD
+
+MAX_NODE_SCORE = 100.0
+_NEG = np.float32(-3.0e38)  # masked (base-infeasible) score
+_SPREAD_BLOCK = np.float32(1.0e30)  # DoNotSchedule / missing-key penalty
+
+MAX_DCAP = 128
+MAX_G = 32
+MAX_TOPO = 4
+
+
+def eligible(st, spec, ec=None) -> bool:
+    """Static shape gate for the v4 kernel (see module docstring)."""
+    if ec is not None:
+        gt = np.asarray(ec.group_topo[: st.G])
+        if len({int(t) for t in gt if t >= 0}) > MAX_TOPO:
+            return False
+    return bool(
+        not st.preemption
+        and not st.has_host_rows
+        and st.A == 0 and st.B == 0 and st.PA == 0
+        and st.MA == 0 and st.MP == 0
+        and st.SP <= 1
+        and not spec.node_affinity
+        and (not spec.taints or st.use_tol_classes)
+        and not spec.taint_score
+        and spec.fit and spec.fit_strategy == "LeastAllocated"
+        and st.Dcap <= MAX_DCAP
+        and st.G <= MAX_G
+    )
+
+
+class V4Static(NamedTuple):
+    C: int  # waves per chunk
+    W: int  # wave width
+    R: int  # resources
+    G: int  # match groups
+    Dcap: int  # max coarse domains
+    T: int  # distinct (referenced) topologies
+    Ct: int  # toleration classes
+    Np: int  # padded node count
+    N: int  # real node count
+    gdom_t: np.ndarray  # [T, Np] f32 node→domain per topology (PAD=-1)
+    topo_of_g: tuple  # [G] static topology slot per group (-1 = none)
+    sp_topo_slot: np.ndarray  # [G] per-group topology slot
+    w_tab: np.ndarray  # [G] f32 spread weights log(size+2)
+    nd_g: np.ndarray  # [G] domains per group
+
+
+def build_v4_static(ec, st, chunk_waves: int, wave_width: int) -> V4Static:
+    N = ec.num_nodes
+    Np = ((N + 255) // 256) * 256
+    G = st.G
+    gt = np.asarray(ec.group_topo[:G])
+    topos = sorted({int(t) for t in gt if t >= 0})
+    assert len(topos) <= MAX_TOPO, "v4 gate should have rejected this"
+    tslot = {t: i for i, t in enumerate(topos)}
+    T = max(len(topos), 1)
+    gdom_t = np.full((T, Np), float(PAD), np.float32)
+    for t, i in tslot.items():
+        gdom_t[i, :N] = ec.node_domain[t].astype(np.float32)
+    topo_of_g = tuple(tslot.get(int(t), -1) for t in gt)
+    w_tab = np.log(np.asarray(st.nd_g, np.float64) + 2.0).astype(np.float32)
+    Ct = max(len(st.tol_rep), 1) if st.use_tol_classes else 1
+    sp_topo_slot = np.array(
+        [tslot.get(int(t), -1) for t in gt], dtype=np.int32
+    )
+    return V4Static(
+        C=chunk_waves, W=wave_width, R=ec.num_resources, G=G,
+        Dcap=st.Dcap, T=T, Ct=Ct, Np=Np, N=N,
+        gdom_t=gdom_t, topo_of_g=topo_of_g, sp_topo_slot=sp_topo_slot,
+        w_tab=w_tab, nd_g=np.asarray(st.nd_g),
+    )
+
+
+class V4Slots(NamedTuple):
+    """Per-chunk slot tensors. All scalar-per-slot arrays are flattened to
+    [C*W] (SMEM); ``pmg`` stays a VMEM tensor."""
+
+    req: jax.Array  # [C*W*R] f32 (SMEM)
+    valid: jax.Array  # [C*W] i32 (SMEM)
+    group: jax.Array  # [C*W] i32 (SMEM)
+    tol_class: jax.Array  # [C*W] i32 (SMEM)
+    has_pmg: jax.Array  # [C*W] i32 (SMEM) — pod matches any group
+    sp_g: jax.Array  # [C*W] i32 (SMEM)
+    sp_t: jax.Array  # [C*W] i32 (SMEM)
+    sp_skew: jax.Array  # [C*W] f32 (SMEM)
+    sp_dns: jax.Array  # [C*W] i32 (SMEM)
+    sp_scored: jax.Array  # [C*W] i32 (SMEM)
+    sp_selfm: jax.Array  # [C*W] f32 (SMEM)
+    sp_w: jax.Array  # [C*W] f32 (SMEM)
+    sp_nd: jax.Array  # [C*W] f32 (SMEM)
+    any_gang: jax.Array  # [C] i32 (SMEM) — wave contains gang slots
+    pmg: jax.Array  # [C, W, G] f32 (VMEM)
+
+
+def build_slots(v4: V4Static, st, ep, idx: np.ndarray) -> V4Slots:
+    """Host-side slot gather for one chunk's wave rows ``idx [C, W]``."""
+    C, W = idx.shape
+    safe = np.clip(idx, 0, None)
+    validb = idx >= 0
+    valid = validb.astype(np.int32)
+    G = v4.G
+    pmg = ep.pod_matches_group[safe][:, :, :G].astype(np.float32)
+    pmg = pmg * validb[:, :, None]
+    group = np.where(validb, ep.group_id[safe], PAD).astype(np.int32)
+    tol_c = (
+        st.tol_class[safe] if st.tol_class.size else np.zeros_like(safe)
+    ).astype(np.int32)
+    if st.SP:
+        sp_g = np.where(validb, ep.spread_g[safe, 0], PAD).astype(np.int32)
+        gsafe = np.clip(sp_g, 0, None)
+        has = sp_g >= 0
+        sp_skew = np.where(has, ep.spread_skew[safe, 0], 0).astype(np.float32)
+        sp_dns = (ep.spread_dns[safe, 0] & has).astype(np.int32)
+        sp_scored = ((~ep.spread_dns[safe, 0]) & has).astype(np.int32)
+        sp_selfm = np.where(
+            has, ep.pod_matches_group[safe, gsafe], 0.0
+        ).astype(np.float32)
+        sp_t = np.clip(v4.sp_topo_slot[gsafe], 0, None).astype(np.int32)
+        sp_w = np.where(has, v4.w_tab[gsafe], 0.0).astype(np.float32)
+        sp_nd = np.where(has, v4.nd_g[gsafe], 0).astype(np.float32)
+    else:
+        sp_g = np.full((C, W), PAD, np.int32)
+        sp_t = np.zeros((C, W), np.int32)
+        sp_skew = np.zeros((C, W), np.float32)
+        sp_dns = np.zeros((C, W), np.int32)
+        sp_scored = np.zeros((C, W), np.int32)
+        sp_selfm = np.zeros((C, W), np.float32)
+        sp_w = np.zeros((C, W), np.float32)
+        sp_nd = np.zeros((C, W), np.float32)
+    flat = lambda a: jnp.asarray(np.ascontiguousarray(a).reshape(-1))
+    return V4Slots(
+        req=flat((ep.requests[safe] * validb[:, :, None]).astype(np.float32)),
+        valid=flat(valid),
+        group=flat(group),
+        tol_class=flat(tol_c),
+        has_pmg=flat((pmg.sum(axis=2) > 0).astype(np.int32)),
+        sp_g=flat(sp_g),
+        sp_t=flat(sp_t),
+        sp_skew=flat(sp_skew),
+        sp_dns=flat(sp_dns),
+        sp_scored=flat(sp_scored),
+        sp_selfm=flat(sp_selfm),
+        sp_w=flat(sp_w),
+        sp_nd=flat(sp_nd),
+        any_gang=jnp.asarray(((group >= 0).any(axis=1)).astype(np.int32)),
+        pmg=jnp.asarray(pmg),
+    )
+
+
+def _make_kernel(v4: V4Static, spec, *, has_gangs: bool, taints: bool,
+                 spread: bool):
+    C, W, R, G, Dcap, T, Np = v4.C, v4.W, v4.R, v4.G, v4.Dcap, v4.T, v4.Np
+    w_cfg = dict(spec.weights)
+    w_fit = np.float32(w_cfg.get("NodeResourcesFit", 1.0))
+    w_sp = np.float32(w_cfg.get("PodTopologySpread", 1.0))
+    rw = [float(x) for x in spec.resource_weights]
+    score_rs = [r for r in range(R) if rw[r] != 0.0]
+    wsum = np.float32(sum(rw[r] for r in score_rs))
+    sp_f32 = bool(getattr(spec, "sp_norm_f32", False))
+
+    def kernel(
+        # SMEM scalar inputs
+        req_s, valid_s, group_s, tolc_s, haspmg_s,
+        spg_s, spt_s, spskew_s, spdns_s, spsc_s, spselfm_s, spw_s, spnd_s,
+        anygang_s,
+        # VMEM tensor inputs
+        used0_ref, mc0_ref, alloc_ref, tol_ref, gdom_ref, tmask_ref, pmg_ref,
+        # outputs
+        used_ref, mc_ref, choice_ref,
+        # scratch
+        mcn_ref, nodes_ref, placed_ref, chrow_ref,
+    ):
+        iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, Np), 1).astype(
+            jnp.float32
+        )
+        iota_d_lane = jax.lax.broadcasted_iota(jnp.int32, (G, Dcap), 1).astype(
+            jnp.float32
+        )
+        alloc_blk = alloc_ref[0, :, :]  # [R, Np] loop-invariant
+
+        # Node-space count planes from the carried domain-space state:
+        # mcn[g, n] = mc_dom[g, dom_g(n)] (0 where the node lacks the key).
+        if spread:
+            for g in range(G):
+                t = v4.topo_of_g[g]
+                if t < 0:
+                    mcn_ref[g, :] = jnp.zeros((Np,), jnp.float32)
+                    continue
+                dom_row = gdom_ref[t, :].reshape(1, Np)
+                acc = jnp.zeros((1, Np), jnp.float32)
+                for d in range(int(v4.nd_g[g])):
+                    acc = acc + jnp.where(
+                        dom_row == np.float32(d), mc0_ref[0, g, d], 0.0
+                    )
+                mcn_ref[g, :] = acc.reshape(Np)
+
+        used_ref[...] = used0_ref[...]
+
+        def wave_body(c, mc_val):
+            # mc (tiny) is value-carried; used lives in its VMEM ref —
+            # carrying the [R, Np] plane as a loop value spilled and lost
+            # ~40% to the ref form.
+            base = c * W
+            for k in range(W):
+                o = base + k
+                valid_k = valid_s[o] > 0
+                req_col = jnp.concatenate(
+                    [
+                        jnp.full((1, 1), req_s[o * R + r], jnp.float32)
+                        for r in range(R)
+                    ],
+                    axis=0,
+                )  # [R, 1]
+                used_blk = used_ref[0, :, :]
+                used1_blk = used_blk + req_col
+                fit_blk = (used1_blk <= alloc_blk + np.float32(1e-6)).astype(
+                    jnp.float32
+                )
+                feas = jnp.min(fit_blk, axis=0, keepdims=True) > np.float32(
+                    0.5
+                )  # [1, Np]
+                if taints:
+                    trow = tol_ref[0, pl.ds(tolc_s[o], 1), :].reshape(1, Np)
+                    feas = feas & (trow > np.float32(0.5))
+
+                # LeastAllocated (exact _fit_score_r chain)
+                acc = jnp.zeros((1, Np), jnp.float32)
+                for r in score_rs:
+                    alloc_r = alloc_blk[r, :].reshape(1, Np)
+                    denom = jnp.where(alloc_r > 0, alloc_r, 1.0)
+                    frac = jnp.where(
+                        alloc_r > 0,
+                        (alloc_r - used1_blk[r, :].reshape(1, Np)) / denom,
+                        0.0,
+                    )
+                    frac = jnp.clip(frac, 0.0, 1.0)
+                    acc = acc + jnp.floor(
+                        frac * np.float32(MAX_NODE_SCORE)
+                    ) * np.float32(rw[r])
+                total = w_fit * (jnp.floor(acc / wsum) if wsum else acc)
+
+                if spread:
+                    g_k = spg_s[o]
+                    has_sp = g_k >= 0
+                    skew_k = spskew_s[o]
+                    is_dns = spdns_s[o] > 0
+                    scored_k = spsc_s[o] > 0
+                    cnt_n = mcn_ref[pl.ds(jnp.maximum(g_k, 0), 1), :].reshape(
+                        1, Np
+                    )
+                    dom_row = gdom_ref[pl.ds(spt_s[o], 1), :].reshape(1, Np)
+                    labeled = dom_row >= np.float32(0)
+                    minv = jnp.min(
+                        jnp.where(labeled, cnt_n, np.float32(np.inf))
+                    )
+                    has_dom = spnd_s[o] > 0
+                    minv0 = jnp.where(has_dom, minv, 0.0)
+                    ok_n = labeled & has_dom & (
+                        cnt_n + spselfm_s[o] - minv0 <= skew_k
+                    )
+                    raw_n = jnp.floor(
+                        cnt_n * spw_s[o] + (skew_k - 1.0) + np.float32(0.5)
+                    )
+                    okn = feas & labeled
+                    hi, lo = _hi_lo(jnp.where(okn, raw_n, jnp.nan))
+                    has = hi > _NEG
+                    if sp_f32:
+                        hi_f = jnp.where(has, hi, 0.0)
+                        lo_f = jnp.where(has, lo, 0.0)
+                        pos = hi_f > 0
+                        out_n = jnp.where(
+                            pos,
+                            jnp.floor(
+                                (np.float32(MAX_NODE_SCORE)
+                                 * (hi_f + lo_f - raw_n))
+                                / jnp.where(pos, hi_f, 1.0)
+                            ),
+                            np.float32(MAX_NODE_SCORE),
+                        )
+                    else:
+                        hi_i = jnp.where(has, hi, 0.0).astype(jnp.int32)
+                        lo_i = jnp.where(has, lo, 0.0).astype(jnp.int32)
+                        out_n = jnp.where(
+                            hi_i > 0,
+                            (
+                                (np.int32(MAX_NODE_SCORE)
+                                 * (hi_i + lo_i - raw_n.astype(jnp.int32)))
+                                // jnp.where(hi_i > 0, hi_i, 1)
+                            ).astype(jnp.float32),
+                            np.float32(MAX_NODE_SCORE),
+                        )
+                    sc = jnp.where(labeled & has & scored_k, out_n, 0.0) * w_sp
+                    pen = jnp.where(
+                        is_dns & ~(ok_n & labeled), -_SPREAD_BLOCK, 0.0
+                    )
+                    total = total + jnp.where(has_sp, sc + pen, 0.0)
+
+                # select: lowest-index argmax
+                masked = jnp.where(feas, total, _NEG)
+                mx = jnp.max(masked)
+                any_f = mx > np.float32(-1.0e29)
+                node_f = jnp.min(
+                    jnp.where(feas & (masked == mx), iota_n, np.float32(Np))
+                )
+                placed = any_f & valid_k
+                nodes_ref[k] = jnp.where(
+                    placed, node_f.astype(jnp.int32), np.int32(PAD)
+                )
+                placed_ref[k] = placed.astype(jnp.int32)
+
+                # speculative apply (value update — no VMEM traffic)
+                oh_n = jnp.where((iota_n == node_f) & placed, 1.0, 0.0)
+                used_ref[0, :, :] = used_blk + req_col * oh_n
+                if spread:
+                    do_mc = placed & (haspmg_s[o] > 0)
+                    dom_at = [
+                        jnp.sum(gdom_ref[t, :].reshape(1, Np) * oh_n)
+                        for t in range(T)
+                    ]
+                    dom_col = jnp.zeros((G, 1), jnp.float32)
+                    for t in range(T):
+                        dom_col = dom_col + tmask_ref[:, t:t + 1] * dom_at[t]
+                    pmg_row = pmg_ref[pl.ds(c, 1), k, :]  # [1, G]
+                    pmg_col = jnp.transpose(pmg_row, (1, 0))  # [G, 1]
+                    sel = jnp.where(do_mc, 1.0, 0.0)
+                    hasd = dom_col >= 0
+                    mc_val = mc_val + jnp.where(
+                        (iota_d_lane == dom_col) & hasd, pmg_col * sel, 0.0
+                    )
+
+                    @pl.when(do_mc)
+                    def _():
+                        gdom_g = jnp.concatenate(
+                            [
+                                gdom_ref[max(v4.topo_of_g[g], 0), :]
+                                .reshape(1, Np)
+                                for g in range(G)
+                            ],
+                            axis=0,
+                        )  # [G, Np]
+                        mcn_ref[...] = mcn_ref[...] + jnp.where(
+                            (gdom_g == dom_col) & hasd, pmg_col, 0.0
+                        )
+
+            # wave-end gang commit / revert
+            for k in range(W):
+                chrow_ref[k] = nodes_ref[k]
+            if has_gangs:
+                for k in range(W):
+                    o = base + k
+                    g_k = group_s[o]
+                    fail = (
+                        (group_s[base + 0] == g_k)
+                        & (valid_s[base + 0] > 0)
+                        & (placed_ref[0] == 0)
+                    )
+                    for j in range(1, W):
+                        fail = fail | (
+                            (group_s[base + j] == g_k)
+                            & (valid_s[base + j] > 0)
+                            & (placed_ref[j] == 0)
+                        )
+                    revert = (
+                        (anygang_s[c] > 0)
+                        & (g_k >= 0)
+                        & (placed_ref[k] > 0)
+                        & fail
+                    )
+                    rsel = jnp.where(revert, 1.0, 0.0)
+                    node_k = nodes_ref[k]
+                    oh_n = jnp.where(
+                        iota_n == node_k.astype(jnp.float32), rsel, 0.0
+                    )
+                    req_col = jnp.concatenate(
+                        [
+                            jnp.full((1, 1), req_s[o * R + r], jnp.float32)
+                            for r in range(R)
+                        ],
+                        axis=0,
+                    )
+                    used_ref[0, :, :] = used_ref[0, :, :] - req_col * oh_n
+                    chrow_ref[k] = jnp.where(revert, np.int32(PAD), node_k)
+                    if spread:
+                        do_mc = revert & (haspmg_s[o] > 0)
+                        dom_at = [
+                            jnp.sum(gdom_ref[t, :].reshape(1, Np) * oh_n)
+                            for t in range(T)
+                        ]
+                        dom_col = jnp.zeros((G, 1), jnp.float32)
+                        for t in range(T):
+                            dom_col = (
+                                dom_col + tmask_ref[:, t:t + 1] * dom_at[t]
+                            )
+                        pmg_row = pmg_ref[pl.ds(c, 1), k, :]
+                        pmg_col = jnp.transpose(pmg_row, (1, 0))
+                        sel = jnp.where(do_mc, 1.0, 0.0)
+                        hasd = dom_col >= 0
+                        mc_val = mc_val - jnp.where(
+                            (iota_d_lane == dom_col) & hasd,
+                            pmg_col * sel, 0.0,
+                        )
+
+                        @pl.when(do_mc)
+                        def _():
+                            gdom_g = jnp.concatenate(
+                                [
+                                    gdom_ref[max(v4.topo_of_g[g], 0), :]
+                                    .reshape(1, Np)
+                                    for g in range(G)
+                                ],
+                                axis=0,
+                            )
+                            mcn_ref[...] = mcn_ref[...] - jnp.where(
+                                (gdom_g == dom_col) & hasd, pmg_col, 0.0
+                            )
+
+            row = jnp.concatenate(
+                [jnp.full((1, 1), chrow_ref[k], jnp.int32) for k in range(W)],
+                axis=1,
+            )
+            choice_ref[0, pl.ds(c, 1), :] = row
+            return mc_val
+
+        mc_f = jax.lax.fori_loop(0, C, wave_body, mc0_ref[0, :, :])
+        mc_ref[0, :, :] = mc_f
+
+    return kernel
+
+
+def _hi_lo(x):
+    """(max, min) over non-NaN entries of ``x`` in one masked pair of
+    reduces (NaN marks excluded lanes)."""
+    isn = jnp.isnan(x)
+    hi = jnp.max(jnp.where(isn, _NEG, x))
+    lo = jnp.min(jnp.where(isn, np.float32(3.0e38), x))
+    return hi, lo
+
+
+def make_v4_chunk_fn(v4: V4Static, st, spec, interpret: bool = False):
+    """chunk_fn(used [S,R,Np] f32, mc [S,G,Dcap] f32, alloc [S,R,Np],
+    tol [S,Ct,Np] f32, slots) -> (used', mc', choices [S, C, W] i32)."""
+    C, W, R, G, Dcap, Ct, Np = (
+        v4.C, v4.W, v4.R, v4.G, v4.Dcap, v4.Ct, v4.Np,
+    )
+    kernel = _make_kernel(
+        v4, spec,
+        has_gangs=bool(st.has_gangs),
+        taints=bool(spec.taints),
+        spread=bool(spec.spread and st.SP),
+    )
+    gdom_c = jnp.asarray(v4.gdom_t)
+    tmask_c = jnp.asarray(
+        np.array(
+            [
+                [1.0 if v4.topo_of_g[g] == t else 0.0 for t in range(v4.T)]
+                for g in range(v4.G)
+            ],
+            np.float32,
+        )
+    )  # [G, T]
+
+    def chunk_fn(used, mc, alloc, tol, slots: V4Slots):
+        S = used.shape[0]
+        smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+        vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+        def per_s(shape):
+            return pl.BlockSpec(
+                (1,) + shape, lambda s: (s, 0, 0), memory_space=pltpu.VMEM
+            )
+
+        out_shape = (
+            jax.ShapeDtypeStruct((S, R, Np), jnp.float32),
+            jax.ShapeDtypeStruct((S, G, Dcap), jnp.float32),
+            jax.ShapeDtypeStruct((S, C, W), jnp.int32),
+        )
+        grid_spec = pl.GridSpec(
+            grid=(S,),
+            in_specs=[
+                smem, smem, smem, smem, smem,  # req..has_pmg
+                smem, smem, smem, smem, smem, smem, smem, smem,  # sp_*
+                smem,  # any_gang
+                per_s((R, Np)),  # used0
+                per_s((G, Dcap)),  # mc0
+                per_s((R, Np)),  # alloc
+                per_s((Ct, Np)),  # tol
+                vmem,  # gdom
+                vmem,  # tmask
+                vmem,  # pmg
+            ],
+            out_specs=(
+                per_s((R, Np)),
+                per_s((G, Dcap)),
+                per_s((C, W)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G, Np), jnp.float32),  # mc_node plane
+                pltpu.SMEM((W,), jnp.int32),  # nodes
+                pltpu.SMEM((W,), jnp.int32),  # placed
+                pltpu.SMEM((W,), jnp.int32),  # final choices
+            ],
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(
+            slots.req, slots.valid, slots.group, slots.tol_class,
+            slots.has_pmg,
+            slots.sp_g, slots.sp_t, slots.sp_skew, slots.sp_dns,
+            slots.sp_scored, slots.sp_selfm, slots.sp_w, slots.sp_nd,
+            slots.any_gang,
+            used, mc, alloc, tol, gdom_c, tmask_c, slots.pmg,
+        )
+
+    return chunk_fn
+
+
+def pad_nodes(a: np.ndarray, n_pad: int, fill=0.0) -> np.ndarray:
+    """Pad the last axis to ``n_pad`` with ``fill`` (host-side)."""
+    pad = n_pad - a.shape[-1]
+    if pad <= 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[-1] = (0, pad)
+    return np.pad(a, widths, constant_values=fill)

@@ -686,12 +686,6 @@ def _hi_lo_premasked(hi_in: jax.Array, lo_in: jax.Array):
     )
 
 
-def _masked_hi_lo(stack: jax.Array, feasible: jax.Array):
-    """(hi, lo) over feasible nodes per row."""
-    return _hi_lo_premasked(
-        jnp.where(feasible[None, :], stack, -jnp.inf),
-        jnp.where(feasible[None, :], stack, jnp.inf),
-    )
 
 
 def _expand_rows(rows: jax.Array, dom_oh_k: jax.Array) -> jax.Array:

@@ -358,14 +358,18 @@ class JaxReplayEngine:
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
-        self.wave_width = wave_width
         self.chunk_waves = chunk_waves
         self.engine = engine
         self.dmax_coarse = dmax_coarse
         self.preemption = preemption
         self.completions = completions
         self.dc = T.DevCluster.from_encoded(ec)
-        self.waves = pack_waves(pods, wave_width)
+        # "auto": measured optimum is W=8 across shapes (W=16 loses to the
+        # W² in-wave coupling even on coarse-only traces) — kept as a
+        # resolution point for when the kernel cost model changes.
+        if wave_width == "auto":
+            wave_width = 8
+        self.wave_width = wave_width
         if engine == "v3":
             self.static3 = V3.V3Static.build(
                 ec, pods, self.spec, dmax_coarse, preemption=preemption
@@ -377,6 +381,7 @@ class JaxReplayEngine:
             )
         else:
             self.chunk_fn = make_chunk_fn(wave_width, self.spec)
+        self.waves = pack_waves(pods, wave_width)
 
     def _init_dev_state(self):
         from ..ops import tpu3 as V3

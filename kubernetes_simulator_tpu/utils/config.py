@@ -154,7 +154,8 @@ class SimConfig:
             taint_p=float(wi.get("taintP", 0.1)),
         )
         cfg.output = d.get("output")
-        cfg.wave_width = int(d.get("waveWidth", 8))
+        ww = d.get("waveWidth", 8)
+        cfg.wave_width = ww if ww == "auto" else int(ww)
         cfg.chunk_waves = int(d.get("chunkWaves", 1024))
         cfg.device_preemption = bool(d.get("devicePreemption", False))
         return cfg

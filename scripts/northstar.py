@@ -1,0 +1,50 @@
+"""North-star per-chip slice (BASELINE.json): Borg-shaped 10k nodes x 1M
+tasks x S what-if scenarios on one chip. The v5e-8 projection is this slice
+at S_total = 8 x S with scenario data-parallelism over the mesh.
+
+Env knobs: NS_NODES, NS_TASKS, NS_S, NS_WAVE, NS_CHUNK.
+"""
+
+import os
+import time
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+
+def main():
+    nodes = int(os.environ.get("NS_NODES", 10_000))
+    tasks = int(os.environ.get("NS_TASKS", 1_000_000))
+    S = int(os.environ.get("NS_S", 128))
+    wave = int(os.environ.get("NS_WAVE", 8))
+    chunk = int(os.environ.get("NS_CHUNK", 2048))
+
+    t0 = time.perf_counter()
+    ec, ep, _ = make_borg_encoded(BorgSpec(nodes=nodes, tasks=tasks, seed=0))
+    print(f"trace gen: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    scenarios = uniform_scenarios(ec, S, seed=0)
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), wave_width=wave, chunk_waves=chunk
+    )
+    print(f"engine: {eng.engine}", flush=True)
+    if os.environ.get("NS_WARMUP", "1") not in ("", "0"):
+        t0 = time.perf_counter()
+        eng.run()
+        print(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    res = eng.run()
+    wall = time.perf_counter() - t0
+    placed = int(res.placed.sum())
+    attempts = S * tasks
+    print(
+        f"S={S} N={nodes} P={tasks} W={wave} C={chunk}: wall={wall:.1f}s "
+        f"placed={placed} attempts/s={attempts / wall / 1e6:.3f}M "
+        f"placements/s={placed / wall / 1e6:.3f}M",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""v4 Pallas chunk kernel (ops.pallas3): parity vs v3 and the greedy
+anchor, in interpreter mode on CPU. The engine is opt-in
+(K8SIM_ENABLE_V4=1) until it beats the v3 scan on hardware — these tests
+keep it correct while it is iterated on."""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
+from kubernetes_simulator_tpu.sim.greedy import greedy_replay
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+
+@pytest.fixture
+def v4_on(monkeypatch):
+    monkeypatch.setenv("K8SIM_ENABLE_V4", "1")
+
+
+def test_v4_selected_and_matches_anchor(v4_on):
+    ec, ep, _ = make_borg_encoded(BorgSpec(nodes=40, tasks=300, seed=0))
+    scenarios = uniform_scenarios(
+        ec, 2, seed=1, p_node_down=0.0, p_capacity=0.0, p_taint=0.0
+    )
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), chunk_waves=8,
+        collect_assignments=True,
+    )
+    assert eng.engine == "v4"
+    res = eng.run()
+    anchor = greedy_replay(ec, ep, FrameworkConfig(), wave_width=8)
+    np.testing.assert_array_equal(res.assignments[0], anchor.assignments)
+
+
+def test_v4_matches_v3_under_perturbations(v4_on, monkeypatch):
+    # Heavy contention + gangs + node-down/capacity/taint perturbations.
+    ec, ep, _ = make_borg_encoded(
+        BorgSpec(nodes=12, tasks=800, seed=3, gang_fraction=0.3, max_gang=6)
+    )
+    scenarios = uniform_scenarios(
+        ec, 3, seed=5, p_node_down=0.4, p_capacity=0.7, p_taint=0.5
+    )
+    eng4 = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), chunk_waves=16,
+        collect_assignments=True,
+    )
+    assert eng4.engine == "v4"
+    res4 = eng4.run()
+    monkeypatch.setenv("K8SIM_ENABLE_V4", "0")
+    eng3 = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), chunk_waves=16,
+        collect_assignments=True,
+    )
+    assert eng3.engine == "v3"
+    res3 = eng3.run()
+    np.testing.assert_array_equal(res4.placed, res3.placed)
+    for s in range(3):
+        np.testing.assert_array_equal(res4.assignments[s], res3.assignments[s])
+    assert (res4.unschedulable > 0).any()  # the case actually contends
+
+
+def test_v4_ineligible_shapes_fall_back(v4_on):
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+    cluster = make_cluster(16, seed=2)
+    pods, _ = make_workload(50, seed=2, with_affinity=True)  # interpod terms
+    ec, ep = encode(cluster, pods)
+    scenarios = uniform_scenarios(ec, 2, seed=0)
+    eng = WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=8)
+    assert eng.engine == "v3"
