@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .framework.registry import get_strategy
@@ -89,8 +90,93 @@ def cmd_whatif(args) -> int:
     return 0
 
 
+def validate_config(cfg) -> list:
+    """Structural checks → list of actionable error strings (empty = ok)."""
+    from .framework.registry import available_strategies
+    from .plugins.builtin import PLUGIN_FACTORIES
+
+    errors = []
+    # Built-ins register lazily — make them visible before consulting the
+    # registry (the L6 contract: validate agrees with get_strategy).
+    from .sim import runtime as _rt  # noqa: F401
+    try:
+        from .sim import jax_runtime as _jrt  # noqa: F401
+    except Exception:
+        pass
+    known_strategies = available_strategies()
+    if cfg.strategy not in known_strategies:
+        errors.append(
+            f"strategy: unknown '{cfg.strategy}' "
+            f"(registered: {', '.join(known_strategies)})"
+        )
+    ww = 8 if cfg.wave_width == "auto" else cfg.wave_width
+    for e in cfg.framework.plugins or []:
+        if not isinstance(e, dict) or "name" not in e:
+            errors.append(
+                f"profile.plugins: entry must be a mapping with name:, got {e!r}"
+            )
+            continue
+        name = e.get("name")
+        if name not in PLUGIN_FACTORIES:
+            errors.append(
+                f"profile.plugins: unknown plugin '{name}' "
+                f"(known: {', '.join(sorted(PLUGIN_FACTORIES))})"
+            )
+    known = set(PLUGIN_FACTORIES)
+    for name, w in (cfg.framework.weights or {}).items():
+        if name not in known:
+            errors.append(f"profile.weights: unknown plugin '{name}'")
+        elif not isinstance(w, (int, float)) or w < 0:
+            errors.append(f"profile.weights.{name}: must be a number >= 0")
+    if cfg.borg is not None:
+        if cfg.borg.nodes <= 0:
+            errors.append("workload.borg.nodes: must be > 0")
+        if cfg.borg.tasks <= 0:
+            errors.append("workload.borg.tasks: must be > 0")
+        if cfg.borg.max_gang > ww:
+            errors.append(
+                f"workload.borg.maxGang ({cfg.borg.max_gang}) exceeds "
+                f"waveWidth ({ww}): a gang must fit in one wave"
+            )
+        for p_attr, key in (
+            ("trace_path", "tracePath"),
+            ("instance_events", "instanceEvents"),
+            ("collection_events", "collectionEvents"),
+        ):
+            p = getattr(cfg.borg, p_attr, None)
+            if p and not os.path.exists(p):
+                errors.append(f"workload.borg.{key}: file not found: {p}")
+        if cfg.borg.cpu_scale <= 0 or cfg.borg.mem_scale <= 0:
+            errors.append("workload.borg.cpuScale/memScale: must be > 0")
+    else:
+        if cfg.cluster.nodes <= 0:
+            errors.append("cluster.nodes: must be > 0")
+        wl = cfg.workload
+        if wl is not None:
+            if wl.pods <= 0:
+                errors.append("workload.pods: must be > 0")
+            if wl.gang_fraction and wl.gang_size > ww:
+                errors.append(
+                    f"workload.gangSize ({wl.gang_size}) exceeds waveWidth "
+                    f"({ww}): a gang must fit in one wave"
+                )
+    if cfg.whatif.scenarios < 0:
+        errors.append("whatIf.scenarios: must be >= 0")
+    if cfg.chunk_waves <= 0:
+        errors.append("chunkWaves: must be > 0")
+    if cfg.wave_width != "auto" and cfg.wave_width <= 0:
+        errors.append("waveWidth: must be > 0 (or 'auto')")
+    if cfg.device_preemption and cfg.strategy == "cpu":
+        errors.append(
+            "devicePreemption requires strategy: jax (the cpu engine runs "
+            "kube PostFilter preemption instead)"
+        )
+    return errors
+
+
 def cmd_validate(args) -> int:
     cfg = SimConfig.load(args.config)
+    errors = validate_config(cfg)
     nodes = cfg.borg.nodes if cfg.borg else cfg.cluster.nodes
     tasks = (
         cfg.borg.tasks if cfg.borg
@@ -99,8 +185,9 @@ def cmd_validate(args) -> int:
     print(json.dumps({"strategy": cfg.strategy, "nodes": nodes, "tasks": tasks,
                       "workload": "borg" if cfg.borg else "synthetic",
                       "devicePreemption": cfg.device_preemption,
-                      "whatif_scenarios": cfg.whatif.scenarios}, indent=2))
-    return 0
+                      "whatif_scenarios": cfg.whatif.scenarios,
+                      "errors": errors}, indent=2))
+    return 1 if errors else 0
 
 
 def main(argv=None) -> int:

@@ -54,6 +54,10 @@ class SchedulerFramework:
         self.weights = weights
         self.ec = ec
         self.pods = pods
+        # Any required anti-affinity anywhere in the trace ⇒ symmetric
+        # checks make every pod's feasibility state-dependent (preemption
+        # fast path gate).
+        self._trace_has_anti = bool((pods.anti_req >= 0).any())
         # Per-extension-point latency accounting (SURVEY.md §5 tracing).
         self.plugin_time: Dict[str, float] = {}
 
@@ -130,22 +134,83 @@ class SchedulerFramework:
         lower = placed[(pods.priority[placed] < prio) & (pods.group_id[placed] == PAD)]
         if lower.size == 0:
             return None
-        for n in range(ec.num_nodes):
-            on_n = lower[bound_nodes[lower] == n]
-            if on_n.size == 0:
+        # State-INDEPENDENT filters (taints, node affinity) cannot change
+        # under evictions: evaluate once, skip nodes they reject, and use
+        # a node-local O(R) resource check inside the victim loop — the
+        # full mask is only recomputed to CONFIRM a fit (affinity/spread
+        # filters can also unblock from evictions, so a failed confirm
+        # keeps evicting). Replaces the O(nodes × victims × full-mask)
+        # recomputation that was pathological at 5k+ nodes.
+        static_mask = np.ones(ec.num_nodes, dtype=bool)
+        for pl in self.plugins:
+            if pl.name in ("NodeResourcesFit", "InterPodAffinity", "PodTopologySpread"):
                 continue
-            # Greedily evict lowest-priority victims until the pod fits.
-            order = on_n[np.lexsort((on_n, pods.priority[on_n]))]
-            trial = st.copy()
+            m = pl.filter(self.ctx, st, p)
+            if m is not None:
+                static_mask &= m
+        req = pods.requests[p]
+        names = {pl.name for pl in self.plugins}
+        has_fit = "NodeResourcesFit" in names
+        # When no state-DEPENDENT filter can reject node n for this pod
+        # (no required interpod terms on p, no anti-affinity anywhere in
+        # the trace to check symmetrically, no DoNotSchedule spread rows),
+        # feasibility at n is exactly static_mask[n] ∧ resource fit — the
+        # full-mask confirm is skipped entirely (the common, fit-bound
+        # preemption shape).
+        state_free = not (
+            (
+                "InterPodAffinity" in names
+                and (
+                    pods.aff_req[p, 0] >= 0
+                    or pods.anti_req[p, 0] >= 0
+                    or self._trace_has_anti
+                )
+            )
+            or (
+                "PodTopologySpread" in names
+                and bool(((pods.spread_g[p] >= 0) & pods.spread_dns[p]).any())
+            )
+        )
+        # Group victims by node once (sorted by priority asc then pod index
+        # — the greedy eviction order) instead of re-scanning per node.
+        order_all = np.lexsort((lower, pods.priority[lower], bound_nodes[lower]))
+        sorted_lower = lower[order_all]
+        node_of = bound_nodes[sorted_lower]
+        cand_nodes = np.unique(node_of)
+        seg_lo = np.searchsorted(node_of, cand_nodes, side="left")
+        seg_hi = np.searchsorted(node_of, cand_nodes, side="right")
+        for ci_n, n in enumerate(cand_nodes):
+            n = int(n)
+            if not static_mask[n]:
+                continue
+            order = sorted_lower[seg_lo[ci_n] : seg_hi[ci_n]]
             victims: List[int] = []
-            for v in order:
-                unbind(ec, pods, trial, int(v))
-                victims.append(int(v))
-                if self._fits_after(trial, p, n):
-                    break
+            fits = False
+            if has_fit and state_free:
+                # Vectorized: smallest k with all resources fitting after
+                # evicting order[:k+1] — no state copies at all.
+                cum = np.cumsum(pods.requests[order], axis=0)  # [K, R]
+                fit_k = np.all(
+                    st.used[n] + req - cum <= ec.allocatable[n] + 1e-6, axis=1
+                )
+                hit = np.nonzero(fit_k)[0]
+                if hit.size:
+                    fits = True
+                    victims = [int(v) for v in order[: hit[0] + 1]]
             else:
-                continue
-            if not self._fits_after(trial, p, n):
+                # Greedily evict lowest-priority victims until the pod fits.
+                trial = st.copy()
+                for v in order:
+                    unbind(ec, pods, trial, int(v))
+                    victims.append(int(v))
+                    if has_fit and not bool(
+                        np.all(trial.used[n] + req <= ec.allocatable[n] + 1e-6)
+                    ):
+                        continue
+                    if state_free or self._fits_after(trial, p, n):
+                        fits = True
+                        break
+            if not fits:
                 continue
             max_vprio = int(pods.priority[victims].max()) if victims else -(2**31)
             candidates.append((len(victims), max_vprio, n, victims))

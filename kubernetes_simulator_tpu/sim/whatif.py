@@ -139,26 +139,48 @@ class ScenarioSet:
         # ranks of kv ids among values present — matches the encoder's
         # sorted-unique ordering because kv ids were interned in vocab order;
         # we rank by label VALUE string to stay consistent).
-        T_keys = len(vocab.topo_keys)
         nd = np.repeat(ec.node_domain[None], S, axis=0).copy()
         ndom = np.repeat(ec.num_domains[None], S, axis=0).copy()
-        for si in range(S):
-            if not labels_dirty[si]:
-                continue
+        dirty = np.nonzero(labels_dirty)[0]
+        if dirty.size:
+            # Vectorized over nodes (the old per-node Python scan was
+            # O(S·T·N·slots) and dominated label-perturbation setup).
+            n_kv = len(vocab.kvs)
+            lk_d = lk[dirty]  # [Sd, N, L]
+            lv_d = lv[dirty]
             for ti, tkey in enumerate(vocab.topo_keys):
                 kid = vocab._k.get(tkey)
                 if kid is None:
                     continue
-                vals = np.full(ec.num_nodes, -1, np.int64)
-                for n in range(ec.num_nodes):
-                    slots = np.nonzero(lk[si, n] == kid)[0]
-                    vals[n] = lv[si, n, slots[0]] if slots.size else -1
-                present = vals >= 0
-                # rank by value string for determinism
-                uniq = sorted({int(v) for v in vals[present]}, key=lambda kv: vocab.kvs[kv][1])
-                rank = {v: i for i, v in enumerate(uniq)}
-                nd[si, ti] = np.array([rank.get(int(v), PAD) if p else PAD for v, p in zip(vals, present)], np.int32)
-                ndom[si, ti] = len(uniq)
+                # Global string-order position per kv id of this key: the
+                # per-scenario dense rank of present values then matches the
+                # encoder's sorted-unique ordering.
+                kv_of_key = [
+                    i for i in range(n_kv) if vocab.kvs[i][0] == tkey
+                ]
+                kv_of_key.sort(key=lambda i: vocab.kvs[i][1])
+                gpos = np.full(n_kv + 1, -1, np.int64)
+                for pos, i in enumerate(kv_of_key):
+                    gpos[i] = pos
+                is_k = lk_d == kid  # [Sd, N, L]
+                has = is_k.any(axis=2)
+                slot = is_k.argmax(axis=2)
+                vals = np.where(
+                    has,
+                    np.take_along_axis(lv_d, slot[..., None], 2)[..., 0],
+                    -1,
+                )  # [Sd, N] kv ids
+                g = np.where(vals >= 0, gpos[np.clip(vals, 0, n_kv)], -1)
+                for s_i, si in enumerate(dirty):
+                    row = g[s_i]
+                    present = row >= 0
+                    uniq = np.unique(row[present])
+                    out = np.full(ec.num_nodes, PAD, np.int32)
+                    out[present] = np.searchsorted(uniq, row[present]).astype(
+                        np.int32
+                    )
+                    nd[si, ti] = out
+                    ndom[si, ti] = len(uniq)
         self.max_domains = max(int(ndom.max()) if ndom.size else 1, ec.max_domains, 1)
         # v3 requires scenario-shared node→domain tables; label perturbations
         # that re-derive domains force the v2 (node-space) engine.

@@ -72,6 +72,12 @@ class BorgWorkloadSpec:
     max_gang: int = 8
     num_apps: int = 48  # template/app vocabulary (clip bound for app_id)
     trace_path: Optional[str] = None  # external task-event CSV (sim.borg)
+    # Real Borg-2019 schema ingest (sim.borg_etl): instance_events CSV
+    # (required for the ETL path) + optional collection_events fallback.
+    instance_events: Optional[str] = None
+    collection_events: Optional[str] = None
+    cpu_scale: float = 8.0
+    mem_scale: float = 16.0 * 2**30
 
 
 @dataclass
@@ -122,6 +128,10 @@ class SimConfig:
                 max_gang=int(b.get("maxGang", 8)),
                 num_apps=int(b.get("numApps", 48)),
                 trace_path=b.get("tracePath"),
+                instance_events=b.get("instanceEvents"),
+                collection_events=b.get("collectionEvents"),
+                cpu_scale=float(b.get("cpuScale", 8.0)),
+                mem_scale=float(b.get("memScale", 16.0 * 2**30)),
             )
         else:
             syn = wl.get("synthetic", wl) or {}
@@ -231,7 +241,16 @@ def build_encoded_case(cfg: SimConfig):
                 stacklevel=2,
             )
         spec = BorgSpec.from_spec(cfg.borg)
-        if cfg.borg.trace_path:
+        if getattr(cfg.borg, "instance_events", None):
+            from ..sim.borg_etl import load_borg2019
+
+            ec, ep, _ = load_borg2019(
+                cfg.borg.instance_events, spec,
+                collection_events=cfg.borg.collection_events,
+                cpu_scale=cfg.borg.cpu_scale,
+                mem_scale=cfg.borg.mem_scale,
+            )
+        elif cfg.borg.trace_path:
             ec, ep, _ = load_trace_csv(cfg.borg.trace_path, spec)
         else:
             ec, ep, _ = make_borg_encoded(spec)

@@ -5,10 +5,15 @@ tier preemption, odd wave widths. Not part of the CI suite (slow);
 run ad hoc before releases:
 
     JAX_PLATFORMS=cpu python scripts/fuzz_parity.py [trials] [master_seed]
+
+A reduced-width seeded slice runs in CI: tests/test_fuzz_parity.py
+(pytest -m fuzz) calls run_fuzz() below.
 """
 import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -18,55 +23,63 @@ from kubernetes_simulator_tpu.sim.greedy import greedy_replay
 from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
 from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
 
-TRIALS = int(sys.argv[1]) if len(sys.argv) > 1 else 48
-MASTER = int(sys.argv[2]) if len(sys.argv) > 2 else 123
-rng = np.random.default_rng(MASTER)
-fails = 0
-cases = 0
-for trial in range(TRIALS):
-    seed = int(rng.integers(10_000))
-    n_nodes = int(rng.choice([15, 40, 90, 160]))
-    n_pods = int(rng.choice([80, 200, 400]))
-    kw = dict(
-        with_affinity=bool(rng.random() < 0.7),
-        with_spread=bool(rng.random() < 0.7),
-        with_tolerations=bool(rng.random() < 0.7),
-        gang_fraction=float(rng.choice([0.0, 0.1, 0.25])),
-        gang_size=int(rng.choice([2, 3, 5])),
-    )
-    ext = None
-    if rng.random() < 0.3:
-        ext = ("google.com/tpu", 8, 0.3)
-    cluster = make_cluster(n_nodes, seed=seed, taint_fraction=float(rng.choice([0.0, 0.2, 0.5])),
-                           num_zones=int(rng.choice([2, 4, 8])),
-                           extended_resources={"google.com/tpu": (8, 0.25)} if ext else None)
-    pods, _ = make_workload(n_pods, seed=seed, extended_resource=ext, **kw)
-    ec, ep = encode(cluster, pods)
-    preempt = bool(rng.random() < 0.4)
-    dmax = int(rng.choice([0, 4, 128])) if not preempt else 128
-    cfg = FrameworkConfig()
-    wave_width = int(rng.choice([5, 8, 13]))
-    if kw["gang_fraction"] and kw["gang_size"] > wave_width:
-        wave_width = 8
-    try:
-        a = greedy_replay(ec, ep, cfg, wave_width=wave_width, preemption=preempt)
-        d = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
-                            dmax_coarse=dmax, preemption=preempt).replay()
-        if not preempt:
-            v2 = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width, engine="v2").replay()
-            assert (v2.assignments == a.assignments).all(), f"v2 mismatch trial={trial}"
 
-    except ValueError as e:
-        if "host" in str(e):  # preemption+host-rows guard
-            continue
-        raise
-    cases += 1
-    mism = int((a.assignments != d.assignments).sum())
-    ok = mism == 0 and a.placed == d.placed and a.preemptions == d.preemptions
-    if not ok:
-        fails += 1
-        print(f"FAIL trial={trial} seed={seed} nodes={n_nodes} pods={n_pods} "
-              f"kw={kw} preempt={preempt} dmax={dmax} W={wave_width} mism={mism} "
-              f"placed {a.placed} vs {d.placed} evict {a.preemptions} vs {d.preemptions}")
-print(f"{cases} cases, {fails} failures")
-sys.exit(1 if fails else 0)
+def run_fuzz(trials: int, master: int):
+  """(cases, fails) over ``trials`` randomized parity cases."""
+  rng = np.random.default_rng(master)
+  fails = 0
+  cases = 0
+  for trial in range(trials):
+      seed = int(rng.integers(10_000))
+      n_nodes = int(rng.choice([15, 40, 90, 160]))
+      n_pods = int(rng.choice([80, 200, 400]))
+      kw = dict(
+          with_affinity=bool(rng.random() < 0.7),
+          with_spread=bool(rng.random() < 0.7),
+          with_tolerations=bool(rng.random() < 0.7),
+          gang_fraction=float(rng.choice([0.0, 0.1, 0.25])),
+          gang_size=int(rng.choice([2, 3, 5])),
+      )
+      ext = None
+      if rng.random() < 0.3:
+          ext = ("google.com/tpu", 8, 0.3)
+      cluster = make_cluster(n_nodes, seed=seed, taint_fraction=float(rng.choice([0.0, 0.2, 0.5])),
+                             num_zones=int(rng.choice([2, 4, 8])),
+                             extended_resources={"google.com/tpu": (8, 0.25)} if ext else None)
+      pods, _ = make_workload(n_pods, seed=seed, extended_resource=ext, **kw)
+      ec, ep = encode(cluster, pods)
+      preempt = bool(rng.random() < 0.4)
+      dmax = int(rng.choice([0, 4, 128])) if not preempt else 128
+      cfg = FrameworkConfig()
+      wave_width = int(rng.choice([5, 8, 13]))
+      if kw["gang_fraction"] and kw["gang_size"] > wave_width:
+          wave_width = 8
+      try:
+          a = greedy_replay(ec, ep, cfg, wave_width=wave_width, preemption=preempt)
+          d = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
+                              dmax_coarse=dmax, preemption=preempt).replay()
+          if not preempt:
+              v2 = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width, engine="v2").replay()
+              assert (v2.assignments == a.assignments).all(), f"v2 mismatch trial={trial}"
+
+      except ValueError as e:
+          if "host" in str(e):  # preemption+host-rows guard
+              continue
+          raise
+      cases += 1
+      mism = int((a.assignments != d.assignments).sum())
+      ok = mism == 0 and a.placed == d.placed and a.preemptions == d.preemptions
+      if not ok:
+          fails += 1
+          print(f"FAIL trial={trial} seed={seed} nodes={n_nodes} pods={n_pods} "
+                f"kw={kw} preempt={preempt} dmax={dmax} W={wave_width} mism={mism} "
+                f"placed {a.placed} vs {d.placed} evict {a.preemptions} vs {d.preemptions}")
+  return cases, fails
+
+
+if __name__ == "__main__":
+  trials = int(sys.argv[1]) if len(sys.argv) > 1 else 48
+  master = int(sys.argv[2]) if len(sys.argv) > 2 else 123
+  cases, fails = run_fuzz(trials, master)
+  print(f"{cases} cases, {fails} failures")
+  sys.exit(1 if fails else 0)

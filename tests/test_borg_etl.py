@@ -1,0 +1,135 @@
+"""Real Borg-2019 schema ETL (sim.borg_etl): round-trip on synthetic
+files written in the actual collection_events / instance_events export
+shape (the dataset itself is unreachable — SURVEY.md §2 trace driver)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.sim.borg import BorgSpec
+from kubernetes_simulator_tpu.sim.borg_etl import Borg2019Etl, load_borg2019
+
+_US = 1_000_000
+
+
+def _write_trace(tmp_path, n_jobs=6, tasks_per_job=4):
+    """Tiny trace in the v3 export schema: jobs 100..; jobs 0/2/4 live in
+    alloc set 9000+j (gangs); odd instance 0 of every job FINISHes."""
+    inst = tmp_path / "instance_events.csv"
+    coll = tmp_path / "collection_events.csv"
+    with open(coll, "w") as f:
+        f.write("time,type,collection_id,priority,alloc_collection_id\n")
+        for j in range(n_jobs):
+            cid = 100 + j
+            alloc = 9000 + j if j % 2 == 0 else 0
+            f.write(f"{600 * _US},SUBMIT,{cid},{(j % 5) * 100},{alloc}\n")
+    with open(inst, "w") as f:
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        for j in range(n_jobs):
+            cid = 100 + j
+            alloc = 9000 + j if j % 2 == 0 else 0
+            prio = (j % 5) * 100
+            for i in range(tasks_per_job):
+                t = (600 + 10 * j + i) * _US
+                f.write(
+                    f"{t},0,{cid},{i},{prio},{alloc},0.05,0.01\n"
+                )
+            # instance 0 finishes 100s after its submit
+            f.write(
+                f"{(700 + 10 * j) * _US},FINISH,{cid},0,,,,\n"
+            )
+    return str(inst), str(coll)
+
+
+def test_roundtrip_shapes_and_mapping(tmp_path):
+    inst, coll = _write_trace(tmp_path)
+    etl = Borg2019Etl(inst, coll, cpu_scale=8.0, mem_scale=16 * 2**30)
+    cols = etl.read_cols()
+    P = 24
+    assert len(cols["arrival"]) == P
+    # duplicate SUBMITs are impossible here; FINISH maps to duration 100s
+    fin = np.isfinite(cols["duration"])
+    assert fin.sum() == 6  # one per job
+    assert np.allclose(cols["duration"][fin], 100.0)
+    # alloc sets → gangs: even jobs gang (12 tasks), odd jobs not
+    assert (cols["group_id"] >= 0).sum() == 12
+    # normalized resources scaled into cluster units
+    assert np.allclose(cols["cpu"], 0.05 * 8.0)
+    # lead-in removed: first arrival at t=0
+    assert cols["arrival"].min() == 0.0
+    # gang members co-arrive and are index-adjacent
+    g = cols["group_id"]
+    for gid in np.unique(g[g >= 0]):
+        at = np.nonzero(g == gid)[0]
+        assert (np.diff(at) == 1).all()
+        assert len(set(cols["arrival"][at])) == 1
+    # toleration rule: priority < 120 tolerates batch taints
+    assert (
+        (cols["tolerates"] == 1) == (cols["priority"] <= 119)
+    ).all()
+
+
+def test_load_and_replay(tmp_path):
+    inst, coll = _write_trace(tmp_path)
+    spec = BorgSpec(nodes=20, tasks=24, seed=0)
+    ec, ep, meta = load_borg2019(inst, spec, collection_events=coll)
+    assert ep.num_pods == 24
+    assert meta["num_gangs"] == 3
+    from kubernetes_simulator_tpu.sim.greedy import greedy_replay
+
+    res = greedy_replay(ec, ep, FrameworkConfig())
+    assert res.placed == 24  # tiny requests all fit
+
+
+def test_missing_submit_rejected(tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("time,type,collection_id,instance_index\n")
+    with pytest.raises(ValueError, match="no instance SUBMIT"):
+        Borg2019Etl(str(p)).read_cols()
+
+
+def test_config_plumbing(tmp_path):
+    inst, coll = _write_trace(tmp_path)
+    from kubernetes_simulator_tpu.utils.config import (
+        SimConfig,
+        build_encoded_case,
+    )
+
+    cfg = SimConfig.from_dict(
+        {
+            "workload": {
+                "borg": {
+                    "nodes": 20,
+                    "tasks": 24,
+                    "instanceEvents": inst,
+                    "collectionEvents": coll,
+                }
+            }
+        }
+    )
+    ec, ep = build_encoded_case(cfg)
+    assert ep.num_pods == 24
+
+
+def test_rescheduled_instance_duration_uses_last_submit(tmp_path):
+    # SUBMIT t=0, (evicted), re-SUBMIT t=1000, FINISH t=1100: arrival is
+    # the first submit, duration the FINAL runtime (100s), not the
+    # eviction-spanning lifetime (1100s).
+    inst = tmp_path / "inst.csv"
+    with open(inst, "w") as f:
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        f.write(f"{600 * _US},0,1,0,100,0,0.1,0.1\n")
+        f.write(f"{700 * _US},4,1,0,,,,\n")  # EVICT
+        f.write(f"{1600 * _US},0,1,0,100,0,0.1,0.1\n")  # re-SUBMIT
+        f.write(f"{1700 * _US},6,1,0,,,,\n")  # FINISH
+    cols = Borg2019Etl(str(inst)).read_cols()
+    assert cols["arrival"][0] == 0.0
+    assert np.isclose(cols["duration"][0], 100.0)

@@ -172,3 +172,57 @@ class TestEncodedCli:
         assert main(["run", str(cfgp)]) == 0
         out = capsys.readouterr().out
         assert '"kind": "replay-jax"' in out
+
+
+class TestValidate:
+    def _write(self, tmp_path, doc):
+        import yaml
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        return str(p)
+
+    def test_rejects_unknown_plugin_and_bad_gang(self, tmp_path, capsys):
+        from kubernetes_simulator_tpu.cli import main
+
+        cfg = self._write(
+            tmp_path,
+            {
+                "strategy": "jax",
+                "waveWidth": 4,
+                "workload": {"borg": {"nodes": 10, "tasks": 100, "maxGang": 8}},
+                "profile": {"plugins": [{"name": "NoSuchPlugin"}]},
+            },
+        )
+        rc = main(["validate", cfg])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "unknown plugin 'NoSuchPlugin'" in out
+        assert "exceeds" in out and "waveWidth" in out
+
+    def test_rejects_missing_trace_file(self, tmp_path, capsys):
+        from kubernetes_simulator_tpu.cli import main
+
+        cfg = self._write(
+            tmp_path,
+            {
+                "workload": {
+                    "borg": {
+                        "nodes": 10,
+                        "tasks": 10,
+                        "instanceEvents": "/no/such/file.csv",
+                    }
+                }
+            },
+        )
+        rc = main(["validate", cfg])
+        assert rc == 1
+        assert "file not found" in capsys.readouterr().out
+
+    def test_accepts_valid_config(self, capsys):
+        from kubernetes_simulator_tpu.cli import main
+
+        rc = main(["validate", "examples/config3_whatif_256.yaml"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert '"errors": []' in out
