@@ -148,3 +148,38 @@ def test_completions_resume_with_prebound(tmp_path):
         checkpoint_path=ck, resume=True
     )
     np.testing.assert_array_equal(full.assignments, resumed.assignments)
+
+
+def test_whatif_completions_scenario0_matches_single_replay():
+    # What-if scenarios now release completed pods per scenario: the
+    # unperturbed scenario must equal the single-chip replay (which has
+    # completions), and a capacity-perturbed scenario must diverge the
+    # usual way without breaking.
+    from kubernetes_simulator_tpu.sim.whatif import (
+        Perturbation,
+        Scenario,
+        WhatIfEngine,
+    )
+
+    cluster = make_cluster(10, seed=7)
+    pods, _ = make_workload(150, seed=7, arrival_rate=15.0, duration_mean=2.0,
+                            with_spread=True, with_tolerations=True)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = [
+        Scenario(),
+        Scenario([
+            Perturbation("scale_capacity", nodes=np.arange(5),
+                         resource="cpu", factor=0.5)
+        ]),
+    ]
+    eng = WhatIfEngine(ec, ep, scen, cfg, wave_width=4, chunk_waves=4,
+                       collect_assignments=True, completions=True)
+    assert eng.completions_on
+    res = eng.run()
+    single = JaxReplayEngine(ec, ep, cfg, wave_width=4, chunk_waves=4).replay()
+    np.testing.assert_array_equal(res.assignments[0], single.assignments)
+    # completions must change the outcome on this trace (non-vacuous)
+    off = WhatIfEngine(ec, ep, scen, cfg, wave_width=4, chunk_waves=4,
+                       collect_assignments=True).run()  # default: off
+    assert (off.assignments[0] != res.assignments[0]).any()

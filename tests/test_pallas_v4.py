@@ -24,7 +24,7 @@ def test_v4_selected_and_matches_anchor(v4_on):
     )
     eng = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), chunk_waves=8,
-        collect_assignments=True,
+        collect_assignments=True, completions=False,
     )
     assert eng.engine == "v4"
     res = eng.run()
@@ -42,14 +42,15 @@ def test_v4_matches_v3_under_perturbations(v4_on, monkeypatch):
     )
     eng4 = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), chunk_waves=16,
-        collect_assignments=True,
+        collect_assignments=True, completions=False,
     )
     assert eng4.engine == "v4"
     res4 = eng4.run()
     monkeypatch.setenv("K8SIM_ENABLE_V4", "0")
+    # v4 keeps no-completions semantics — compare v3 with them off too.
     eng3 = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), chunk_waves=16,
-        collect_assignments=True,
+        collect_assignments=True, completions=False,
     )
     assert eng3.engine == "v3"
     res3 = eng3.run()
