@@ -48,17 +48,20 @@ def main():
     cpu_res = greedy_replay(ec_s, ep_s, FrameworkConfig())
     cpu_pps = cpu_res.placements_per_sec
 
-    # JAX what-if batch: compile once (first run), then measure best-of-2
-    # (the tunneled device occasionally stalls a single run by >10x).
+    # JAX what-if batch: compile once (warmup run), then N timed runs.
+    # The headline is the MEDIAN rate — the tunneled device occasionally
+    # stalls a single run by >10x, and a single best-of-K number made
+    # cross-round comparisons indistinguishable from noise (round-2
+    # verdict); min/max/all walls ship in detail for spread inspection.
+    runs = max(1, int(os.environ.get("BENCH_RUNS", 5)))
     scenarios = uniform_scenarios(ec, S, seed=0)
     eng = WhatIfEngine(ec, ep, scenarios, cfg, chunk_waves=512)
     eng.run()  # warmup: compile + first execution
-    res = eng.run()
-    res2 = eng.run()
-    if res2.wall_clock_s < res.wall_clock_s:
-        res = res2
-
-    value = res.placements_per_sec
+    results = [eng.run() for _ in range(runs)]
+    walls = sorted(r.wall_clock_s for r in results)
+    med_wall = float(np.median(walls))
+    res = results[0]  # placement counts are identical across runs
+    value = res.total_placed / med_wall if med_wall > 0 else 0.0
     vs = value / cpu_pps if cpu_pps > 0 else 0.0
     print(
         json.dumps(
@@ -69,7 +72,11 @@ def main():
                 "unit": "placements/sec",
                 "vs_baseline": round(vs, 2),
                 "detail": {
-                    "jax_wall_s": round(res.wall_clock_s, 3),
+                    "jax_wall_median_s": round(med_wall, 3),
+                    "jax_wall_min_s": round(walls[0], 3),
+                    "jax_wall_max_s": round(walls[-1], 3),
+                    "jax_walls_s": [round(w, 3) for w in walls],
+                    "timed_runs": runs,
                     "jax_total_placed": res.total_placed,
                     "cpu_default_path_pps": round(cpu_pps, 1),
                     "scenario0_placed": int(res.placed[0]),

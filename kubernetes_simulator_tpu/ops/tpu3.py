@@ -104,6 +104,24 @@ class V3Static:
     anti_midx: np.ndarray  # [P, MA]
     pref_midx: np.ndarray  # [P, MP]
     has_gangs: bool
+    # Any DoNotSchedule spread constraint in the trace: when False the
+    # node-space spread FILTER block is statically absent (sp_dns is traced
+    # data, so XLA cannot DCE it; ScheduleAnyway-only traces — the Borg
+    # shape — would otherwise pay the [S, KT, N] count expansion for a
+    # filter that never fires). Profile round 3: _expand_rows was ~10% of
+    # device time on the north-star shape purely from this.
+    has_dns: bool
+    # All domain-bearing groups share one topology key (the Borg shape:
+    # zone-only): bound-node domain lookups collapse to one shared [N] map.
+    # ``topo0`` is that topology's id (PAD when no group carries domains);
+    # Shared3.build consumes it — ONE detection site.
+    single_topo: bool
+    topo0: int
+    # Structured shared-topology layout: "stride" (dom = n % D) or "block"
+    # (dom = n // (N/D)) — per-domain feasibility then reduces over a plain
+    # reshape instead of the [S, N]×[N, D] one-hot matmul. "" = no pattern.
+    seg_mode: str
+    seg_D: int
     # Toleration / node-affinity equivalence classes: pods sharing identical
     # term rows share one per-chunk [N] mask+raw (C ≪ P in real traces, e.g.
     # one class per workload template). class id PAD → fall back row 0 is a
@@ -272,7 +290,21 @@ class V3Static:
             and single_g[anti_h_ids].all()
             and max_pods * max(B, 1) <= 256
         )
+        topo_groups = (gt >= 0) & (nd_g > 0)
+        single_topo = bool(len(set(gt[topo_groups].tolist())) <= 1)
+        topo0 = int(gt[topo_groups][0]) if topo_groups.any() else PAD
+        seg_mode, seg_D = "", 0
+        if single_topo and topo0 != PAD:
+            dom = ec.node_domain[topo0]
+            D0 = int(ec.num_domains[topo0])
+            N = ec.num_nodes
+            if 0 < D0 <= Dcap and N % D0 == 0:
+                if (dom == np.arange(N) % D0).all():
+                    seg_mode, seg_D = "stride", D0
+                elif (dom == np.arange(N) // (N // D0)).all():
+                    seg_mode, seg_D = "block", D0
         out = cls(
+            seg_mode=seg_mode, seg_D=seg_D, topo0=topo0,
             tol_class=tol_class, tol_rep=tol_rep,
             na_class=na_class, na_rep=na_rep,
             preemption=preemption, Tt=Tt, pod_tier=pod_tier,
@@ -287,6 +319,10 @@ class V3Static:
             g2mc_h=inv(mc_h_ids), g2anti_h=inv(anti_h_ids), g2pref_h=inv(pref_h_ids),
             anti_midx=anti_midx, pref_midx=pref_midx,
             has_gangs=spec.has_gangs,
+            has_dns=bool(
+                SP and (ep.spread_dns[:, :SP] & (ep.spread_g[:, :SP] >= 0)).any()
+            ),
+            single_topo=single_topo,
         )
         if preemption and out.has_host_rows:
             raise ValueError(
@@ -342,13 +378,31 @@ class Shared3(NamedTuple):
     gdom_f: jax.Array  # [G, N] f32 domain of node n under group g (PAD=-1)
     coarse_f: jax.Array  # [G] f32 1.0 where coarse
     mt_mask: jax.Array  # [G] f32 1.0 where group has domains (for totals)
+    # single_topo fast path: the one shared node→domain map and the groups
+    # it applies to (all-PAD rows stay PAD through has_dom_g masking).
+    topo1_f: jax.Array  # [N] f32 (all-PAD when single_topo is False/vacuous)
+    has_dom_g: jax.Array  # [G] f32 1.0 where the group carries domains
 
     @classmethod
     def build(cls, ec: EncodedCluster, st: V3Static) -> "Shared3":
+        gdom = _gdom_table(ec, st.G)
+        gt = (
+            ec.group_topo[: st.G]
+            if ec.group_topo.shape[0] >= st.G
+            else np.full(st.G, PAD, np.int32)
+        )
+        # Single source of truth: V3Static.build already certified topo0 /
+        # single_topo; this only materializes the corresponding tensors.
+        if st.topo0 != PAD:
+            topo1 = ec.node_domain[st.topo0].astype(np.float32)
+        else:
+            topo1 = np.full(ec.num_nodes, float(PAD), np.float32)
         return cls(
-            gdom_f=jnp.asarray(_gdom_table(ec, st.G).astype(np.float32)),
+            gdom_f=jnp.asarray(gdom.astype(np.float32)),
             coarse_f=jnp.asarray((~st.is_host).astype(np.float32)),
             mt_mask=jnp.asarray((st.nd_g > 0).astype(np.float32)),
+            topo1_f=jnp.asarray(topo1),
+            has_dom_g=jnp.asarray(((gt >= 0) & (st.nd_g > 0)).astype(np.float32)),
         )
 
 
@@ -701,14 +755,16 @@ def class_masks(dc: DevCluster, d: Derived, st: V3Static, spec, rep_slots):
     gathered host-side at engine build). Computed ONCE per chunk."""
     tol_reps, na_reps = rep_slots
     out = {}
+    # 0/1 masks are bf16-exact; the per-pod row reads (dynamic_index in the
+    # wave step) then cost half the bytes. Raw score planes stay f32.
     if spec.taints and st.use_tol_classes:
         out["tol_ok"] = jax.vmap(lambda s: T2.taint_mask(dc, s))(tol_reps).astype(
-            jnp.float32
+            jnp.bfloat16
         )
         out["tol_raw"] = jax.vmap(lambda s: T2.taint_prefer_count(dc, s))(tol_reps)
     if spec.node_affinity and st.use_na_classes:
         out["na_ok"] = jax.vmap(lambda s: T2.node_affinity_mask(d, s))(na_reps).astype(
-            jnp.float32
+            jnp.bfloat16
         )
         out["na_raw"] = jax.vmap(lambda s: T2.node_affinity_score(d, s))(na_reps)
     return out
@@ -735,6 +791,17 @@ def make_wave_step3(
     # statically gone (no PreferNoSchedule), the whole [S, K, N] hi/lo
     # pass disappears from Borg-shaped traces.
     spread_dom_hilo = bool(spec.spread and st.SP == 1 and not st.has_host_rows)
+    # Node-space expansion of the domain rows ([S, KT, N] via the dom_oh
+    # one-hot matmul) is only needed when some section actually consumes
+    # node values: interpod sections, host planes, a real DoNotSchedule
+    # spread filter, or the node-space spread scoring path. The Borg shape
+    # (ScheduleAnyway-only spread, no interpod) statically skips it.
+    need_vals = bool(
+        st.A or st.B or st.MA or st.PA or st.MP
+        or st.has_host_rows
+        or (st.SP and (st.has_dns or not spread_dom_hilo))
+    )
+    pack_select = pack_select_ok(spec, w_cfg, dc.allocatable.shape[0])
 
     def wave_step(carry: DevState3, batch):
         sb, sx = batch
@@ -773,14 +840,26 @@ def make_wave_step3(
                         "wkh,hn->wkn", pre.oh_pref_h, carry.pref_host, precision=_HI
                     )
             totals0 = jnp.einsum("wkg,g->wk", pre.oh_row, carry.match_total, precision=_HI)
-            # Per-wave node→domain one-hot (scenario-shared) for expansion.
-            dom_oh = (
-                pre.dmap[..., None] == jnp.arange(Dcap, dtype=jnp.float32)
-            ).astype(jnp.float32)  # [W, KT, N, Dcap]
+            if need_vals:
+                # Per-wave node→domain one-hot (scenario-shared) for expansion.
+                dom_oh = (
+                    pre.dmap[..., None] == jnp.arange(Dcap, dtype=jnp.float32)
+                ).astype(jnp.float32)  # [W, KT, N, Dcap]
             if spread_dom_hilo:
-                # [W, N, Dcap+1]: spread-row domain one-hot + no-domain col.
+                # [W, N, Dcap+1]: spread-row domain one-hot + no-domain col
+                # (built from dmap directly — dom_oh may be skipped).
+                # bf16: 0/1 one-hots and the integer score values they meet
+                # (≤ MAX_NODE_SCORE) are bf16-exact; accumulation stays f32
+                # via preferred_element_type. Halves the dominant operand
+                # traffic of both domain einsums.
                 domoh2 = jnp.concatenate(
-                    [dom_oh[:, o2], (pre.dmap[:, o2] < 0)[..., None].astype(jnp.float32)],
+                    [
+                        (
+                            pre.dmap[:, o2][..., None]
+                            == jnp.arange(Dcap, dtype=jnp.float32)
+                        ).astype(jnp.bfloat16),
+                        (pre.dmap[:, o2] < 0)[..., None].astype(jnp.bfloat16),
+                    ],
                     axis=-1,
                 )
             # #domains per row (for the domain-space spread min).
@@ -891,40 +970,56 @@ def make_wave_step3(
             nonfit = jnp.ones(N, bool)
             if spec.taints:
                 if st.use_tol_classes:
-                    oh_c = (
-                        jnp.arange(len(st.tol_rep)) == sx.tol_class[k]
-                    ).astype(jnp.float32)
+                    # Row select by class id — a dynamic slice reads ONE
+                    # [N] row. (The old one-hot einsum contracted the whole
+                    # [C, N] plane per pod: 40% of device time on the
+                    # north-star profile.) Values identical: one-hot × f32
+                    # picked the same row exactly.
                     tok_k = (
-                        jnp.einsum("c,cn->n", oh_c, cmasks["tol_ok"], precision=_HI) > 0.5
+                        jax.lax.dynamic_index_in_dim(
+                            cmasks["tol_ok"], sx.tol_class[k], 0, keepdims=False
+                        )
+                        > 0.5
                     )
-                    traw_k = jnp.einsum("c,cn->n", oh_c, cmasks["tol_raw"], precision=_HI)
+                    traw_k = jax.lax.dynamic_index_in_dim(
+                        cmasks["tol_raw"], sx.tol_class[k], 0, keepdims=False
+                    )
                 else:
                     tok_k, traw_k = pre.taint_ok[k], pre.taint_raw[k]
                 nonfit = nonfit & tok_k
             if spec.node_affinity:
                 if st.use_na_classes:
-                    oh_c = (
-                        jnp.arange(len(st.na_rep)) == sx.na_class[k]
-                    ).astype(jnp.float32)
                     naok_k = (
-                        jnp.einsum("c,cn->n", oh_c, cmasks["na_ok"], precision=_HI) > 0.5
+                        jax.lax.dynamic_index_in_dim(
+                            cmasks["na_ok"], sx.na_class[k], 0, keepdims=False
+                        )
+                        > 0.5
                     )
-                    naraw_k = jnp.einsum("c,cn->n", oh_c, cmasks["na_raw"], precision=_HI)
+                    naraw_k = jax.lax.dynamic_index_in_dim(
+                        cmasks["na_raw"], sx.na_class[k], 0, keepdims=False
+                    )
                 else:
                     naok_k, naraw_k = pre.na_ok[k], pre.na_raw[k]
                 nonfit = nonfit & naok_k
 
-            # Materialize the shared [N]-planes once: stops XLA from
-            # re-deriving used1/feasible inside every reduce-rooted kernel.
-            used1_r = list(jax.lax.optimization_barrier(tuple(used1_r)))
+            # Materialize `feasible` once: it feeds several reduce-rooted
+            # kernels (domfeas, select). used1_r stays UN-materialized since
+            # round 3 — its two consumers (the feasible fusion and the
+            # select reduce's fit score) each re-derive it from carry.used
+            # at the same read cost, and skipping the barrier removes the
+            # R×[S, N] write per pod (~14% of device time on the profile).
+            # Preemption still materializes (prefit re-reads used1_r).
+            if st.preemption:
+                used1_r = list(jax.lax.optimization_barrier(tuple(used1_r)))
             feasible = jax.lax.optimization_barrier(feasible)
             if st.KT:
                 rows_k = rows0[k] + rows_corr  # [KT, Dcap]
-                vals = _expand_rows(rows_k, dom_oh[k])
-                if st.has_host_rows:
-                    vals = vals + vals_h0[k] + valh_corr
-                gvalid = pre.dmap[k] >= 0  # [KT, N]
                 totals = totals0[k] + tot_corr
+                if need_vals:
+                    vals = _expand_rows(rows_k, dom_oh[k])
+                    if st.has_host_rows:
+                        vals = vals + vals_h0[k] + valh_corr
+                    gvalid = pre.dmap[k] >= 0  # [KT, N]
 
             if spec.interpod and st.A:
                 cnt = vals[o0:o1]
@@ -941,7 +1036,7 @@ def make_wave_step3(
             if spec.interpod and st.MA:
                 blocked = jnp.sum(vals[o4:o5], axis=0) > 0.5
                 nonfit = nonfit & ~blocked
-            if spec.spread and st.SP:
+            if spec.spread and st.SP and st.has_dns:
                 cnts = vals[o2:o3]
                 gval = gvalid[o2:o3]
                 # Min over domains — every existing domain has ≥1 node, so
@@ -1070,13 +1165,33 @@ def make_wave_step3(
                 dval = (
                     jnp.arange(Dcap, dtype=jnp.float32) < nd_row[k, o2]
                 )  # existing domains
-                domfeas = (
-                    jnp.einsum(
-                        "n,nd->d", feasible.astype(jnp.float32), domoh2[k],
-                        precision=_HI,
+                if st.seg_mode:
+                    # Structured layout: per-domain any() over a reshape of
+                    # the feasibility plane (≈12% of device time as a
+                    # one-hot matmul on the north-star profile). Exact: for
+                    # a PAD spread row the downstream out_d is masked to 0
+                    # by sp_scored either way, and any(domfeas) still
+                    # equals any(feasible) — every node carries a domain
+                    # under the detected pattern.
+                    if st.seg_mode == "stride":
+                        core = jnp.any(
+                            feasible.reshape(-1, st.seg_D), axis=0
+                        )  # [D]
+                    else:
+                        core = jnp.any(
+                            feasible.reshape(st.seg_D, -1), axis=1
+                        )
+                    domfeas = jnp.concatenate(
+                        [core, jnp.zeros(Dcap + 1 - st.seg_D, bool)]
                     )
-                    > 0.5
-                )  # [Dcap+1]
+                else:
+                    domfeas = (
+                        jnp.einsum(
+                            "n,nd->d", feasible.astype(jnp.bfloat16), domoh2[k],
+                            precision=_HI, preferred_element_type=jnp.float32,
+                        )
+                        > 0.5
+                    )  # [Dcap+1]
                 okd = dval & domfeas[:Dcap]
                 hi_sp = jnp.max(jnp.where(okd, raw_d, -jnp.inf))
                 lo_sp = jnp.min(jnp.where(okd, raw_d, jnp.inf))
@@ -1093,8 +1208,10 @@ def make_wave_step3(
                     np.float32(T2.MAX_NODE_SCORE),
                 )
                 out_d = jnp.where(dval & has & scored0, out_d, 0.0)
+                # out_d holds integer scores in [0, 100] — bf16-exact.
                 out = jnp.einsum(
-                    "nd,d->n", domoh2[k][:, :Dcap], out_d, precision=_HI
+                    "nd,d->n", domoh2[k][:, :Dcap], out_d.astype(jnp.bfloat16),
+                    precision=_HI, preferred_element_type=jnp.float32,
                 )
                 if any_f is None:
                     any_f = jnp.any(domfeas)
@@ -1102,7 +1219,10 @@ def make_wave_step3(
             if any_f is None:
                 any_f = jnp.any(feasible)
 
-            node, _ = select_node(total, feasible)
+            if pack_select:
+                node, _ = T2.select_node_packed(total, feasible)
+            else:
+                node, _ = select_node(total, feasible)
             placed = any_f & s.valid
             if st.preemption:
                 tier_k = sx.tier[k]  # shared scalar
@@ -1171,10 +1291,22 @@ def make_wave_step3(
                     )
                 evicted.append(jnp.zeros((), bool))
             if maintain_dom:
-                oh_n = ((iota_n == node) & (node >= 0)).astype(jnp.float32)
-                dom_at = jnp.einsum("gn,n->g", sh.gdom_f, oh_n, precision=_HI)
-                # A miss (or padded slot) must not look like domain 0.
-                dom_at = jnp.where(placed, dom_at, float(PAD))
+                if st.single_topo:
+                    # Every domain-bearing group shares ONE topology: the
+                    # bound node's domain is a single dynamic read of the
+                    # shared [N] map, broadcast over groups — instead of an
+                    # einsum streaming the whole [G, N] table per pod.
+                    dom1 = jax.lax.dynamic_index_in_dim(
+                        sh.topo1_f, jnp.clip(node, 0), 0, keepdims=False
+                    )
+                    dom_at = jnp.where(
+                        placed & (sh.has_dom_g > 0.5), dom1, float(PAD)
+                    )
+                else:
+                    oh_n = ((iota_n == node) & (node >= 0)).astype(jnp.float32)
+                    dom_at = jnp.einsum("gn,n->g", sh.gdom_f, oh_n, precision=_HI)
+                    # A miss (or padded slot) must not look like domain 0.
+                    dom_at = jnp.where(placed, dom_at, float(PAD))
                 dom_ats.append(dom_at)
             choices.append(node)
             placeds.append(placed)
@@ -1201,14 +1333,32 @@ def make_wave_step3(
         wv = commit.astype(jnp.float32)  # [W]
         wv_used = commit_used.astype(jnp.float32)  # [W]
         # One-hots rebuilt from chosen-node indices, bf16 operands: exact
-        # (0/1 values), half the einsum traffic of stacked f32 planes.
-        oh_all = (
-            (iota_n[None, :] == choice[:, None]) & (choice[:, None] >= 0)
-        ).astype(jnp.bfloat16)  # [W, N]
-        used = carry.used + jnp.einsum(
-            "w,wn,wr->rn", wv_used, oh_all, sb.req,
-            precision=_HI, preferred_element_type=jnp.float32,
-        )
+        # (0/1 values), half the einsum traffic of stacked f32 planes. Only
+        # the host-plane / tier commits still consume them — the `used`
+        # update itself is an unrolled elementwise add since round 3 (the
+        # [W, N]×[W, R] dot emitted layout copies around the carry that
+        # cost more than the dot; same f32 sum of the same multiset).
+        need_oh_all = st.preemption or st.has_host_rows
+        if need_oh_all:
+            oh_all = (
+                (iota_n[None, :] == choice[:, None]) & (choice[:, None] >= 0)
+            ).astype(jnp.bfloat16)  # [W, N]
+        if st.preemption:
+            used = carry.used + jnp.einsum(
+                "w,wn,wr->rn", wv_used, oh_all, sb.req,
+                precision=_HI, preferred_element_type=jnp.float32,
+            )
+        else:
+            coefs = wv_used[:, None] * sb.req  # [W, R] tiny
+            rows_u = []
+            for r in range(R):
+                acc = carry.used[r]
+                for w in range(wave_width):
+                    acc = acc + jnp.where(
+                        iota_n == choice[w], coefs[w, r], 0.0
+                    )
+                rows_u.append(acc)
+            used = jnp.stack(rows_u)
         used_tier, npods_tier = carry.used_tier, carry.npods_tier
         if st.preemption:
             # Eviction: free the wave-start lower-tier usage at the node.
@@ -1313,6 +1463,29 @@ def make_wave_step3(
         return new_state, final
 
     return wave_step
+
+
+def pack_select_ok(spec, w_cfg, n_nodes: int) -> bool:
+    """Static gate for ops.tpu.select_node_packed (see its exactness
+    bounds): integer non-negative weights on every ACTIVE score row keep
+    the total an integer ≤ 100·Σw, so (total, node) packs exactly into
+    f32 when 100·Σw ≤ PACK_MAX_TOTAL and N ≤ PACK_MAX_NODES."""
+    w_active = [
+        w_cfg.get(name, 1.0)
+        for name, on in (
+            ("NodeResourcesFit", spec.fit),
+            ("TaintToleration", spec.taints and spec.taint_score),
+            ("NodeAffinity", spec.node_affinity),
+            ("InterPodAffinity", spec.interpod),
+            ("PodTopologySpread", spec.spread),
+        )
+        if on and w_cfg.get(name, 1.0) != 0
+    ]
+    return (
+        n_nodes <= T2.PACK_MAX_NODES
+        and all(float(w).is_integer() and w >= 0 for w in w_active)
+        and 100.0 * sum(w_active) <= T2.PACK_MAX_TOTAL
+    )
 
 
 def kind_masks(st: V3Static):

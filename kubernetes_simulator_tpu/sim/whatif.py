@@ -534,7 +534,9 @@ class WhatIfEngine:
                     )["tol_ok"]
                 )
             )
-            tol = np.asarray(tol_fn(self.sset.dc))  # [S, Ct, N]
+            # class_masks ok-planes are bf16 since round 3; the Pallas
+            # kernel consumes f32.
+            tol = np.asarray(tol_fn(self.sset.dc)).astype(np.float32)  # [S, Ct, N]
             tol = P4.pad_nodes(tol, Np)
         else:
             tol = np.zeros((S, v4.Ct, Np), np.float32)

@@ -147,6 +147,7 @@ class TestFacade:
         assert "cpu" in Simulator.strategies() and "jax" in Simulator.strategies()
 
 
+@pytest.mark.slow
 def test_scale_smoke_5k_nodes():
     """SURVEY.md §4.5: a 5k-node replay completes under a wall budget even
     on the CPU XLA backend (pods kept small to bound CI time)."""

@@ -145,6 +145,7 @@ def test_parity_bootstrap_on_domainless_node():
     assert cpu_res.placed == 2
 
 
+@pytest.mark.slow
 def test_fused_eval_matches_reference_chain():
     """eval_pod_fused must be BIT-identical to the straight-line reference
     chain eval_pod — walks real waves, comparing mask and (feasible-masked)

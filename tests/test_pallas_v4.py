@@ -32,6 +32,7 @@ def test_v4_selected_and_matches_anchor(v4_on):
     np.testing.assert_array_equal(res.assignments[0], anchor.assignments)
 
 
+@pytest.mark.slow
 def test_v4_matches_v3_under_perturbations(v4_on, monkeypatch):
     # Heavy contention + gangs + node-down/capacity/taint perturbations.
     ec, ep, _ = make_borg_encoded(

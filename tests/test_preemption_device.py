@@ -60,6 +60,7 @@ def test_preemption_places_high_priority():
     assert (used <= 4.0 + 1e-5).all()
 
 
+@pytest.mark.slow
 def test_whatif_preemption_matches_single_replay():
     from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
 

@@ -2,6 +2,7 @@
 preemption, completions, failure injection (SURVEY.md §4.3, §4.6, §5)."""
 
 import numpy as np
+import pytest
 
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.framework.registry import get_strategy
@@ -192,6 +193,7 @@ def test_gang_members_do_not_preempt():
     assert res.placed == 1
 
 
+@pytest.mark.slow
 def test_preemption_at_scale_within_budget():
     # 5k nodes fully packed with low-priority pods; 400 high-priority pods
     # must each preempt. The incremental PostFilter (static filters hoisted,

@@ -35,12 +35,15 @@ def _assert_same(ec, ep, **kw):
     return v3
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize(
+    "seed", [0, 1, pytest.param(2, marks=pytest.mark.slow)]
+)
 def test_v3_matches_v2_and_cpu(seed):
     ec, ep = _case(seed)
     _assert_same(ec, ep)
 
 
+@pytest.mark.slow
 def test_v3_host_planes_forced():
     """dmax_coarse=4 pushes zone/rack groups onto the host-plane path —
     results must not change."""
@@ -48,6 +51,7 @@ def test_v3_host_planes_forced():
     _assert_same(ec, ep, dmax_coarse=4)
 
 
+@pytest.mark.slow
 def test_v3_class_fallback(monkeypatch):
     """Force the per-wave vmap fallback (as if every pod were distinct)."""
     from kubernetes_simulator_tpu.ops import tpu3 as V3
@@ -89,6 +93,7 @@ def test_v3_host_singleton_partial_labels():
     _assert_same(ec, ep, dmax_coarse=0)
 
 
+@pytest.mark.slow
 def test_v3_mesh_with_host_planes():
     """Mesh-sharded what-if on a trace whose anti terms ride a hostname
     topology (>128 domains → real host planes). Regression: the sharding
@@ -112,6 +117,7 @@ def test_v3_mesh_with_host_planes():
     np.testing.assert_array_equal(res.assignments[0], single.assignments)
 
 
+@pytest.mark.slow
 def test_v3_checkpoint_resume_identical(tmp_path):
     ec, ep = _case(5, n_pods=400)
     cfg = FrameworkConfig()
