@@ -316,10 +316,20 @@ def spread_filter_mask(
 
 
 def spread_weight(ec: EncodedCluster, g: int) -> np.float32:
-    """Upstream topologyNormalizingWeight for match-group ``g``'s topology:
-    ``log(size + 2)`` with size = number of distinct domains of the key
-    ([K8S] podtopologyspread/scoring.go). f64 log cast once to f32 so every
-    backend sees the identical value."""
+    """topologyNormalizingWeight for match-group ``g``'s topology:
+    ``log(size + 2)`` ([K8S] podtopologyspread/scoring.go).
+
+    DOCUMENTED DEVIATION from upstream: ``size`` here is the STATIC
+    cluster-wide distinct-domain count of the key, computed once at encode.
+    Upstream counts distinct domains among the pod's *filtered* nodes per
+    scheduling cycle, and special-cases kubernetes.io/hostname as
+    ``len(filteredNodes) − 2``. Scores deviate from upstream whenever
+    filtering excludes whole domains (the weight is then slightly larger
+    than upstream's). The static form keeps the weight a trace-time
+    constant — a per-pod dynamic count would force a per-pod [N]-wide
+    domain census into the device hot loop. Cross-backend parity is exact:
+    all three backends consume this same value (f64 log cast once to
+    f32)."""
     ti = ec.group_topo[g]
     nd = int(ec.num_domains[ti]) if ti >= 0 else 0
     return np.float32(np.log(np.float64(nd) + 2.0))

@@ -150,6 +150,83 @@ class PodSlot(NamedTuple):
     group: jax.Array  # i32 scalar (wave-local gang handling)
 
 
+class SlotSource(NamedTuple):
+    """All per-pod slot arrays resident ON DEVICE, uploaded once per
+    engine. Per-chunk slot batches are then gathered inside jit from these
+    (gather_slots_device) — only the [C, W] index array crosses the host
+    boundary per chunk. (Round-3 profile: the host-side numpy gather +
+    tunnel H2D of ~18 arrays cost ~127 ms per 2048-wave chunk — more than
+    10% of the whole north-star replay.)"""
+
+    requests: jax.Array
+    tol_key: jax.Array
+    tol_kv: jax.Array
+    tol_effect: jax.Array
+    na_req: jax.Array
+    na_has_req: jax.Array
+    na_pref: jax.Array
+    na_pref_w: jax.Array
+    aff_req: jax.Array
+    anti_req: jax.Array
+    pref_aff: jax.Array
+    pref_aff_w: jax.Array
+    spread_g: jax.Array
+    spread_skew: jax.Array
+    spread_dns: jax.Array
+    pmg: jax.Array
+    group_id: jax.Array
+
+    @classmethod
+    def build(cls, ep: EncodedPods) -> "SlotSource":
+        return cls(
+            requests=jnp.asarray(ep.requests),
+            tol_key=jnp.asarray(ep.tol_key),
+            tol_kv=jnp.asarray(ep.tol_kv),
+            tol_effect=jnp.asarray(ep.tol_effect),
+            na_req=jnp.asarray(ep.na_req),
+            na_has_req=jnp.asarray(ep.na_has_req),
+            na_pref=jnp.asarray(ep.na_pref),
+            na_pref_w=jnp.asarray(ep.na_pref_w),
+            aff_req=jnp.asarray(ep.aff_req),
+            anti_req=jnp.asarray(ep.anti_req),
+            pref_aff=jnp.asarray(ep.pref_aff),
+            pref_aff_w=jnp.asarray(ep.pref_aff_w),
+            spread_g=jnp.asarray(ep.spread_g),
+            spread_skew=jnp.asarray(ep.spread_skew),
+            spread_dns=jnp.asarray(ep.spread_dns),
+            pmg=jnp.asarray(ep.pod_matches_group),
+            group_id=jnp.asarray(ep.group_id.astype(np.int32)),
+        )
+
+
+@jax.jit
+def gather_slots_device(src: SlotSource, idx: jax.Array) -> PodSlot:
+    """jnp twin of gather_slots: row-gather on device (value-identical)."""
+    safe = jnp.clip(idx, 0, None)
+    take = lambda a: a[safe]
+    return PodSlot(
+        pod_id=idx.astype(jnp.int32),
+        valid=idx >= 0,
+        req=take(src.requests),
+        tol_key=take(src.tol_key),
+        tol_kv=take(src.tol_kv),
+        tol_effect=take(src.tol_effect),
+        na_req=take(src.na_req),
+        na_has_req=take(src.na_has_req),
+        na_pref=take(src.na_pref),
+        na_pref_w=take(src.na_pref_w),
+        aff_req=take(src.aff_req),
+        anti_req=take(src.anti_req),
+        pref_aff=take(src.pref_aff),
+        pref_aff_w=take(src.pref_aff_w),
+        spread_g=take(src.spread_g),
+        spread_skew=take(src.spread_skew),
+        spread_dns=take(src.spread_dns),
+        pmg=take(src.pmg),
+        group=jnp.where(idx >= 0, src.group_id[safe], PAD).astype(jnp.int32),
+    )
+
+
 def gather_slots(ep: EncodedPods, idx: np.ndarray) -> PodSlot:
     """Host-side gather of pod rows at ``idx`` (any leading shape); PAD ids
     become invalid slots."""

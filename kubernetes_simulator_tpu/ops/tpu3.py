@@ -298,7 +298,8 @@ class V3Static:
             dom = ec.node_domain[topo0]
             D0 = int(ec.num_domains[topo0])
             N = ec.num_nodes
-            if 0 < D0 <= Dcap and N % D0 == 0:
+            # ≤ 31: per-domain feasibility packs into int32 bit positions.
+            if 0 < D0 <= min(Dcap, 31) and N % D0 == 0:
                 if (dom == np.arange(N) % D0).all():
                     seg_mode, seg_D = "stride", D0
                 elif (dom == np.arange(N) // (N // D0)).all():
@@ -523,6 +524,46 @@ class SlotExtra(NamedTuple):
     tol_class: jax.Array  # i32 scalar
     na_class: jax.Array  # i32 scalar
     tier: jax.Array  # i32 scalar (0 when preemption off)
+
+
+class ExtraSource(NamedTuple):
+    """Device-resident twins of the V3Static per-pod rows (see
+    ops.tpu.SlotSource — same once-per-engine upload pattern)."""
+
+    anti_midx: jax.Array  # [P, MA]
+    pref_midx: jax.Array  # [P, MP]
+    tol_class: jax.Array  # [P]
+    na_class: jax.Array  # [P]
+    tier: jax.Array  # [P]
+
+    @classmethod
+    def build(cls, st: V3Static, num_pods: int) -> "ExtraSource":
+        z = np.zeros(num_pods, np.int32)
+        return cls(
+            anti_midx=jnp.asarray(st.anti_midx.astype(np.int32)),
+            pref_midx=jnp.asarray(st.pref_midx.astype(np.int32)),
+            tol_class=jnp.asarray(
+                st.tol_class.astype(np.int32) if st.tol_class.size else z
+            ),
+            na_class=jnp.asarray(
+                st.na_class.astype(np.int32) if st.na_class.size else z
+            ),
+            tier=jnp.asarray(st.pod_tier.astype(np.int32) if st.Tt else z),
+        )
+
+
+@jax.jit
+def gather_extra_device(src: ExtraSource, idx: jax.Array) -> SlotExtra:
+    """jnp twin of gather_extra (value-identical)."""
+    safe = jnp.clip(idx, 0, None)
+    ok = (idx >= 0)[..., None]
+    return SlotExtra(
+        anti_midx=jnp.where(ok, src.anti_midx[safe], PAD).astype(jnp.int32),
+        pref_midx=jnp.where(ok, src.pref_midx[safe], PAD).astype(jnp.int32),
+        tol_class=src.tol_class[safe],
+        na_class=src.na_class[safe],
+        tier=src.tier[safe],
+    )
 
 
 def gather_extra(st: V3Static, idx: np.ndarray) -> SlotExtra:
@@ -845,9 +886,11 @@ def make_wave_step3(
                 dom_oh = (
                     pre.dmap[..., None] == jnp.arange(Dcap, dtype=jnp.float32)
                 ).astype(jnp.float32)  # [W, KT, N, Dcap]
-            if spread_dom_hilo:
+            if spread_dom_hilo and not st.seg_mode:
                 # [W, N, Dcap+1]: spread-row domain one-hot + no-domain col
                 # (built from dmap directly — dom_oh may be skipped).
+                # seg_mode needs neither: domfeas rides the bit-OR reduce
+                # and the score expansion is a tile/repeat.
                 # bf16: 0/1 one-hots and the integer score values they meet
                 # (≤ MAX_NODE_SCORE) are bf16-exact; accumulation stays f32
                 # via preferred_element_type. Halves the dominant operand
@@ -1166,21 +1209,32 @@ def make_wave_step3(
                     jnp.arange(Dcap, dtype=jnp.float32) < nd_row[k, o2]
                 )  # existing domains
                 if st.seg_mode:
-                    # Structured layout: per-domain any() over a reshape of
-                    # the feasibility plane (≈12% of device time as a
-                    # one-hot matmul on the north-star profile). Exact: for
-                    # a PAD spread row the downstream out_d is masked to 0
-                    # by sp_scored either way, and any(domfeas) still
-                    # equals any(feasible) — every node carries a domain
-                    # under the detected pattern.
+                    # Structured layout: per-domain feasibility via ONE
+                    # full-width bitwise-OR reduce of (1 << dom(n)) — a
+                    # lane-efficient [N]→scalar reduce (the reshape-any
+                    # form reduced over the 8-wide minor axis at ~6% lane
+                    # utilization; the one-hot matmul before it was ~12%
+                    # of device time). Exact: for a PAD spread row the
+                    # downstream out_d is masked to 0 by sp_scored either
+                    # way, and any(domfeas) still equals any(feasible) —
+                    # every node carries a domain under the pattern.
                     if st.seg_mode == "stride":
-                        core = jnp.any(
-                            feasible.reshape(-1, st.seg_D), axis=0
-                        )  # [D]
+                        dom_i = iota_n % st.seg_D
                     else:
-                        core = jnp.any(
-                            feasible.reshape(st.seg_D, -1), axis=1
-                        )
+                        dom_i = iota_n // (N // st.seg_D)
+                    word = jax.lax.reduce(
+                        jnp.where(
+                            feasible,
+                            jnp.left_shift(np.int32(1), dom_i),
+                            np.int32(0),
+                        ),
+                        np.int32(0),
+                        jax.lax.bitwise_or,
+                        (0,),
+                    )
+                    core = (
+                        jnp.right_shift(word, jnp.arange(st.seg_D)) & 1
+                    ) > 0  # [D]
                     domfeas = jnp.concatenate(
                         [core, jnp.zeros(Dcap + 1 - st.seg_D, bool)]
                     )
@@ -1208,11 +1262,22 @@ def make_wave_step3(
                     np.float32(T2.MAX_NODE_SCORE),
                 )
                 out_d = jnp.where(dval & has & scored0, out_d, 0.0)
-                # out_d holds integer scores in [0, 100] — bf16-exact.
-                out = jnp.einsum(
-                    "nd,d->n", domoh2[k][:, :Dcap], out_d.astype(jnp.bfloat16),
-                    precision=_HI, preferred_element_type=jnp.float32,
-                )
+                if st.seg_mode == "stride":
+                    # dom(n) = n % D: the expansion out_d[dom(n)] is a pure
+                    # tile — no [N, D] one-hot read at all (the expansion
+                    # dot was the single largest op after round-3's other
+                    # cuts). PAD spread rows have out_d ≡ 0 → tile of 0.
+                    out = jnp.tile(out_d[: st.seg_D], N // st.seg_D)
+                elif st.seg_mode == "block":
+                    out = jnp.repeat(out_d[: st.seg_D], N // st.seg_D)
+                else:
+                    # out_d holds integer scores in [0, 100] — bf16-exact.
+                    out = jnp.einsum(
+                        "nd,d->n",
+                        domoh2[k][:, :Dcap],
+                        out_d.astype(jnp.bfloat16),
+                        precision=_HI, preferred_element_type=jnp.float32,
+                    )
                 if any_f is None:
                     any_f = jnp.any(domfeas)
                 total = total + np.float32(wt) * out

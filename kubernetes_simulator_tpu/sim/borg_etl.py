@@ -161,15 +161,19 @@ class Borg2019Etl:
         prio = np.array([tasks[k][3] for k in keys], np.int64)
         alloc = np.array([tasks[k][4] for k in keys], np.int64)
         appid = np.array([tasks[k][5] for k in keys], np.int64)
-        dur = np.array(
-            [
-                max(ends[k] - min(last_submit.get(k, tasks[k][0]), ends[k]), 0.0)
-                if k in ends
-                else np.inf
-                for k in keys
-            ],
-            np.float32,
-        )
+        def _dur(k):
+            if k not in ends:
+                return np.inf
+            start = last_submit.get(k, tasks[k][0])
+            if start > ends[k]:
+                # Re-SUBMIT after the last FINISH/KILL: the restarted
+                # incarnation is still running at trace end — hold its
+                # resources for the remainder (advisor round-2: clamping
+                # to the stale end gave duration 0, freeing instantly).
+                return np.inf
+            return max(ends[k] - start, 0.0)
+
+        dur = np.array([_dur(k) for k in keys], np.float32)
         group = np.where(alloc > 0, alloc, -1)
 
         # Alloc-set members co-arrive at the set's first submit and must be

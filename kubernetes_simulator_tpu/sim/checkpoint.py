@@ -26,9 +26,19 @@ class ReplayCheckpoint:
     anti_active: np.ndarray  # [G, D]
     pref_wsum: np.ndarray  # [G, D]
     outs: List[np.ndarray]  # per-chunk collected outputs so far
+    # [P] bool — pods whose completion releases are ALREADY subtracted from
+    # the saved state (completions-on replays). Forking consumers must seed
+    # their released mask from this or they re-subtract every pre-fork
+    # release at the first post-fork boundary (advisor round-2 finding).
+    # None on checkpoints written before the field existed — treated as
+    # "reconstruct from outs" by the loaders that need it.
+    released: Optional[np.ndarray] = None
 
     def save(self, path: str) -> None:
         tmp = path + ".tmp"
+        extra = {}
+        if self.released is not None:
+            extra["released"] = self.released.astype(bool)
         np.savez_compressed(
             tmp,
             chunk_cursor=np.int64(self.chunk_cursor),
@@ -38,6 +48,7 @@ class ReplayCheckpoint:
             pref_wsum=self.pref_wsum,
             num_outs=np.int64(len(self.outs)),
             **{f"out_{i}": o for i, o in enumerate(self.outs)},
+            **extra,
         )
         os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
 
@@ -52,6 +63,7 @@ class ReplayCheckpoint:
                 anti_active=z["anti_active"],
                 pref_wsum=z["pref_wsum"],
                 outs=[z[f"out_{i}"] for i in range(n)],
+                released=z["released"] if "released" in z.files else None,
             )
 
 

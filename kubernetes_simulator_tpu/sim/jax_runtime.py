@@ -298,6 +298,28 @@ def make_chunk_fn3(static3, shared3, rep_slots, wave_width: int, spec: StepSpec)
     return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
+def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
+    """make_chunk_fn3 with the slot gathers INSIDE the jitted program:
+    (dc, state, SlotSource, ExtraSource, idx [C, W]) → (state, choices).
+    One dispatch per chunk and only the index array as per-chunk input —
+    the tunneled-device round-trip latency of separate gather dispatches
+    was a measurable slice of the north-star wall."""
+    from ..ops import tpu3 as V3
+
+    def chunk_fn(dc: T.DevCluster, state, src, xsrc, idx):
+        slots = T.gather_slots_device(src, idx)
+        extra = V3.gather_extra_device(xsrc, idx)
+        d = T.Derived.build(dc)
+        cmasks = V3.class_masks(dc, d, static3, spec, rep_slots)
+        step = V3.make_wave_step3(
+            dc, d, shared3, static3, wave_width, spec, cmasks
+        )
+        state, choices = jax.lax.scan(step, state, (slots, extra))
+        return state, choices
+
+    return jax.jit(chunk_fn, donate_argnums=(1,))
+
+
 def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray,
                     ev_node: np.ndarray, ev_tier: np.ndarray,
                     pod_tier: np.ndarray, nongang: np.ndarray) -> None:
@@ -314,6 +336,46 @@ def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray
         ids = idx[w]
         ok = ids >= 0
         assignments[ids[ok]] = finals[w][ok]
+
+
+def rebuild_fork_state(pods: EncodedPods, idx: np.ndarray, C: int, outs,
+                       wave_times: np.ndarray, upto_chunk: int,
+                       reconstruct_released: bool = True):
+    """Replay saved per-chunk choices for chunks 0..upto_chunk-1 and apply
+    the completions an uninterrupted completions-on run would have released
+    at each boundary. Returns (host_assign [P], released [P]).
+
+    A release is due at boundary b when the pod was placed in a chunk < b
+    (pre-bound pods count as chunk −1) and its arrival+duration is at or
+    before the boundary's start time. Shared by JaxReplayEngine.replay
+    resume and the what-if fork path (which previously started released
+    all-False and re-subtracted every pre-fork release — advisor round-2)."""
+    host_assign = np.where(pods.bound_node >= 0, pods.bound_node, PAD).astype(
+        np.int32
+    )
+    chunk_of = np.where(pods.bound_node >= 0, -1, 1 << 30).astype(np.int64)
+    rel_time = pods.arrival + np.where(
+        np.isfinite(pods.duration), pods.duration, np.inf
+    )
+    for cj in range(upto_chunk):
+        rows = idx[cj * C : (cj + 1) * C]
+        ch = np.asarray(outs[cj]).reshape(rows.shape)
+        v = rows >= 0
+        host_assign[rows[v]] = ch[v]
+        chunk_of[rows[v]] = cj
+    released = np.zeros(pods.num_pods, bool)
+    if reconstruct_released:
+        # O(upto_chunk × P) — callers holding a persisted mask skip this.
+        for b in range(upto_chunk):
+            tb = wave_times[b * C]
+            if np.isfinite(tb):
+                released |= (
+                    (host_assign != PAD)
+                    & (chunk_of < b)
+                    & np.isfinite(rel_time)
+                    & (rel_time <= tb)
+                )
+    return host_assign, released
 
 
 def rep_slots_for(static3, pods: EncodedPods):
@@ -375,13 +437,23 @@ class JaxReplayEngine:
                 ec, pods, self.spec, dmax_coarse, preemption=preemption
             )
             self.shared3 = V3.Shared3.build(ec, self.static3)
-            self.chunk_fn = make_chunk_fn3(
+            self.chunk_fn = make_chunk_fn3_src(
                 self.static3, self.shared3, rep_slots_for(self.static3, pods),
                 wave_width, self.spec,
             )
         else:
             self.chunk_fn = make_chunk_fn(wave_width, self.spec)
         self.waves = pack_waves(pods, wave_width)
+        # Slot data lives on device once; chunks gather rows inside jit
+        # (ops.tpu.SlotSource) — only wave indices cross the host boundary.
+        # v3-only: the v2 fallback engine still host-gathers, so the device
+        # copies would be dead HBM weight there.
+        self._slot_src = T.SlotSource.build(pods) if engine == "v3" else None
+        self._extra_src = (
+            V3.ExtraSource.build(self.static3, pods.num_pods)
+            if engine == "v3"
+            else None
+        )
 
     def _init_dev_state(self):
         from ..ops import tpu3 as V3
@@ -404,7 +476,8 @@ class JaxReplayEngine:
             match_total=jnp.asarray(host.match_count.sum(axis=1).astype(np.float32)),
         )
 
-    def _save_checkpoint(self, state, cursor: int, all_choices, path: str) -> None:
+    def _save_checkpoint(self, state, cursor: int, all_choices, path: str,
+                         released=None) -> None:
         from .checkpoint import ReplayCheckpoint, state_to_checkpoint
 
         if self.engine == "v3":
@@ -412,9 +485,14 @@ class JaxReplayEngine:
             ReplayCheckpoint(
                 used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
                 chunk_cursor=cursor, outs=[np.asarray(o) for o in all_choices],
+                released=released,
             ).save(path)
         else:
-            state_to_checkpoint(state, self._gdom, self._Dhost, cursor, all_choices).save(path)
+            ck = state_to_checkpoint(
+                state, self._gdom, self._Dhost, cursor, all_choices
+            )
+            ck.released = released
+            ck.save(path)
 
     def _preemption_walk(self, idx: np.ndarray, finals: np.ndarray,
                          ev_node: np.ndarray, ev_tier: np.ndarray):
@@ -510,7 +588,7 @@ class JaxReplayEngine:
                 preemption=self.preemption, allow_bf16_host=False,
             )
             self.shared3 = V3.Shared3.build(self.ec, self.static3)
-            self.chunk_fn = make_chunk_fn3(
+            self.chunk_fn = make_chunk_fn3_src(
                 self.static3, self.shared3,
                 rep_slots_for(self.static3, self.pods),
                 self.wave_width, self.spec,
@@ -559,31 +637,27 @@ class JaxReplayEngine:
             ).astype(np.int32)
             released = np.zeros(self.pods.num_pods, bool)
             if start_chunk:
-                # Resume: rebuild placements from the saved outs, then mark
-                # every release an uninterrupted run would have applied at
-                # boundaries 0..start_chunk-1 (due at boundary b = placed in
-                # a chunk < b with release time ≤ the boundary's start).
-                # Pre-bound pods never appear in waves: chunk −1 so every
-                # boundary can release them (else resume re-subtracts).
-                chunk_of = np.where(
-                    self.pods.bound_node >= 0, -1, 1 << 30
-                ).astype(np.int64)
-                for cj in range(start_chunk):
-                    rows = idx[cj * C : (cj + 1) * C]
-                    ch = np.asarray(all_choices[cj]).reshape(rows.shape)
-                    v = rows >= 0
-                    host_assign[rows[v]] = ch[v]
-                    chunk_of[rows[v]] = cj
-                for b in range(start_chunk):
-                    tb = wave_times[b * C]
-                    if np.isfinite(tb):
-                        released |= (
-                            (host_assign != PAD)
-                            & (chunk_of < b)
-                            & np.isfinite(rel_time)
-                            & (rel_time <= tb)
-                        )
+                # Resume: the saved state already carries pre-resume
+                # releases — seed from the persisted mask (or reconstruct
+                # from the saved outs for pre-field checkpoints).
+                have_mask = getattr(ck, "released", None) is not None
+                host_assign, released = rebuild_fork_state(
+                    self.pods, idx, C, all_choices, wave_times, start_chunk,
+                    reconstruct_released=not have_mask,
+                )
+                if have_mask:
+                    released = ck.released.astype(bool)
         saved_alloc = np.asarray(self.dc.allocatable).copy()
+        # Pre-stage the per-chunk wave indices on device (a few MB total):
+        # the timed loop then issues ONE call per chunk with no H2D.
+        idx_chunks = (
+            [
+                jnp.asarray(idx[c0 : c0 + C])
+                for c0 in range(0, idx.shape[0], C)
+            ]
+            if self.engine == "v3"
+            else None
+        )
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_chunk:
@@ -608,12 +682,15 @@ class JaxReplayEngine:
                             state, due_p, host_assign[due_p]
                         )
                         released[due_p] = True
-            slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
             if self.engine == "v3":
-                extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
-                state, choices = self.chunk_fn(self.dc, state, slots, extra)
+                state, choices = self.chunk_fn(
+                    self.dc, state, self._slot_src, self._extra_src,
+                    idx_chunks[ci],
+                )
             else:
-                state, choices = self.chunk_fn(self.dc, state, slots)
+                state, choices = self.chunk_fn(
+                    self.dc, state, T.gather_slots(self.pods, idx[c0 : c0 + C])
+                )
             all_choices.append(choices)
             if completions_on:
                 rows = idx[c0 : c0 + C]
@@ -621,7 +698,14 @@ class JaxReplayEngine:
                 v = rows >= 0
                 host_assign[rows[v]] = ch[v]
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
-                self._save_checkpoint(state, ci + 1, all_choices, checkpoint_path)
+                self._save_checkpoint(
+                    state, ci + 1, all_choices, checkpoint_path,
+                    released=(
+                        released
+                        if completions_on
+                        else np.zeros(self.pods.num_pods, bool)
+                    ),
+                )
         jax.block_until_ready(all_choices[-1] if all_choices else state)
         wall = time.perf_counter() - t0
         if node_events:

@@ -328,6 +328,16 @@ class WhatIfEngine:
         # wants counts.
         self._need_choices = collect_assignments or self.completions_on
         self._chunk_fn = None if self.engine == "v4" else self._build_chunk_fn()
+        # Device-resident slot sources (one upload per engine): the chunk
+        # loop then gathers rows on device — see ops.tpu.SlotSource.
+        self._slot_srcs = None
+        if self.mesh is None and self.engine == "v3":
+            from ..ops import tpu3 as V3
+
+            self._slot_srcs = (
+                T.SlotSource.build(pods),
+                V3.ExtraSource.build(self.static3, pods.num_pods),
+            )
 
     def _build_chunk_fn(self):
         collect = self._need_choices
@@ -366,6 +376,22 @@ class WhatIfEngine:
 
                 state, outs = jax.lax.scan(step, state, (slots, extra))
                 return state, outs
+
+            if self.mesh is None:
+                # Device-side slot gathers INSIDE the jitted program: one
+                # dispatch per chunk, only indices as per-chunk input
+                # (scenario-shared → gathered once, not per scenario).
+                def per_scenario_src(dc, state, src, xsrc, idx):
+                    slots = T.gather_slots_device(src, idx)
+                    from ..ops import tpu3 as V3m
+
+                    extra = V3m.gather_extra_device(xsrc, idx)
+                    return per_scenario(dc, state, slots, extra)
+
+                vmapped_src = jax.vmap(
+                    per_scenario_src, in_axes=(0, 0, None, None, None)
+                )
+                return jax.jit(vmapped_src, donate_argnums=(1,))
 
             vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None, None))
         else:
@@ -465,9 +491,14 @@ class WhatIfEngine:
                 host.used, host.match_count, host.anti_active, host.pref_wsum,
                 self.ec, self.static3, ep=self.pods,
             )
-            return jax.tree.map(
-                lambda a: jnp.repeat(jnp.asarray(a)[None], self.S, axis=0), one
-            )
+            # ONE jitted broadcast dispatch: per-leaf jnp.repeat round-trips
+            # cost 12.5s through the tunneled device at the north-star shape.
+            S = self.S
+            return jax.jit(
+                lambda s: jax.tree.map(
+                    lambda a: jnp.broadcast_to(a[None], (S,) + a.shape), s
+                )
+            )(one)
         G, D = host.match_count.shape[0], self.D
         # Domain dim may have grown (label perturbations) → pad.
         mc = np.zeros((G, D), np.float32)
@@ -729,13 +760,14 @@ class WhatIfEngine:
             mc_dom=jnp.asarray(dom_part(mc_d)),
             anti_dom=jnp.asarray(dom_part(aa_d)),
             pref_dom=jnp.asarray(dom_part(pw_d)),
+            # .dtype on the jax array directly — np.asarray here forced a
+            # full device→host copy of the [S, H, N] plane per release
+            # chunk just to read its dtype (advisor round-2).
             mc_host=jnp.asarray(
-                host_part(mc_d, st3.mc_h_ids, np.asarray(states.mc_host).dtype)
+                host_part(mc_d, st3.mc_h_ids, states.mc_host.dtype)
             ),
             anti_host=jnp.asarray(
-                host_part(
-                    aa_d, st3.anti_h_ids, np.asarray(states.anti_host).dtype
-                )
+                host_part(aa_d, st3.anti_h_ids, states.anti_host.dtype)
             ),
             pref_host=jnp.asarray(
                 host_part(pw_d, st3.pref_h_ids, np.float32)
@@ -789,6 +821,56 @@ class WhatIfEngine:
                 pv = pidx >= 0
                 host_assign[:, pidx[pv]] = pch[pv][None, :]
             released = np.zeros((self.S, self.pods.num_pods), bool)
+            if self.fork_checkpoint and self._fork_waves_done:
+                # The forked state already carries the source replay's
+                # pre-fork releases (completions default ON there): seed
+                # from the persisted mask, or reconstruct what the source
+                # applied at its own chunk boundaries — else the first
+                # post-fork boundary re-subtracts every pre-fork release,
+                # driving count planes negative (advisor round-2 medium).
+                ck = self._fork_ck
+                if ck.released is not None:
+                    rel0 = ck.released.astype(bool)
+                else:
+                    from .jax_runtime import rebuild_fork_state
+
+                    C_src = ck.outs[0].shape[0] if ck.outs else 0
+                    full_first = self.waves.idx[:, 0]
+                    full_t = np.where(
+                        full_first >= 0,
+                        self.pods.arrival[np.clip(full_first, 0, None)],
+                        np.inf,
+                    )
+                    if C_src:
+                        # The source padded ITS wave list to a multiple of
+                        # C_src — mirror that so chunk rows line up.
+                        idx_src = self.waves.idx
+                        need = ck.chunk_cursor * C_src
+                        if idx_src.shape[0] < need:
+                            idx_src = np.concatenate([
+                                idx_src,
+                                np.full(
+                                    (need - idx_src.shape[0], idx_src.shape[1]),
+                                    PAD, np.int32,
+                                ),
+                            ])
+                            full_t = np.concatenate([
+                                full_t,
+                                np.full(need - full_t.shape[0], np.inf),
+                            ])
+                        _, rel0 = rebuild_fork_state(
+                            self.pods, idx_src, C_src, ck.outs,
+                            full_t, ck.chunk_cursor,
+                        )
+                    else:
+                        rel0 = np.zeros(self.pods.num_pods, bool)
+                released |= rel0[None, :]
+        srcs = self._slot_srcs
+        idx_chunks = (
+            [jnp.asarray(idx[c0 : c0 + C]) for c0 in range(0, idx.shape[0], C)]
+            if srcs is not None
+            else None
+        )
         outs = []
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
@@ -798,18 +880,25 @@ class WhatIfEngine:
                     states = self._apply_releases(
                         states, host_assign, released, t_chunk
                     )
-            slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
-            if self.mesh is not None:
-                slots = replicate_tree(self.mesh, slots)
-            if self.engine == "v3":
-                from ..ops import tpu3 as V3
-
-                extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
-                if self.mesh is not None:
-                    extra = replicate_tree(self.mesh, extra)
-                states, out = self._chunk_fn(dc, states, slots, extra)
+            if self.mesh is None and self.engine == "v3" and srcs is not None:
+                # Fused device-side gather + wave scan: one dispatch per
+                # chunk, indices pre-staged (ops.tpu.SlotSource).
+                states, out = self._chunk_fn(
+                    dc, states, srcs[0], srcs[1], idx_chunks[ci]
+                )
             else:
-                states, out = self._chunk_fn(dc, states, slots)
+                slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
+                if self.mesh is not None:
+                    slots = replicate_tree(self.mesh, slots)
+                if self.engine == "v3":
+                    from ..ops import tpu3 as V3
+
+                    extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
+                    if self.mesh is not None:
+                        extra = replicate_tree(self.mesh, extra)
+                    states, out = self._chunk_fn(dc, states, slots, extra)
+                else:
+                    states, out = self._chunk_fn(dc, states, slots)
             outs.append(out)
             if comp_on:
                 rows = idx[c0 : c0 + C]
@@ -866,20 +955,32 @@ class WhatIfEngine:
                     .astype(np.int32)
                 )
             else:
-                placed = np.concatenate(
-                    [np.asarray(o) for o in outs], axis=1
-                ).sum(axis=1).astype(np.int32)
+                # Device-side reduce, ONE small D2H: per-array np.asarray
+                # round-trips through the tunneled device add seconds.
+                placed = np.asarray(
+                    jax.jit(
+                        lambda o: jnp.concatenate(o, axis=1).sum(
+                            axis=1, dtype=jnp.int32
+                        )
+                    )(outs)
+                ).astype(np.int32)
 
-        used = np.asarray(states.used)  # [S, N, R] (v3 stores [S, R, N])
-        if self.engine == "v3":
-            used = np.transpose(used, (0, 2, 1))
         util = None
         ri = self.ec.vocab._r.get("cpu")
         if ri is not None:
-            alloc = np.asarray(self.sset.dc.allocatable)[:, :, ri]
-            with np.errstate(invalid="ignore", divide="ignore"):
-                u = np.where(alloc > 0, used[:, :, ri] / np.where(alloc > 0, alloc, 1), 0)
-            util = u.mean(axis=1)
+            v3_layout = self.engine == "v3"
+
+            def _util(used, alloc):
+                a = alloc[:, :, ri]  # [S, N]
+                u_row = used[:, ri, :] if v3_layout else used[:, :, ri]
+                u = jnp.where(a > 0, u_row / jnp.where(a > 0, a, 1.0), 0.0)
+                return u.mean(axis=1)
+
+            # [S] floats instead of the full [S, R, N] used plane D2H
+            # (11.7s through the tunnel at the north-star shape).
+            util = np.asarray(
+                jax.jit(_util)(states.used, self.sset.dc.allocatable)
+            )
         total = int(placed.sum())
         return WhatIfResult(
             placed=placed,

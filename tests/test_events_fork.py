@@ -79,3 +79,49 @@ def test_whatif_fork_from_padded_checkpoint(tmp_path):
     res = weng.run()
     # Checkpoint covered the whole trace → fork reproduces it exactly.
     assert (res.assignments[0] == full.assignments).all()
+
+
+def test_whatif_fork_with_completions_no_double_release(tmp_path):
+    """Fork + completions=True must seed the released mask from the source
+    checkpoint: the saved state already carries pre-fork releases, so
+    re-subtracting them at the first post-fork boundary (advisor round-2
+    medium) over-frees resources and over-places pods. Boundary-aligned
+    fork ⇒ scenario 0 must equal the uninterrupted completions-on replay."""
+    cluster = make_cluster(8, seed=7)
+    pods, _ = make_workload(200, seed=7, with_affinity=False, with_spread=True)
+    # Finite durations so completions actually fire (short vs the trace).
+    for i, p in enumerate(pods):
+        p.duration = 0.5 + (i % 5) * 0.2
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    C = 4
+
+    full = JaxReplayEngine(ec, ep, cfg, chunk_waves=C).replay()
+
+    ck = str(tmp_path / "fork.npz")
+    JaxReplayEngine(ec, ep, cfg, chunk_waves=C).replay(
+        checkpoint_path=ck, checkpoint_every=3
+    )
+    from kubernetes_simulator_tpu.sim.checkpoint import ReplayCheckpoint
+
+    saved = ReplayCheckpoint.load(ck)
+    assert saved.released is not None and saved.released.any(), (
+        "precondition: the source checkpoint must carry applied releases"
+    )
+
+    wi = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, chunk_waves=C,
+        fork_checkpoint=ck, collect_assignments=True, completions=True,
+    )
+    res = wi.run()
+    np.testing.assert_array_equal(res.assignments[0], full.assignments)
+
+    # Pre-field checkpoints (released=None) reconstruct from the outs.
+    saved.released = None
+    saved.save(ck)
+    wi2 = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, chunk_waves=C,
+        fork_checkpoint=ck, collect_assignments=True, completions=True,
+    )
+    res2 = wi2.run()
+    np.testing.assert_array_equal(res2.assignments[0], full.assignments)

@@ -152,10 +152,10 @@ def test_segmented_domfeas_matches_einsum_path():
     eng2 = JaxReplayEngine(ec, ep, cfg, chunk_waves=8)
     eng2.static3 = dataclasses.replace(eng2.static3, seg_mode="", seg_D=0)
     from kubernetes_simulator_tpu.sim.jax_runtime import (
-        make_chunk_fn3, rep_slots_for,
+        make_chunk_fn3_src, rep_slots_for,
     )
 
-    eng2.chunk_fn = make_chunk_fn3(
+    eng2.chunk_fn = make_chunk_fn3_src(
         eng2.static3, eng2.shared3, rep_slots_for(eng2.static3, ep),
         eng2.wave_width, eng2.spec,
     )
