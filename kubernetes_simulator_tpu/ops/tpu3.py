@@ -298,8 +298,7 @@ class V3Static:
             dom = ec.node_domain[topo0]
             D0 = int(ec.num_domains[topo0])
             N = ec.num_nodes
-            # ≤ 31: per-domain feasibility packs into int32 bit positions.
-            if 0 < D0 <= min(Dcap, 31) and N % D0 == 0:
+            if 0 < D0 <= Dcap and N % D0 == 0:
                 if (dom == np.arange(N) % D0).all():
                     seg_mode, seg_D = "stride", D0
                 elif (dom == np.arange(N) // (N // D0)).all():
@@ -1218,23 +1217,31 @@ def make_wave_step3(
                     # downstream out_d is masked to 0 by sp_scored either
                     # way, and any(domfeas) still equals any(feasible) —
                     # every node carries a domain under the pattern.
-                    if st.seg_mode == "stride":
-                        dom_i = iota_n % st.seg_D
-                    else:
-                        dom_i = iota_n // (N // st.seg_D)
-                    word = jax.lax.reduce(
-                        jnp.where(
-                            feasible,
-                            jnp.left_shift(np.int32(1), dom_i),
+                    if st.seg_D <= 31:
+                        # Bit-pack: per-domain feasibility in int32 bits.
+                        if st.seg_mode == "stride":
+                            dom_i = iota_n % st.seg_D
+                        else:
+                            dom_i = iota_n // (N // st.seg_D)
+                        word = jax.lax.reduce(
+                            jnp.where(
+                                feasible,
+                                jnp.left_shift(np.int32(1), dom_i),
+                                np.int32(0),
+                            ),
                             np.int32(0),
-                        ),
-                        np.int32(0),
-                        jax.lax.bitwise_or,
-                        (0,),
-                    )
-                    core = (
-                        jnp.right_shift(word, jnp.arange(st.seg_D)) & 1
-                    ) > 0  # [D]
+                            jax.lax.bitwise_or,
+                            (0,),
+                        )
+                        core = (
+                            jnp.right_shift(word, jnp.arange(st.seg_D)) & 1
+                        ) > 0  # [D]
+                    elif st.seg_mode == "stride":
+                        # 32..Dcap domains: reshape-any (still cheaper
+                        # than the [N, Dcap+1] one-hot einsum).
+                        core = jnp.any(feasible.reshape(-1, st.seg_D), axis=0)
+                    else:
+                        core = jnp.any(feasible.reshape(st.seg_D, -1), axis=1)
                     domfeas = jnp.concatenate(
                         [core, jnp.zeros(Dcap + 1 - st.seg_D, bool)]
                     )

@@ -280,26 +280,8 @@ def make_chunk_fn(wave_width: int, spec: StepSpec):
     return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
-def make_chunk_fn3(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
-    """v3 twin of make_chunk_fn: xs = (slots, extra). ``rep_slots`` are the
-    toleration/NA class-representative PodSlots (host-gathered once); their
-    [C, N] masks are computed per chunk, not per wave."""
-    from ..ops import tpu3 as V3
-
-    def chunk_fn(dc: T.DevCluster, state, slots, extra):
-        d = T.Derived.build(dc)
-        cmasks = V3.class_masks(dc, d, static3, spec, rep_slots)
-        step = V3.make_wave_step3(
-            dc, d, shared3, static3, wave_width, spec, cmasks
-        )
-        state, choices = jax.lax.scan(step, state, (slots, extra))
-        return state, choices
-
-    return jax.jit(chunk_fn, donate_argnums=(1,))
-
-
 def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
-    """make_chunk_fn3 with the slot gathers INSIDE the jitted program:
+    """The v3 chunk program with the slot gathers INSIDE the jit:
     (dc, state, SlotSource, ExtraSource, idx [C, W]) → (state, choices).
     One dispatch per chunk and only the index array as per-chunk input —
     the tunneled-device round-trip latency of separate gather dispatches
@@ -592,6 +574,13 @@ class JaxReplayEngine:
                 self.static3, self.shared3,
                 rep_slots_for(self.static3, self.pods),
                 self.wave_width, self.spec,
+            )
+            # Keep the device-resident per-pod rows in lockstep with the
+            # rebuilt static tables (value-identical today, but a silent
+            # desync trap if V3Static ever derives them from a rebuild
+            # parameter).
+            self._extra_src = V3.ExtraSource.build(
+                self.static3, self.pods.num_pods
             )
 
         idx = self.waves.idx

@@ -36,20 +36,6 @@ from .jax_runtime import StepSpec, make_wave_step
 from .waves import pack_waves
 
 
-def _v4_disabled() -> bool:
-    # The v4 Pallas chunk kernel is OPT-IN (K8SIM_ENABLE_V4=1): it is
-    # parity-exact and keeps state VMEM-resident, but as of round 2 its
-    # per-pod Mosaic op latency loses to the v3 scan ~2.4× on the Borg
-    # shape (44.9s vs 18.8s per 100k×128 slice; see ops/pallas3.py
-    # docstring for the traffic analysis and COVERAGE.md for the round-2
-    # measurement log). Flip the default once it wins.
-    import os
-
-    return os.environ.get("K8SIM_ENABLE_V4", "").lower() not in (
-        "1", "true", "yes", "on",
-    )
-
-
 @dataclass
 class Perturbation:
     """One mutation of the base cluster. ``nodes`` is a boolean mask or
@@ -302,22 +288,11 @@ class WhatIfEngine:
             )
             self.shared3 = V3.Shared3.build(ec, self.static3)
             self.rep_slots = rep_slots_for(self.static3, pods)
-            from ..ops import pallas3 as P4
-
-            if (
-                P4.eligible(self.static3, self.spec, ec)
-                and not _v4_disabled()
-                and not preemption
-            ):
-                # Coarse-only shape → the VMEM-resident chunk kernel
-                # (HBM-bound v3 scan → VPU-bound v4; see ops.pallas3).
-                self.engine = "v4"
         self.waves = pack_waves(pods, self.wave_width)
         rel = pods.arrival + np.where(
             np.isfinite(pods.duration), pods.duration, np.inf
         )
         self._rel_time = rel
-        # v4 (opt-in Pallas kernel) keeps no-completions semantics for now.
         self.completions_on = bool(
             completions
             and self.engine == "v3"
@@ -327,7 +302,7 @@ class WhatIfEngine:
         # Completions need per-scenario choices even when the caller only
         # wants counts.
         self._need_choices = collect_assignments or self.completions_on
-        self._chunk_fn = None if self.engine == "v4" else self._build_chunk_fn()
+        self._chunk_fn = self._build_chunk_fn()
         # Device-resident slot sources (one upload per engine): the chunk
         # loop then gathers rows on device — see ops.tpu.SlotSource.
         self._slot_srcs = None
@@ -526,154 +501,6 @@ class WhatIfEngine:
             match_total=rep(mc.sum(axis=1).astype(np.float32)),
         )
 
-    def _run_v4(self) -> WhatIfResult:
-        """The Pallas chunk-kernel path (ops.pallas3): state stays in VMEM
-        for a whole chunk; same semantics as v3 (greedy anchor parity)."""
-        from ..ops import pallas3 as P4
-
-        st3 = self.static3
-        host_used, host_mc = self._load_fork_or_init()
-        idx = self.waves.idx
-        if self._fork_waves_done:
-            idx = idx[self._fork_waves_done :]
-            if idx.shape[0] == 0:
-                idx = np.full((1, self.waves.wave_width), PAD, np.int32)
-        # SMEM budget caps the v4 chunk (slot scalars live there).
-        C = min(self.chunk_waves, 512, max(idx.shape[0], 1))
-        pad_to = ((idx.shape[0] + C - 1) // C) * C
-        if pad_to != idx.shape[0]:
-            idx = np.concatenate(
-                [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)]
-            )
-        v4 = P4.build_v4_static(self.ec, st3, C, self.wave_width)
-        # Off-TPU (CPU CI / virtual meshes) the Mosaic kernel can't compile:
-        # run the interpreter — slow, but parity tests use tiny shapes.
-        interp = jax.default_backend() != "tpu"
-        chunk_fn = P4.make_v4_chunk_fn(v4, st3, self.spec, interpret=interp)
-        S, Np, G, Dcap = self.S, v4.Np, v4.G, v4.Dcap
-
-        # Per-scenario planes.
-        alloc = np.transpose(np.asarray(self.sset.dc.allocatable), (0, 2, 1))
-        alloc = P4.pad_nodes(np.ascontiguousarray(alloc), Np)  # [S, R, Np]
-        if self.spec.taints and st3.use_tol_classes:
-            from ..ops import tpu3 as V3
-
-            tol_fn = jax.jit(
-                jax.vmap(
-                    lambda dc: V3.class_masks(
-                        dc, None, st3, self.spec, self.rep_slots
-                    )["tol_ok"]
-                )
-            )
-            # class_masks ok-planes are bf16 since round 3; the Pallas
-            # kernel consumes f32.
-            tol = np.asarray(tol_fn(self.sset.dc)).astype(np.float32)  # [S, Ct, N]
-            tol = P4.pad_nodes(tol, Np)
-        else:
-            tol = np.zeros((S, v4.Ct, Np), np.float32)
-        used0 = P4.pad_nodes(
-            np.ascontiguousarray(host_used.T.astype(np.float32)), Np
-        )
-        mc0 = np.zeros((G, Dcap), np.float32)
-        w = min(host_mc.shape[1], Dcap)
-        mc0[: host_mc.shape[0], :w] = host_mc[:G, :w]
-        used = jnp.asarray(np.repeat(used0[None], S, axis=0))
-        mc = jnp.asarray(np.repeat(mc0[None], S, axis=0))
-        alloc_d = jnp.asarray(alloc)
-        tol_d = jnp.asarray(tol)
-
-        if self.mesh is None:
-            step = jax.jit(chunk_fn, donate_argnums=(0, 1))
-        else:
-            from jax.sharding import NamedSharding, PartitionSpec as PS
-
-            from jax.experimental.shard_map import shard_map
-
-            sh = PS(SCENARIO_AXIS)
-            rp = PS()
-            step = jax.jit(
-                shard_map(
-                    chunk_fn,
-                    mesh=self.mesh,
-                    in_specs=(
-                        sh, sh, sh, sh,
-                        P4.V4Slots(*([rp] * len(P4.V4Slots._fields))),
-                    ),
-                    out_specs=(sh, sh, sh),
-                    check_rep=False,
-                ),
-                donate_argnums=(0, 1),
-            )
-            shard = NamedSharding(self.mesh, PS(SCENARIO_AXIS))
-            used = jax.device_put(used, shard)
-            mc = jax.device_put(mc, shard)
-            alloc_d = jax.device_put(alloc_d, shard)
-            tol_d = jax.device_put(tol_d, shard)
-
-        # When assignments aren't wanted, reduce each chunk's choices to
-        # per-scenario placed counts ON DEVICE — the full per-pod tensor is
-        # S·P·4 bytes (0.5 GB at the north-star shape).
-        count_fn = jax.jit(
-            lambda ch: jnp.sum(ch >= 0, axis=(1, 2), dtype=jnp.int32)
-        )
-        outs = []
-        t0 = time.perf_counter()
-        for c0 in range(0, idx.shape[0], C):
-            slots = P4.build_slots(v4, st3, self.pods, idx[c0 : c0 + C])
-            if self.mesh is not None:
-                slots = replicate_tree(self.mesh, slots)
-            used, mc, choices = step(used, mc, alloc_d, tol_d, slots)
-            outs.append(
-                choices if self.collect_assignments else count_fn(choices)
-            )
-        jax.block_until_ready(used)
-        wall = time.perf_counter() - t0
-
-        to_schedule = int((idx >= 0).sum())
-        assignments = None
-        if self.collect_assignments:
-            choices = np.concatenate([np.asarray(o) for o in outs], axis=1)
-            flat_idx = idx.reshape(-1)
-            valid = flat_idx >= 0
-            flat_choice = choices.reshape(self.S, -1)
-            placed = (flat_choice[:, valid] >= 0).sum(axis=1).astype(np.int32)
-            assignments = np.full((self.S, self.pods.num_pods), PAD, np.int32)
-            prebound = self.pods.bound_node >= 0
-            assignments[:, prebound] = self.pods.bound_node[prebound]
-            assignments[:, flat_idx[valid]] = flat_choice[:, valid]
-            if self._fork_choices is not None:
-                pidx = self.waves.idx[: self._fork_waves_done].reshape(-1)
-                pch = self._fork_choices.reshape(-1)
-                pv = pidx >= 0
-                assignments[:, pidx[pv]] = pch[pv][None, :]
-        else:
-            placed = (
-                np.stack([np.asarray(o) for o in outs]).sum(axis=0)
-            ).astype(np.int32)
-
-        used_np = np.asarray(used)[:, :, : self.ec.num_nodes]  # [S, R, N]
-        util = None
-        ri = self.ec.vocab._r.get("cpu")
-        if ri is not None:
-            alloc_n = np.asarray(self.sset.dc.allocatable)[:, :, ri]
-            with np.errstate(invalid="ignore", divide="ignore"):
-                u = np.where(
-                    alloc_n > 0,
-                    used_np[:, ri, :] / np.where(alloc_n > 0, alloc_n, 1),
-                    0,
-                )
-            util = u.mean(axis=1)
-        total = int(placed.sum())
-        return WhatIfResult(
-            placed=placed,
-            unschedulable=(to_schedule - placed).astype(np.int32),
-            total_placed=total,
-            wall_clock_s=wall,
-            placements_per_sec=total / wall if wall > 0 else 0.0,
-            assignments=assignments,
-            utilization_cpu=util,
-        )
-
     def _apply_releases(self, states, host_assign, released, t_chunk):
         """Subtract completed pods' contributions per scenario (the
         JaxReplayEngine chunk-boundary mechanism, scenario-stacked; one
@@ -787,8 +614,6 @@ class WhatIfEngine:
         return jax.tree.map(jnp.subtract, states, delta)
 
     def run(self) -> WhatIfResult:
-        if self.engine == "v4":
-            return self._run_v4()
         states = self._init_states()  # sets fork bookkeeping first
         idx = self.waves.idx
         if self._fork_waves_done:

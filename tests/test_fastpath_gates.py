@@ -207,3 +207,24 @@ def test_multi_topology_disables_single_topo():
     res = JaxReplayEngine(ec, ep, cfg, chunk_waves=8).replay()
     anchor = greedy_replay(ec, ep, cfg)
     np.testing.assert_array_equal(res.assignments, anchor.assignments)
+
+
+def test_seg_mode_wide_domain_fallback_parity():
+    """32..Dcap domains: seg_mode stays on (reshape-any domfeas, tile
+    expansion) — the bit-pack int32 bound must not silently drop the
+    structured fast path for wide stride layouts. Generator zone names
+    sort lexicographically past 9 domains, so the 40-domain stride map is
+    installed directly (every consumer downstream of encode reads
+    node_domain/num_domains, not the raw labels)."""
+    ec, ep = _spread_case(nodes=80, pods=200, seed=9)
+    spec = StepSpec.from_config(ec, None, ep)
+    t0 = V3.V3Static.build(ec, ep, spec).topo0
+    ec.node_domain[t0] = (np.arange(ec.num_nodes) % 40).astype(np.int32)
+    ec.num_domains[t0] = 40
+    ec.max_domains = max(ec.max_domains, 40)
+    st = V3.V3Static.build(ec, ep, spec)
+    assert st.seg_mode == "stride" and st.seg_D == 40
+    cfg = FrameworkConfig()
+    res = JaxReplayEngine(ec, ep, cfg, chunk_waves=8).replay()
+    anchor = greedy_replay(ec, ep, cfg)
+    np.testing.assert_array_equal(res.assignments, anchor.assignments)
