@@ -66,6 +66,12 @@ from .tpu import (
 # Static (per-trace) structure
 # ---------------------------------------------------------------------------
 
+# Topologies with more domains than this live in node-space host planes
+# instead of [G, Dcap] domain planes. ONE shared constant: V3Static.build's
+# default and whatif.ScenarioSet's DynTables eligibility must agree on it.
+DMAX_COARSE = 128
+
+
 
 @dataclass(frozen=True)
 class V3Static:
@@ -181,15 +187,21 @@ class V3Static:
         ec: EncodedCluster,
         ep: EncodedPods,
         spec,
-        dmax_coarse: int = 128,
+        dmax_coarse: int = DMAX_COARSE,
         preemption: bool = False,
         allow_bf16_host: bool = True,
+        dcap_min: int = 0,
     ) -> "V3Static":
+        """``dcap_min``: widen the domain axis past the base cluster's
+        count — labels_dirty what-if batches append per-scenario domain
+        ids for new label values (whatif.ScenarioDyn)."""
         G = max(ec.num_groups, 1)
         gt = ec.group_topo[:G] if ec.group_topo.shape[0] >= G else np.full(G, PAD, np.int32)
         nd_g = np.where(gt >= 0, ec.num_domains[np.clip(gt, 0, None)], 0).astype(np.int32)
         is_host = nd_g > dmax_coarse
-        Dcap = int(max(nd_g[~is_host].max() if (~is_host).any() else 1, 1))
+        Dcap = int(
+            max(nd_g[~is_host].max() if (~is_host).any() else 1, 1, dcap_min)
+        )
         # Per topology: does every domain hold exactly one node?
         Tn = ec.node_domain.shape[0]
         topo_single = np.zeros(Tn, bool)
@@ -585,6 +597,21 @@ def gather_extra(st: V3Static, idx: np.ndarray) -> SlotExtra:
 # ---------------------------------------------------------------------------
 
 
+class DynTables(NamedTuple):
+    """Per-scenario domain tables for labels_dirty what-if batches (one
+    scenario's slice under vmap; built by whatif.ScenarioDyn). The base
+    (scenario-shared) expansion tables stay untouched — these carry only
+    the per-scenario corrections: K label-perturbed nodes with their
+    old/new domains per group, the domain-existence mask, and the
+    per-scenario spread weights. All tiny next to the [S, N] planes."""
+
+    ov_nodes: jax.Array  # [K] i32 (PAD-padded)
+    ov_gdom: jax.Array  # [G, K] f32 new domain (PAD where inapplicable)
+    ov_old: jax.Array  # [G, K] f32 base domain (PAD likewise)
+    dexist: jax.Array  # [G, Dcap] f32 1.0 where the domain has ≥1 node
+    sp_w_g: jax.Array  # [G] f32 log(size+2), size = #existing domains
+
+
 class WavePre3(NamedTuple):
     """Per-wave precompute. Scenario-independent unless noted."""
 
@@ -610,11 +637,15 @@ class WavePre3(NamedTuple):
     taint_raw: jax.Array  # [W, N] f32 (per-scenario)
     na_ok: jax.Array  # [W, N] bool (per-scenario)
     na_raw: jax.Array  # [W, N] f32 (per-scenario)
+    # labels_dirty (DynTables) rows — zero-width when dyn is None.
+    ov_new_row: jax.Array  # [W, KT, K] f32 new dom per row at override j
+    ov_old_row: jax.Array  # [W, KT, K] f32 base dom likewise
+    dex_row: jax.Array  # [W, SP, Dcap] bool domain-exists per spread row
 
 
 def build_wave_pre3(
     dc: DevCluster, d: Derived, sh: Shared3, st: V3Static,
-    sb: PodSlot, sx: SlotExtra, spec,
+    sb: PodSlot, sx: SlotExtra, spec, dyn: Optional[DynTables] = None,
 ) -> WavePre3:
     W = sb.pod_id.shape[0]
     G = st.G
@@ -698,10 +729,16 @@ def build_wave_pre3(
         sp_skew = sb.spread_skew[:, : st.SP].astype(jnp.float32)
         sp_dns = (sb.spread_g[:, : st.SP] >= 0) & sb.spread_dns[:, : st.SP]
         sp_scored = (sb.spread_g[:, : st.SP] >= 0) & ~sb.spread_dns[:, : st.SP]
-        # One source of truth for the upstream topologyNormalizingWeight
-        # table: spec.sp_w_g (jax_runtime._spread_w_table).
-        w_tab = T2._padded_w_table(spec.sp_w_g, G)
-        sp_w = jnp.einsum("wag,g->wa", ohS, jnp.asarray(w_tab), precision=_HI)
+        if dyn is not None:
+            # Per-scenario weights: domain sizes change under set_label.
+            sp_w = jnp.einsum("wag,g->wa", ohS, dyn.sp_w_g, precision=_HI)
+        else:
+            # One source of truth for the upstream topologyNormalizingWeight
+            # table: spec.sp_w_g (jax_runtime._spread_w_table).
+            w_tab = T2._padded_w_table(spec.sp_w_g, G)
+            sp_w = jnp.einsum(
+                "wag,g->wa", ohS, jnp.asarray(w_tab), precision=_HI
+            )
     else:
         sp_selfm = jnp.zeros((W, 0), jnp.float32)
         sp_skew = jnp.zeros((W, 0), jnp.float32)
@@ -724,6 +761,33 @@ def build_wave_pre3(
         na_ok = jnp.ones((W, 1), bool)
         na_raw = jnp.zeros((W, 1), jnp.float32)
 
+    if dyn is not None:
+        K = dyn.ov_nodes.shape[0]
+        valid_row = (row_g >= 0)[:, :, None]
+        ov_new_row = jnp.where(
+            valid_row,
+            jnp.einsum("wkg,gj->wkj", oh_row, dyn.ov_gdom, precision=_HI),
+            float(PAD),
+        )
+        ov_old_row = jnp.where(
+            valid_row,
+            jnp.einsum("wkg,gj->wkj", oh_row, dyn.ov_old, precision=_HI),
+            float(PAD),
+        )
+        if st.SP:
+            dex_row = (
+                jnp.einsum(
+                    "wag,gd->wad", oh_row[:, o2:o3], dyn.dexist, precision=_HI
+                )
+                > 0.5
+            )
+        else:
+            dex_row = jnp.zeros((W, 0, st.Dcap), bool)
+    else:
+        ov_new_row = jnp.zeros((W, st.KT, 0), jnp.float32)
+        ov_old_row = jnp.zeros((W, st.KT, 0), jnp.float32)
+        dex_row = jnp.zeros((W, st.SP, st.Dcap), bool)
+
     return WavePre3(
         row_g=row_g, oh_row=oh_row, coarse_row=coarse_row, dmap=dmap, ov=ov,
         oh_mc_h=oh_mc_h, oh_anti_h=oh_anti_h, oh_pref_h=oh_pref_h,
@@ -732,6 +796,7 @@ def build_wave_pre3(
         sp_scored=sp_scored, sp_w=sp_w,
         pmg_f=pmg_f, anti_g=anti_g, pref_g=pref_g,
         taint_ok=taint_ok, taint_raw=taint_raw, na_ok=na_ok, na_raw=na_raw,
+        ov_new_row=ov_new_row, ov_old_row=ov_old_row, dex_row=dex_row,
     )
 
 
@@ -812,11 +877,14 @@ def class_masks(dc: DevCluster, d: Derived, st: V3Static, spec, rep_slots):
 
 def make_wave_step3(
     dc: DevCluster, d: Derived, sh: Shared3, st: V3Static,
-    wave_width: int, spec, cmasks=None,
+    wave_width: int, spec, cmasks=None, dyn: Optional[DynTables] = None,
+    dyn_flip: bool = True,
 ):
     """Scan body over (PodSlot, SlotExtra) wave batches. Bit-identical to
     the v2 step; see module docstring for the traffic model. ``cmasks``:
-    per-chunk class masks from :func:`class_masks`."""
+    per-chunk class masks from :func:`class_masks`. ``dyn``: per-scenario
+    DynTables for labels_dirty batches — base expansion tables stay
+    shared; corrections apply as K-term fused elementwise updates."""
     cmasks = cmasks or {}
     G = st.G
     Dcap = st.Dcap
@@ -830,7 +898,10 @@ def make_wave_step3(
     # over [Dcap+1] buckets instead of [N] nodes — with the taint row
     # statically gone (no PreferNoSchedule), the whole [S, K, N] hi/lo
     # pass disappears from Borg-shaped traces.
-    spread_dom_hilo = bool(spec.spread and st.SP == 1 and not st.has_host_rows)
+    spread_dom_hilo = bool(
+        spec.spread and st.SP == 1 and not st.has_host_rows and dyn is None
+    )
+    Kdyn = dyn.ov_nodes.shape[0] if dyn is not None else 0
     # Node-space expansion of the domain rows ([S, KT, N] via the dom_oh
     # one-hot matmul) is only needed when some section actually consumes
     # node values: interpod sections, host planes, a real DoNotSchedule
@@ -846,7 +917,7 @@ def make_wave_step3(
     def wave_step(carry: DevState3, batch):
         sb, sx = batch
         N = dc.allocatable.shape[0]
-        pre = build_wave_pre3(dc, d, sh, st, sb, sx, spec)
+        pre = build_wave_pre3(dc, d, sh, st, sb, sx, spec, dyn)
 
         # Wave-start reads (identical for every pod in the wave).
         if st.KT:
@@ -910,6 +981,13 @@ def make_wave_step3(
                 precision=_HI,
             )  # [W, KT]
         iota_n = jnp.arange(N)
+        if Kdyn:
+            # [K, N] override-node one-hots, built once per wave (f32: the
+            # count deltas they meet are unbounded integers — bf16 would
+            # round past 256).
+            at_ov = (
+                dyn.ov_nodes[:, None] == iota_n[None, :]
+            ).astype(jnp.float32)
         R = carry.used.shape[0]
         if st.preemption:
             # Prefix-over-tiers stacks: [Tt+1, ...]; row t = aggregate over
@@ -1062,6 +1140,44 @@ def make_wave_step3(
                     if st.has_host_rows:
                         vals = vals + vals_h0[k] + valh_corr
                     gvalid = pre.dmap[k] >= 0  # [KT, N]
+                    if Kdyn:
+                        # labels_dirty: corrections on top of the BASE
+                        # expansion — for each perturbed node, swap in
+                        # rows_k at its new domain and its new validity.
+                        # PAD ids give all-zero one-hots. ONE [2KT, K] ×
+                        # [K, N] matmul carries both the value deltas and
+                        # the validity flips (a per-j Python loop fused
+                        # badly: 1.8× on the config-3 dirty batch).
+                        arange_d = jnp.arange(Dcap, dtype=jnp.float32)
+                        ohn = (
+                            pre.ov_new_row[k][..., None] == arange_d
+                        ).astype(jnp.float32)  # [KT, K, Dcap]
+                        oho = (
+                            pre.ov_old_row[k][..., None] == arange_d
+                        ).astype(jnp.float32)
+                        newv = jnp.einsum("rjd,rd->rj", ohn, rows_k, precision=_HI)
+                        oldv = jnp.einsum("rjd,rd->rj", oho, rows_k, precision=_HI)
+                        delta = newv - oldv  # [KT, K]
+                        if dyn_flip:
+                            flip = (
+                                (pre.ov_new_row[k] >= 0)
+                                != (pre.ov_old_row[k] >= 0)
+                            ).astype(jnp.float32)  # [KT, K]
+                            corr = jnp.einsum(
+                                "rj,jn->rn",
+                                jnp.concatenate([delta, flip], axis=0),
+                                at_ov,
+                                precision=_HI,
+                            )  # [2·KT, N]
+                            vals = vals + corr[: st.KT]
+                            gvalid = gvalid != (corr[st.KT :] > 0.5)
+                        else:
+                            # No key-presence changes in the whole batch:
+                            # validity is untouched, only values shift.
+                            corr = jnp.einsum(
+                                "rj,jn->rn", delta, at_ov, precision=_HI
+                            )
+                            vals = vals + corr
 
             if spec.interpod and st.A:
                 cnt = vals[o0:o1]
@@ -1086,8 +1202,12 @@ def make_wave_step3(
                 # rows reduce over [Dcap] (tiny); host rows (domain≈node)
                 # need the node-space min.
                 dval = (
-                    jnp.arange(Dcap, dtype=jnp.float32)[None, :]
-                    < nd_row[k, o2:o3][:, None]
+                    pre.dex_row[k]
+                    if dyn is not None
+                    else (
+                        jnp.arange(Dcap, dtype=jnp.float32)[None, :]
+                        < nd_row[k, o2:o3][:, None]
+                    )
                 )  # [SP, Dcap]
                 minv_dom = jnp.min(
                     jnp.where(dval, rows_k[o2:o3], jnp.inf), axis=1
@@ -1363,7 +1483,7 @@ def make_wave_step3(
                     )
                 evicted.append(jnp.zeros((), bool))
             if maintain_dom:
-                if st.single_topo:
+                if st.single_topo and dyn is None:
                     # Every domain-bearing group shares ONE topology: the
                     # bound node's domain is a single dynamic read of the
                     # shared [N] map, broadcast over groups — instead of an
@@ -1377,6 +1497,12 @@ def make_wave_step3(
                 else:
                     oh_n = ((iota_n == node) & (node >= 0)).astype(jnp.float32)
                     dom_at = jnp.einsum("gn,n->g", sh.gdom_f, oh_n, precision=_HI)
+                    for j in range(Kdyn):
+                        # Perturbed node bound: its per-group domain is the
+                        # override (== base where that topology unchanged).
+                        dom_at = jnp.where(
+                            node == dyn.ov_nodes[j], dyn.ov_gdom[:, j], dom_at
+                        )
                     # A miss (or padded slot) must not look like domain 0.
                     dom_at = jnp.where(placed, dom_at, float(PAD))
                 dom_ats.append(dom_at)

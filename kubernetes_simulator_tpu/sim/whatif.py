@@ -81,6 +81,7 @@ class ScenarioSet:
         lv = np.repeat(ec.node_label_kv[None], S, axis=0).copy()
         ln = np.repeat(ec.node_label_num[None], S, axis=0).copy()
         labels_dirty = np.zeros(S, dtype=bool)
+        ov_sets: Dict[int, set] = {}  # scenario → perturbed-label node ids
 
         for si, sc in enumerate(scenarios):
             for pt in sc.perturbations:
@@ -117,6 +118,7 @@ class ScenarioSet:
                         lk[si, n, slot] = kid
                         lv[si, n, slot] = kvid
                         ln[si, n, slot] = num
+                        ov_sets.setdefault(si, set()).add(int(n))
                     labels_dirty[si] = True
                 else:
                     raise ValueError(f"unknown perturbation op {pt.op!r}")
@@ -128,6 +130,7 @@ class ScenarioSet:
         nd = np.repeat(ec.node_domain[None], S, axis=0).copy()
         ndom = np.repeat(ec.num_domains[None], S, axis=0).copy()
         dirty = np.nonzero(labels_dirty)[0]
+        kv_by_topo: Dict[int, np.ndarray] = {}  # ti → [Sd, N] kv ids
         if dirty.size:
             # Vectorized over nodes (the old per-node Python scan was
             # O(S·T·N·slots) and dominated label-perturbation setup).
@@ -157,6 +160,7 @@ class ScenarioSet:
                     -1,
                 )  # [Sd, N] kv ids
                 g = np.where(vals >= 0, gpos[np.clip(vals, 0, n_kv)], -1)
+                kv_by_topo[ti] = vals
                 for s_i, si in enumerate(dirty):
                     row = g[s_i]
                     present = row >= 0
@@ -168,9 +172,19 @@ class ScenarioSet:
                     nd[si, ti] = out
                     ndom[si, ti] = len(uniq)
         self.max_domains = max(int(ndom.max()) if ndom.size else 1, ec.max_domains, 1)
-        # v3 requires scenario-shared node→domain tables; label perturbations
-        # that re-derive domains force the v2 (node-space) engine.
         self.labels_dirty = bool(labels_dirty.any())
+        # v3 with per-scenario DynTables (round 3): keep the base (shared)
+        # expansion tables and thread tiny per-scenario corrections through
+        # the wave step. Domain ids are APPEND-style — existing label values
+        # keep their base ids, new values get ids past the base count.
+        # Internal ids are semantics-free (all consumers use per-domain
+        # counts / existence / sizes), so this differs from the v2 path's
+        # rank-style re-derivation without changing any observable result.
+        self.dyn = None
+        if self.labels_dirty:
+            self.dyn = self._build_dyn(
+                ec, S, dirty, ov_sets, kv_by_topo
+            )
         # Injected PreferNoSchedule taints re-enable the taint score row
         # (StepSpec.taint_score is derived from the base cluster only).
         self.injected_prefer_taint = any(
@@ -180,7 +194,10 @@ class ScenarioSet:
             for pt in sc.perturbations
         )
 
-        self.dc = T.DevCluster(
+        self.dc = self._build_dc(ec, S, alloc, lk, lv, ln, tk, tv, te, nd, ndom)
+
+    def _build_dc(self, ec, S, alloc, lk, lv, ln, tk, tv, te, nd, ndom):
+        return T.DevCluster(
             allocatable=jnp.asarray(alloc),
             node_label_key=jnp.asarray(lk),
             node_label_kv=jnp.asarray(lv),
@@ -196,6 +213,175 @@ class ScenarioSet:
             expr_num=jnp.asarray(np.repeat(ec.expr_num[None], S, 0)),
             group_topo=jnp.asarray(np.repeat(ec.group_topo[None], S, 0)),
         )
+
+    def _build_dyn(self, ec, S, dirty, ov_sets, kv_by_topo):
+        """Append-style per-scenario domain tables (ScenarioDyn docstring).
+        All host-side numpy; every array is tiny ([S, G, K] / [S, G, D])."""
+        vocab = ec.vocab
+        Tn = ec.node_domain.shape[0]
+        K = max((len(v) for v in ov_sets.values()), default=0)
+        if K == 0:
+            return None
+        from ..ops.tpu3 import DMAX_COARSE
+
+        dirty_pos = {int(si): i for i, si in enumerate(dirty)}
+        # Base value→domain maps per topology (from the base label arrays;
+        # vectorized — a per-node Python loop here would re-dominate
+        # labels_dirty setup at Borg scale, the round-2 finding).
+        base_kv2dom = []
+        for ti, tkey in enumerate(vocab.topo_keys):
+            m = {}
+            kid = vocab._k.get(tkey)
+            if kid is not None:
+                is_k = ec.node_label_key == kid  # [N, L]
+                has = is_k.any(axis=1)
+                slot = is_k.argmax(axis=1)
+                kvv = np.where(
+                    has,
+                    np.take_along_axis(ec.node_label_kv, slot[:, None], 1)[:, 0],
+                    -1,
+                )
+                bm = ec.node_domain[ti]
+                sel = has & (bm >= 0)
+                kv_u, first = np.unique(kvv[sel], return_index=True)
+                dom_u = bm[sel][first]
+                m = dict(zip(kv_u.tolist(), dom_u.tolist()))
+            base_kv2dom.append(m)
+        base_nd = [int(ec.num_domains[t]) for t in range(Tn)]
+        coarse_t = [base_nd[t] <= DMAX_COARSE for t in range(Tn)]
+        # Appended ids for values absent from the base (sorted by kv id —
+        # the choice is semantics-free; only counts/existence/size matter).
+        app_ids = {}
+        Dext = max([nd for t, nd in enumerate(base_nd) if coarse_t[t]] + [1])
+        Dfull = max(base_nd + [1])  # counts cover host topos too (weights)
+        for si, nodes in ov_sets.items():
+            s_i = dirty_pos[si]
+            for ti in range(Tn):
+                kvv = kv_by_topo.get(ti)
+                if kvv is None:
+                    continue
+                newkvs = {
+                    int(kvv[s_i, n])
+                    for n in nodes
+                    if int(kvv[s_i, n]) >= 0
+                    and int(kvv[s_i, n]) not in base_kv2dom[ti]
+                }
+                ids = {
+                    kv: base_nd[ti] + r for r, kv in enumerate(sorted(newkvs))
+                }
+                app_ids[(si, ti)] = ids
+                if coarse_t[ti]:
+                    Dext = max(Dext, base_nd[ti] + len(ids))
+                Dfull = max(Dfull, base_nd[ti] + len(ids))
+        # Per-domain node counts → existence, applying overrides.
+        cnt = np.zeros((S, Tn, Dfull), np.int64)
+        for t in range(Tn):
+            bm = ec.node_domain[t]
+            labeled = bm[bm >= 0]
+            if labeled.size:
+                bc = np.bincount(labeled, minlength=Dfull)[:Dfull]
+                cnt[:, t, :] = bc[None, :]
+        ov_nodes = np.full((S, K), PAD, np.int32)
+        new_tn = np.full((S, Tn, K), float(PAD), np.float32)
+        old_tn = np.full((S, Tn, K), float(PAD), np.float32)
+        for si, nodes in ov_sets.items():
+            s_i = dirty_pos[si]
+            nlist = sorted(nodes)
+            ov_nodes[si, : len(nlist)] = nlist
+            for ti in range(Tn):
+                kvv = kv_by_topo.get(ti)
+                bm = ec.node_domain[ti]
+                for j, n in enumerate(nlist):
+                    old = int(bm[n])
+                    if kvv is None:
+                        newd = old  # topology untouched by any set_label
+                    else:
+                        kv = int(kvv[s_i, n])
+                        if kv < 0:
+                            newd = PAD
+                        else:
+                            newd = base_kv2dom[ti].get(kv)
+                            if newd is None:
+                                newd = app_ids[(si, ti)][kv]
+                    new_tn[si, ti, j] = newd
+                    old_tn[si, ti, j] = old
+                    if newd != old:
+                        if old >= 0:
+                            cnt[si, ti, old] -= 1
+                        if newd >= 0:
+                            cnt[si, ti, newd] += 1
+        ex = cnt > 0
+        nd_exist = ex.sum(axis=2)  # [S, Tn]
+        # A perturbation that moves a node's domain under a HOST-scale
+        # topology cannot be corrected (host planes are node-space) — the
+        # engine must fall back to v2 for the whole batch.
+        # PAD-padded slots have new == old == PAD, so the inequality
+        # alone suffices.
+        host_changed = any(
+            not coarse_t[t] and (new_tn[:, t, :] != old_tn[:, t, :]).any()
+            for t in range(Tn)
+        )
+        G = max(ec.num_groups, 1)
+        gt = (
+            ec.group_topo[:G]
+            if ec.group_topo.shape[0] >= G
+            else np.full(G, PAD, np.int32)
+        )
+        ov_gdom = np.full((S, G, K), float(PAD), np.float32)
+        ov_old = np.full((S, G, K), float(PAD), np.float32)
+        dexist = np.zeros((S, G, Dext), np.float32)  # coarse width only
+        sp_w = np.full(
+            (S, G), np.float32(np.log(np.float64(2.0))), np.float32
+        )  # nd=0 groups: log(0+2), matching _spread_w_table
+        for g in range(G):
+            t = int(gt[g])
+            if t < 0:
+                continue
+            ov_gdom[:, g, :] = new_tn[:, t, :]
+            ov_old[:, g, :] = old_tn[:, t, :]
+            if coarse_t[t]:
+                dexist[:, g, :] = ex[:, t, :Dext]
+            sp_w[:, g] = np.log(
+                nd_exist[:, t].astype(np.float64) + 2.0
+            ).astype(np.float32)
+        dyn = ScenarioDyn(ov_nodes, ov_gdom, ov_old, dexist, sp_w, Dext)
+        dyn.host_changed = host_changed
+        # Key-presence changes (a node gaining/losing a topology key) are
+        # rare; when absent the wave step statically drops the validity-
+        # flip half of its correction matmul.
+        dyn.has_presence_change = bool(
+            ((new_tn >= 0) != (old_tn >= 0)).any()
+        )
+        return dyn
+
+
+class ScenarioDyn:
+    """Per-scenario domain tables for v3 labels_dirty batches (append-style
+    ids; see ScenarioSet). All arrays lead with the scenario axis and are
+    tiny relative to the [S, N] planes:
+
+    - ``ov_nodes`` [S, K] i32 — label-perturbed node ids (PAD-padded)
+    - ``ov_gdom`` [S, G, K] f32 — the node's NEW domain under each group's
+      topology (== base where that topology is unchanged; PAD where the
+      group has no topology or the node lacks the key)
+    - ``ov_old`` [S, G, K] f32 — the node's BASE domain (PAD likewise)
+    - ``dexist`` [S, G, Dcap] f32 — 1.0 where the domain has ≥1 node
+    - ``sp_w_g`` [S, G] f32 — upstream log(size+2) with size = number of
+      EXISTING domains per scenario (f64 log on host, matching the CPU
+      path value-for-value)
+    """
+
+    def __init__(self, ov_nodes, ov_gdom, ov_old, dexist, sp_w_g, Dcap):
+        self.ov_nodes = ov_nodes
+        self.ov_gdom = ov_gdom
+        self.ov_old = ov_old
+        self.dexist = dexist
+        self.sp_w_g = sp_w_g
+        self.Dcap = int(Dcap)  # required Dcap (base + appended values)
+
+    @property
+    def K(self) -> int:
+        return self.ov_nodes.shape[1]
 
 
 @dataclass
@@ -257,8 +443,26 @@ class WhatIfEngine:
             if self.S % ndev != 0:
                 raise ValueError(f"num scenarios {self.S} must divide over {ndev} devices")
         self.D = max(self.sset.max_domains, 1)
-        # v3 engine unless label perturbations re-derived topology domains.
-        self.engine = "v2" if self.sset.labels_dirty else "v3"
+        # v3 unless the labels_dirty batch falls outside the DynTables
+        # envelope (per-scenario domain tables; round 3): host-scale
+        # topologies, pre-bound pods, preemption, forks, completions and
+        # >32 perturbed nodes per scenario stay on the v2 parity engine.
+        self.engine = "v3"
+        self._dyn = None
+        if self.sset.labels_dirty:
+            dyn = self.sset.dyn
+            if (
+                dyn is not None
+                and dyn.K <= 32
+                and not dyn.host_changed
+                and not preemption
+                and fork_checkpoint is None
+                and not bool((pods.bound_node >= 0).any())
+                and not completions
+            ):
+                self._dyn = dyn
+            else:
+                self.engine = "v2"
         self.preemption = preemption
         if preemption and (self.engine != "v3" or fork_checkpoint):
             raise ValueError(
@@ -285,9 +489,23 @@ class WhatIfEngine:
             self.static3 = V3.V3Static.build(
                 ec, pods, self.spec, preemption=preemption,
                 allow_bf16_host=not scales_pods,
+                dcap_min=(self._dyn.Dcap if self._dyn is not None else 0),
             )
             self.shared3 = V3.Shared3.build(ec, self.static3)
             self.rep_slots = rep_slots_for(self.static3, pods)
+        if self.engine == "v3" and self._dyn is not None:
+            from ..ops import tpu3 as V3
+
+            d = self._dyn
+            self._dyn_dev = V3.DynTables(
+                ov_nodes=jnp.asarray(d.ov_nodes),
+                ov_gdom=jnp.asarray(d.ov_gdom),
+                ov_old=jnp.asarray(d.ov_old),
+                dexist=jnp.asarray(d.dexist),
+                sp_w_g=jnp.asarray(d.sp_w_g),
+            )
+        else:
+            self._dyn_dev = None
         self.waves = pack_waves(pods, self.wave_width)
         rel = pods.arrival + np.where(
             np.isfinite(pods.duration), pods.duration, np.inf
@@ -296,6 +514,7 @@ class WhatIfEngine:
         self.completions_on = bool(
             completions
             and self.engine == "v3"
+            and self._dyn is None  # release deltas use base domain tables
             and not preemption
             and np.isfinite(rel).any()
         )
@@ -324,12 +543,18 @@ class WhatIfEngine:
             st3, sh3, reps = self.static3, self.shared3, self.rep_slots
 
             pre_on = self.preemption
+            dyn_on = self._dyn_dev is not None
+            dyn_flip = bool(
+                self._dyn is not None
+                and getattr(self._dyn, "has_presence_change", True)
+            )
 
-            def per_scenario(dc, state, slots, extra):
+            def per_scenario(dc, state, slots, extra, dyn=None):
                 d = T.Derived.build(dc)
                 cmasks = V3.class_masks(dc, d, st3, spec, reps)
                 wave_step = V3.make_wave_step3(
-                    dc, d, sh3, st3, wave_width, spec, cmasks
+                    dc, d, sh3, st3, wave_width, spec, cmasks, dyn=dyn,
+                    dyn_flip=dyn_flip,
                 )
 
                 def step(st, batch):
@@ -356,19 +581,31 @@ class WhatIfEngine:
                 # Device-side slot gathers INSIDE the jitted program: one
                 # dispatch per chunk, only indices as per-chunk input
                 # (scenario-shared → gathered once, not per scenario).
-                def per_scenario_src(dc, state, src, xsrc, idx):
+                def per_scenario_src(dc, state, src, xsrc, idx, dyn=None):
                     slots = T.gather_slots_device(src, idx)
                     from ..ops import tpu3 as V3m
 
                     extra = V3m.gather_extra_device(xsrc, idx)
-                    return per_scenario(dc, state, slots, extra)
+                    return per_scenario(dc, state, slots, extra, dyn)
 
+                # vmap matches in_axes against the args actually passed,
+                # so the defaulted dyn arg needs no wrapper.
                 vmapped_src = jax.vmap(
-                    per_scenario_src, in_axes=(0, 0, None, None, None)
+                    per_scenario_src,
+                    in_axes=(
+                        (0, 0, None, None, None, 0)
+                        if dyn_on
+                        else (0, 0, None, None, None)
+                    ),
                 )
                 return jax.jit(vmapped_src, donate_argnums=(1,))
 
-            vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None, None))
+            vmapped = jax.vmap(
+                per_scenario,
+                in_axes=(
+                    (0, 0, None, None, 0) if dyn_on else (0, 0, None, None)
+                ),
+            )
         else:
             def per_scenario(dc, state, slots):
                 d = T.Derived.build(dc)
@@ -404,6 +641,8 @@ class WhatIfEngine:
                     lambda _: repl, V3.gather_extra(self.static3, self.waves.idx[:1])
                 )
             )
+            if self._dyn_dev is not None:
+                in_sh.append(jax.tree.map(lambda _: shard, self._dyn_dev))
         return jax.jit(
             vmapped,
             in_shardings=tuple(in_sh),
@@ -708,9 +947,10 @@ class WhatIfEngine:
             if self.mesh is None and self.engine == "v3" and srcs is not None:
                 # Fused device-side gather + wave scan: one dispatch per
                 # chunk, indices pre-staged (ops.tpu.SlotSource).
-                states, out = self._chunk_fn(
-                    dc, states, srcs[0], srcs[1], idx_chunks[ci]
-                )
+                args = (dc, states, srcs[0], srcs[1], idx_chunks[ci])
+                if self._dyn_dev is not None:
+                    args = args + (self._dyn_dev,)
+                states, out = self._chunk_fn(*args)
             else:
                 slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
                 if self.mesh is not None:
@@ -721,7 +961,13 @@ class WhatIfEngine:
                     extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
                     if self.mesh is not None:
                         extra = replicate_tree(self.mesh, extra)
-                    states, out = self._chunk_fn(dc, states, slots, extra)
+                    args = (dc, states, slots, extra)
+                    if self._dyn_dev is not None:
+                        dyn_in = self._dyn_dev
+                        if self.mesh is not None:
+                            dyn_in = shard_scenario_tree(self.mesh, dyn_in)
+                        args = args + (dyn_in,)
+                    states, out = self._chunk_fn(*args)
                 else:
                     states, out = self._chunk_fn(dc, states, slots)
             outs.append(out)

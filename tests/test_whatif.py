@@ -166,3 +166,121 @@ def test_injected_prefer_taint_reenables_score_row():
     ec_t, ep_t = encode(cluster_t, pods)
     ref = JaxReplayEngine(ec_t, ep_t, FrameworkConfig()).replay()
     np.testing.assert_array_equal(res.assignments[1], ref.assignments)
+
+
+def _force_v2(ec, ep, scen, cfg, **kw):
+    """The v2 node-space engine as the labels_dirty parity pin."""
+    eng = WhatIfEngine(ec, ep, scen, cfg, **kw)
+    if eng.engine != "v2":
+        eng.engine = "v2"
+        eng._dyn = None
+        eng._dyn_dev = None
+        eng._slot_srcs = None
+        eng._chunk_fn = eng._build_chunk_fn()
+    return eng
+
+
+def test_labels_dirty_runs_v3_and_matches_v2_and_scratch():
+    """Round-3 DynTables: label-perturbation batches stay on the v3 engine
+    and must match BOTH the v2 parity engine and a from-scratch replay of
+    each explicitly perturbed cluster. Cases: move to an existing value,
+    a NEW value (appended domain id), emptying a domain (its last node
+    moves out — the spread min must exclude it), a node GAINING the key,
+    and mixed taint/capacity perturbations in the same batch."""
+    import copy
+
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+    cluster = make_cluster(18, seed=5, taint_fraction=0.1)
+    zkey = "topology.kubernetes.io/zone"
+    # Give one zone exactly one node (emptying case) and strip the key
+    # from one node (gaining case).
+    cluster.nodes[7].labels[zkey] = "zonly"
+    del cluster.nodes[11].labels[zkey]
+    pods, _ = make_workload(
+        70, seed=5, with_affinity=True, with_spread=True, with_tolerations=True
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = [
+        Scenario(),
+        Scenario([  # existing value + capacity in one scenario
+            Perturbation("set_label", nodes=np.array([0, 4]), key=zkey, value="zone-1"),
+            Perturbation("scale_capacity", nodes=np.array([2]), resource="cpu", factor=0.5),
+        ]),
+        Scenario([  # NEW value → appended domain id
+            Perturbation("set_label", nodes=np.array([1, 9]), key=zkey, value="zz-fresh"),
+        ]),
+        Scenario([  # empty the singleton zone
+            Perturbation("set_label", nodes=np.array([7]), key=zkey, value="zone-0"),
+        ]),
+        Scenario([  # unlabeled node gains the key
+            Perturbation("set_label", nodes=np.array([11]), key=zkey, value="zone-2"),
+        ]),
+        Scenario([  # taint-only scenario sharing the dirty batch
+            Perturbation("add_taint", nodes=np.array([5]), key="wi", value="x", effect="NoSchedule"),
+        ]),
+    ]
+    eng = WhatIfEngine(ec, ep, scen, cfg, chunk_waves=4, collect_assignments=True)
+    assert eng.engine == "v3" and eng._dyn is not None
+    res = eng.run()
+
+    v2 = _force_v2(ec, ep, scen, cfg, chunk_waves=4, collect_assignments=True)
+    assert v2.engine == "v2"
+    res2 = v2.run()
+    np.testing.assert_array_equal(res.assignments, res2.assignments)
+
+    # From-scratch replay of each perturbed cluster (label/taint/capacity
+    # applied to a copy, re-encoded) — chunk sizes aligned.
+    for si, sc in enumerate(scen):
+        c2 = copy.deepcopy(cluster)
+        for pt in sc.perturbations:
+            for n in np.asarray(pt.nodes).tolist():
+                if pt.op == "set_label":
+                    c2.nodes[n].labels[pt.key] = pt.value
+                elif pt.op == "scale_capacity":
+                    c2.nodes[n].allocatable = {
+                        k: (v * pt.factor if k == "cpu" else v)
+                        for k, v in c2.nodes[n].allocatable.items()
+                    }
+                elif pt.op == "add_taint":
+                    from kubernetes_simulator_tpu.models.core import Taint
+
+                    c2.nodes[n].taints.append(
+                        Taint(pt.key, pt.value, pt.effect)
+                    )
+        ec2, ep2 = encode(c2, pods)
+        single = JaxReplayEngine(ec2, ep2, cfg, chunk_waves=4).replay()
+        np.testing.assert_array_equal(
+            res.assignments[si], single.assignments,
+            err_msg=f"scenario {si} diverged from from-scratch replay",
+        )
+
+
+def test_labels_dirty_mesh_matches_unsharded():
+    """DynTables shard over the scenario axis like every other per-scenario
+    tensor: the 8-device mesh run must equal the unsharded batch."""
+    ec, ep = small_case(seed=11, n=16, p=64)
+    zkey = "topology.kubernetes.io/zone"
+    rng = np.random.default_rng(11)
+    scen = [Scenario()] + [
+        Scenario([
+            Perturbation(
+                "set_label", nodes=rng.choice(16, 2, replace=False),
+                key=zkey, value=f"zone-{rng.integers(0, 8)}",
+            )
+        ])
+        for _ in range(7)
+    ]
+    cfg = FrameworkConfig()
+    plain = WhatIfEngine(ec, ep, scen, cfg, chunk_waves=4, collect_assignments=True)
+    assert plain.engine == "v3" and plain._dyn is not None
+    res = plain.run()
+    sharded = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, collect_assignments=True,
+        mesh=make_mesh(),
+    )
+    assert sharded.engine == "v3" and sharded._dyn is not None
+    res2 = sharded.run()
+    np.testing.assert_array_equal(res.assignments, res2.assignments)
