@@ -57,12 +57,30 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _global_put(a, sh: NamedSharding):
+    """Host copy → global array for a multi-process mesh. Built from each
+    process's local data via make_array_from_callback: device_put's
+    cross-process consistency check compares values with ``==``, which NaN
+    entries (numeric-label slots) always fail even though every process
+    holds identical bytes."""
+    a = np.asarray(a)
+    return jax.make_array_from_callback(a.shape, sh, lambda idx: a[idx])
+
+
 def shard_scenario_tree(mesh: Mesh, tree, axis: str = SCENARIO_AXIS):
-    """device_put every leaf with its leading dim sharded over the mesh."""
+    """device_put every leaf with its leading dim sharded over the mesh.
+
+    Multi-process (DCN): leaves are pulled back to host and re-emitted as
+    global arrays — device_put from a single-device array to a sharding
+    spanning non-addressable devices is not defined."""
     sh = scenario_sharding(mesh, axis)
+    if jax.process_count() > 1:
+        return jax.tree.map(lambda a: _global_put(a, sh), tree)
     return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
 
 
 def replicate_tree(mesh: Mesh, tree):
     sh = replicated(mesh)
+    if jax.process_count() > 1:
+        return jax.tree.map(lambda a: _global_put(a, sh), tree)
     return jax.tree.map(lambda a: jax.device_put(a, sh), tree)

@@ -31,7 +31,13 @@ from ..models.core import Effect
 from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import init_state
 from ..ops import tpu as T
-from ..parallel.mesh import SCENARIO_AXIS, make_mesh, replicate_tree, shard_scenario_tree
+from ..parallel.mesh import (
+    SCENARIO_AXIS,
+    make_mesh,
+    replicate_tree,
+    replicated,
+    shard_scenario_tree,
+)
 from .jax_runtime import StepSpec, make_wave_step
 from .waves import pack_waves
 
@@ -253,7 +259,6 @@ class ScenarioSet:
         # the choice is semantics-free; only counts/existence/size matter).
         app_ids = {}
         Dext = max([nd for t, nd in enumerate(base_nd) if coarse_t[t]] + [1])
-        Dfull = max(base_nd + [1])  # counts cover host topos too (weights)
         for si, nodes in ov_sets.items():
             s_i = dirty_pos[si]
             for ti in range(Tn):
@@ -272,14 +277,18 @@ class ScenarioSet:
                 app_ids[(si, ti)] = ids
                 if coarse_t[ti]:
                     Dext = max(Dext, base_nd[ti] + len(ids))
-                Dfull = max(Dfull, base_nd[ti] + len(ids))
-        # Per-domain node counts → existence, applying overrides.
-        cnt = np.zeros((S, Tn, Dfull), np.int64)
+        # Per-domain node counts → existence. Coarse topologies only:
+        # host-scale ones (hostname at Borg scale) would make this an
+        # O(S·T·N) allocation, and they never change here (host_changed
+        # forces v2 otherwise) — their nd_exist is the base count.
+        cnt = np.zeros((S, Tn, Dext), np.int64)
         for t in range(Tn):
+            if not coarse_t[t]:
+                continue
             bm = ec.node_domain[t]
             labeled = bm[bm >= 0]
             if labeled.size:
-                bc = np.bincount(labeled, minlength=Dfull)[:Dfull]
+                bc = np.bincount(labeled, minlength=Dext)[:Dext]
                 cnt[:, t, :] = bc[None, :]
         ov_nodes = np.full((S, K), PAD, np.int32)
         new_tn = np.full((S, Tn, K), float(PAD), np.float32)
@@ -305,13 +314,16 @@ class ScenarioSet:
                                 newd = app_ids[(si, ti)][kv]
                     new_tn[si, ti, j] = newd
                     old_tn[si, ti, j] = old
-                    if newd != old:
+                    if coarse_t[ti] and newd != old:
                         if old >= 0:
                             cnt[si, ti, old] -= 1
                         if newd >= 0:
                             cnt[si, ti, newd] += 1
         ex = cnt > 0
         nd_exist = ex.sum(axis=2)  # [S, Tn]
+        for t in range(Tn):
+            if not coarse_t[t]:
+                nd_exist[:, t] = base_nd[t]  # unchanged (host_changed gate)
         # A perturbation that moves a node's domain under a HOST-scale
         # topology cannot be corrected (host planes are node-space) — the
         # engine must fall back to v2 for the whole batch.
@@ -340,7 +352,7 @@ class ScenarioSet:
             ov_gdom[:, g, :] = new_tn[:, t, :]
             ov_old[:, g, :] = old_tn[:, t, :]
             if coarse_t[t]:
-                dexist[:, g, :] = ex[:, t, :Dext]
+                dexist[:, g, :] = ex[:, t, :]
             sp_w[:, g] = np.log(
                 nd_exist[:, t].astype(np.float64) + 2.0
             ).astype(np.float32)
@@ -506,6 +518,19 @@ class WhatIfEngine:
             )
         else:
             self._dyn_dev = None
+        self._replicate_fn = None
+        if self._dyn is not None and self.spec.sp_norm_f32:
+            # Per-scenario spread weights (appended domains) can exceed the
+            # bound under which the f32 normalize division is exactly the
+            # integer division — re-validate with the per-scenario maxima
+            # and drop the fast form if they might.
+            from .jax_runtime import _spread_norm_f32_ok
+
+            sp_w_max = tuple(
+                float(x) for x in self._dyn.sp_w_g.max(axis=0)
+            )
+            if not _spread_norm_f32_ok(sp_w_max, pods):
+                self.spec = dc_replace(self.spec, sp_norm_f32=False)
         self.waves = pack_waves(pods, self.wave_width)
         rel = pods.arrival + np.where(
             np.isfinite(pods.duration), pods.duration, np.inf
@@ -852,6 +877,21 @@ class WhatIfEngine:
             delta = shard_scenario_tree(self.mesh, delta)
         return jax.tree.map(jnp.subtract, states, delta)
 
+    def _fetch(self, x) -> np.ndarray:
+        """Device→host for a result tensor. On a multi-process (DCN) mesh
+        the array is replicated first — the end-of-replay all_gather that
+        SURVEY §5 names as the replay's one collective — since host
+        conversion needs every shard addressable. The jitted replicator is
+        cached on the engine (jit caches by function identity; a fresh
+        lambda per call would recompile per tensor per chunk)."""
+        if self.mesh is not None and jax.process_count() > 1:
+            if self._replicate_fn is None:
+                self._replicate_fn = jax.jit(
+                    lambda a: a, out_shardings=replicated(self.mesh)
+                )
+            x = self._replicate_fn(x)
+        return np.asarray(x)
+
     def run(self) -> WhatIfResult:
         states = self._init_states()  # sets fork bookkeeping first
         idx = self.waves.idx
@@ -929,6 +969,10 @@ class WhatIfEngine:
                     else:
                         rel0 = np.zeros(self.pods.num_pods, bool)
                 released |= rel0[None, :]
+        dyn_sharded = self._dyn_dev
+        if dyn_sharded is not None and self.mesh is not None:
+            # Chunk-invariant: shard once, not per chunk.
+            dyn_sharded = shard_scenario_tree(self.mesh, dyn_sharded)
         srcs = self._slot_srcs
         idx_chunks = (
             [jnp.asarray(idx[c0 : c0 + C]) for c0 in range(0, idx.shape[0], C)]
@@ -948,8 +992,8 @@ class WhatIfEngine:
                 # Fused device-side gather + wave scan: one dispatch per
                 # chunk, indices pre-staged (ops.tpu.SlotSource).
                 args = (dc, states, srcs[0], srcs[1], idx_chunks[ci])
-                if self._dyn_dev is not None:
-                    args = args + (self._dyn_dev,)
+                if dyn_sharded is not None:
+                    args = args + (dyn_sharded,)
                 states, out = self._chunk_fn(*args)
             else:
                 slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
@@ -962,18 +1006,15 @@ class WhatIfEngine:
                     if self.mesh is not None:
                         extra = replicate_tree(self.mesh, extra)
                     args = (dc, states, slots, extra)
-                    if self._dyn_dev is not None:
-                        dyn_in = self._dyn_dev
-                        if self.mesh is not None:
-                            dyn_in = shard_scenario_tree(self.mesh, dyn_in)
-                        args = args + (dyn_in,)
+                    if dyn_sharded is not None:
+                        args = args + (dyn_sharded,)
                     states, out = self._chunk_fn(*args)
                 else:
                     states, out = self._chunk_fn(dc, states, slots)
             outs.append(out)
             if comp_on:
                 rows = idx[c0 : c0 + C]
-                ch = np.asarray(out).reshape((self.S,) + rows.shape)
+                ch = self._fetch(out).reshape((self.S,) + rows.shape)
                 v = rows >= 0
                 host_assign[:, rows[v]] = ch[:, v]
         jax.block_until_ready(states)
@@ -981,9 +1022,9 @@ class WhatIfEngine:
 
         to_schedule = int((idx >= 0).sum())
         if self.collect_assignments and self.preemption:
-            choices = np.concatenate([np.asarray(o[0]) for o in outs], axis=1)
-            ev_node = np.concatenate([np.asarray(o[1]) for o in outs], axis=1)
-            ev_tier = np.concatenate([np.asarray(o[2]) for o in outs], axis=1)
+            choices = np.concatenate([self._fetch(o[0]) for o in outs], axis=1)
+            ev_node = np.concatenate([self._fetch(o[1]) for o in outs], axis=1)
+            ev_tier = np.concatenate([self._fetch(o[2]) for o in outs], axis=1)
             from .jax_runtime import preemption_walk
 
             assignments = np.full((self.S, self.pods.num_pods), PAD, np.int32)
@@ -997,7 +1038,9 @@ class WhatIfEngine:
             scheduled = ~prebound
             placed = (assignments[:, scheduled] >= 0).sum(axis=1).astype(np.int32)
         elif self.collect_assignments:
-            choices = np.concatenate([np.asarray(o) for o in outs], axis=1)  # [S, Cw, W]
+            choices = np.concatenate(
+                [self._fetch(o) for o in outs], axis=1
+            )  # [S, Cw, W]
             flat_idx = idx.reshape(-1)
             valid = flat_idx >= 0
             assignments = np.full((self.S, self.pods.num_pods), PAD, np.int32)
@@ -1017,7 +1060,7 @@ class WhatIfEngine:
             assignments = None
             if self._need_choices:
                 # Completions forced per-pod choices; count from them.
-                choices = np.concatenate([np.asarray(o) for o in outs], axis=1)
+                choices = np.concatenate([self._fetch(o) for o in outs], axis=1)
                 flat_idx = idx.reshape(-1)
                 valid = flat_idx >= 0
                 placed = (
@@ -1028,7 +1071,7 @@ class WhatIfEngine:
             else:
                 # Device-side reduce, ONE small D2H: per-array np.asarray
                 # round-trips through the tunneled device add seconds.
-                placed = np.asarray(
+                placed = self._fetch(
                     jax.jit(
                         lambda o: jnp.concatenate(o, axis=1).sum(
                             axis=1, dtype=jnp.int32
@@ -1049,7 +1092,7 @@ class WhatIfEngine:
 
             # [S] floats instead of the full [S, R, N] used plane D2H
             # (11.7s through the tunnel at the north-star shape).
-            util = np.asarray(
+            util = self._fetch(
                 jax.jit(_util)(states.used, self.sset.dc.allocatable)
             )
         total = int(placed.sum())

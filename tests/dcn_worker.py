@@ -1,0 +1,52 @@
+"""Worker for the 2-process DCN test (SURVEY §5 distributed backend):
+launched as a subprocess with 4 virtual CPU devices, joins the
+jax.distributed coordinator, runs a tiny mesh-sharded what-if over the 8
+GLOBAL devices, and prints per-scenario placed counts as one JSON line.
+
+Env (set by the parent test): DCN_COORD, DCN_NPROC, DCN_PID.
+Platform env (JAX_PLATFORMS=cpu, --xla_force_host_platform_device_count=4)
+must be set BEFORE jax import — the parent passes it through the
+environment, not this module.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    import jax
+
+    from kubernetes_simulator_tpu.parallel.mesh import init_distributed, make_mesh
+
+    init_distributed(
+        coordinator_address=os.environ["DCN_COORD"],
+        num_processes=int(os.environ["DCN_NPROC"]),
+        process_id=int(os.environ["DCN_PID"]),
+    )
+    assert jax.process_count() == int(os.environ["DCN_NPROC"])
+    assert jax.device_count() == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+    from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+    cluster = make_cluster(12, seed=21, taint_fraction=0.2)
+    pods, _ = make_workload(
+        48, seed=21, with_affinity=True, with_spread=True, with_tolerations=True
+    )
+    ec, ep = encode(cluster, pods)
+    scenarios = uniform_scenarios(ec, 8, seed=21, p_capacity=0.5, p_taint=0.3)
+    mesh = make_mesh()  # 8 global devices across the 2 processes
+    res = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), mesh=mesh, chunk_waves=4
+    ).run()
+    print("DCN_RESULT " + json.dumps(res.placed.tolist()), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""2-process jax.distributed (DCN) execution of the mesh-sharded what-if
+(SURVEY §5 distributed communication backend; VERDICT r2 #5: the path must
+have a passing caller, not just exist).
+
+Two subprocesses × 4 virtual CPU devices join a local coordinator; the
+scenario mesh spans all 8 global devices; per-scenario placed counts must
+equal the single-process 8-device run bit-for-bit."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+_WORKER = os.path.join(os.path.dirname(__file__), "dcn_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _reference_placed() -> np.ndarray:
+    """Single-process 8-device reference (same trace/scenarios/seed)."""
+    cluster = make_cluster(12, seed=21, taint_fraction=0.2)
+    pods, _ = make_workload(
+        48, seed=21, with_affinity=True, with_spread=True, with_tolerations=True
+    )
+    ec, ep = encode(cluster, pods)
+    scenarios = uniform_scenarios(ec, 8, seed=21, p_capacity=0.5, p_taint=0.3)
+    from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+
+    res = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), mesh=make_mesh(), chunk_waves=4
+    ).run()
+    return res.placed
+
+
+def test_two_process_dcn_matches_single_process():
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "DCN_COORD": f"127.0.0.1:{port}",
+        "DCN_NPROC": "2",
+        # Workers import the repo package from the checkout. Any axon
+        # sitecustomize dir is dropped: it pre-imports jax and initializes
+        # the backend before jax.distributed gets a chance.
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(__file__))]
+            + [
+                p
+                for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p
+            ]
+        ),
+    }
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, DCN_PID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("DCN worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        lines = [l for l in out.splitlines() if l.startswith("DCN_RESULT ")]
+        assert lines, f"no result line:\n{out}\n{err}"
+        outs.append(np.asarray(json.loads(lines[-1][len("DCN_RESULT "):])))
+
+    # Both processes hold the full (replicated-at-gather) result.
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[0], _reference_placed())
