@@ -24,7 +24,7 @@ _BUILD = _SRC / "_build"
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
-_SOURCES = ("wavepack.cpp", "traceio.cpp")
+_SOURCES = ("wavepack.cpp", "traceio.cpp", "borg2019.cpp")
 
 
 def _build_lib() -> Optional[Path]:
@@ -85,6 +85,16 @@ def _lib() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.ksim_borg2019_count.restype = ctypes.c_int64
+            lib.ksim_borg2019_count.argtypes = [ctypes.c_char_p]
+            lib.ksim_borg2019_parse.restype = ctypes.c_int64
+            lib.ksim_borg2019_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ]
             _LIB = lib
     return _LIB
@@ -154,6 +164,42 @@ def read_trace_csv(path: str | os.PathLike) -> Optional[dict]:
     )
     if got < 0:
         raise ValueError(f"malformed trace file: {path}")
+    return {k: v[:got] for k, v in cols.items()}
+
+
+def read_borg2019_events(path: str | os.PathLike) -> Optional[dict]:
+    """Borg-2019 schema CSV (instance_events / collection_events) → raw
+    per-event columnar arrays (time_us, etype, cid, iidx, prio, alloc,
+    cpu, mem), or None when the native lib is unavailable OR the file
+    needs the tolerant csv.DictReader fallback (quoted fields, missing
+    required columns). Sentinels: prio/alloc −1 = field absent."""
+    lib = _lib()
+    if lib is None:
+        return None
+    p = str(path).encode()
+    n = lib.ksim_borg2019_count(p)
+    if n < 0:
+        raise FileNotFoundError(path)
+    cols = {
+        "time_us": np.empty(n, np.float64),
+        "etype": np.empty(n, np.int32),
+        "cid": np.empty(n, np.int64),
+        "iidx": np.empty(n, np.int64),
+        "prio": np.empty(n, np.int32),
+        "alloc": np.empty(n, np.int64),
+        "cpu": np.empty(n, np.float32),
+        "mem": np.empty(n, np.float32),
+    }
+    got = lib.ksim_borg2019_parse(
+        p, n,
+        cols["time_us"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _i32p(cols["etype"]), _i64p(cols["cid"]), _i64p(cols["iidx"]),
+        _i32p(cols["prio"]), _i64p(cols["alloc"]),
+        cols["cpu"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        cols["mem"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if got < 0:
+        return None  # unsupported shape → csv.DictReader fallback
     return {k: v[:got] for k, v in cols.items()}
 
 

@@ -1,0 +1,239 @@
+// Native Borg-2019 instance/collection event ingest (SURVEY.md §2 L5
+// trace-driver row: "Python ETL → columnar"). Parses the Google
+// cluster-usage v3 CSV exports into raw columnar buffers in one pass —
+// the per-row csv.DictReader path in sim/borg_etl.py costs minutes at the
+// billions-of-rows scale the real table ships at; aggregation stays in
+// vectorized numpy on the Python side.
+//
+// Header-driven column mapping (BigQuery export names + flattened
+// variants); event types accept the integer enum or the upper-case name.
+// Quoted fields are NOT handled — the parser returns -1 on the first '"'
+// and the caller falls back to csv.DictReader.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct FileBuf {
+  char* data = nullptr;
+  int64_t size = 0;
+  ~FileBuf() { std::free(data); }
+};
+
+bool slurp(const char* path, FileBuf* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (sz < 0) {
+    std::fclose(f);
+    return false;
+  }
+  out->data = static_cast<char*>(std::malloc(static_cast<size_t>(sz) + 1));
+  if (!out->data) {
+    std::fclose(f);
+    return false;
+  }
+  size_t rd = std::fread(out->data, 1, static_cast<size_t>(sz), f);
+  std::fclose(f);
+  out->data[rd] = '\0';
+  out->size = static_cast<int64_t>(rd);
+  return true;
+}
+
+// Column roles filled from the header line.
+enum Col {
+  TIME = 0, TYPE, CID, IIDX, PRIO, ALLOC, CPU, MEM, NCOLS
+};
+
+bool header_name(const char* s, int len, int* role) {
+  struct Alias { const char* n; int role; };
+  static const Alias kAliases[] = {
+      {"time", TIME},
+      {"type", TYPE},
+      {"collection_id", CID},
+      {"instance_index", IIDX},
+      {"priority", PRIO},
+      {"alloc_collection_id", ALLOC},
+      {"resource_request.cpus", CPU},
+      {"cpus", CPU},
+      {"cpu", CPU},
+      {"resource_request.memory", MEM},
+      {"memory", MEM},
+      {"mem", MEM},
+  };
+  for (const auto& a : kAliases) {
+    if (static_cast<int>(std::strlen(a.n)) == len &&
+        std::strncmp(s, a.n, len) == 0) {
+      *role = a.role;
+      return true;
+    }
+  }
+  return false;
+}
+
+// Event-type names → the v3 enum (mirrors borg_etl._TYPE_NAMES,
+// case-insensitive like its v.upper()).
+int type_name(const char* s, int len) {
+  struct Name { const char* n; int v; };
+  static const Name kNames[] = {
+      {"SUBMIT", 0}, {"QUEUE", 1}, {"ENABLE", 2}, {"SCHEDULE", 3},
+      {"EVICT", 4},  {"FAIL", 5},  {"FINISH", 6}, {"KILL", 7},
+      {"LOST", 8},   {"UPDATE_PENDING", 9}, {"UPDATE_RUNNING", 10},
+  };
+  for (const auto& nm : kNames) {
+    if (static_cast<int>(std::strlen(nm.n)) != len) continue;
+    bool eq = true;
+    for (int i = 0; i < len && eq; ++i) {
+      eq = std::toupper(static_cast<unsigned char>(s[i])) == nm.n[i];
+    }
+    if (eq) return nm.v;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of data rows after the header, or -1 on IO error.
+int64_t ksim_borg2019_count(const char* path) {
+  FileBuf buf;
+  if (!slurp(path, &buf)) return -1;
+  int64_t lines = 0;
+  bool seen_header = false;
+  char* p = buf.data;
+  char* end = buf.data + buf.size;
+  while (p < end) {
+    char* nl = static_cast<char*>(std::memchr(p, '\n', end - p));
+    bool blank = (*p == '\n' || *p == '\r' || *p == '\0' || *p == '#');
+    if (!blank) {
+      if (!seen_header) {
+        seen_header = true;  // first non-blank line is the header
+      } else {
+        ++lines;
+      }
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return lines;
+}
+
+// Parse into raw columnar buffers (each sized [max_rows]).
+// Sentinels: prio = -1 (missing), alloc = -1 (missing), cpu/mem = 0
+// (missing, matching the Python default), iidx = 0 when the file has no
+// instance_index column (collection_events).
+// Returns rows parsed; -1 on IO error, quoted fields, or a missing
+// required column (time/type/collection_id) — callers fall back to the
+// csv.DictReader path.
+int64_t ksim_borg2019_parse(const char* path, int64_t max_rows,
+                            double* time_us, int32_t* etype, int64_t* cid,
+                            int64_t* iidx, int32_t* prio, int64_t* alloc,
+                            float* cpu, float* mem) {
+  FileBuf buf;
+  if (!slurp(path, &buf)) return -1;
+  char* p = buf.data;
+  char* end = buf.data + buf.size;
+
+  // --- header ---------------------------------------------------------
+  while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  char* hl_end = static_cast<char*>(std::memchr(p, '\n', end - p));
+  if (!hl_end) hl_end = end;
+  int col_role[256];
+  int ncols = 0;
+  {
+    char* q = p;
+    while (q <= hl_end && ncols < 256) {
+      char* c = q;
+      while (c < hl_end && *c != ',') ++c;
+      int len = static_cast<int>(c - q);
+      while (len > 0 && (q[len - 1] == '\r' || q[len - 1] == ' ')) --len;
+      int role = -1;
+      header_name(q, len, &role);
+      col_role[ncols++] = role;
+      if (c >= hl_end) break;
+      q = c + 1;
+    }
+  }
+  bool have[NCOLS] = {false};
+  for (int i = 0; i < ncols; ++i)
+    if (col_role[i] >= 0) have[col_role[i]] = true;
+  if (!have[TIME] || !have[TYPE] || !have[CID]) return -1;
+  p = hl_end < end ? hl_end + 1 : end;
+
+  // --- data rows ------------------------------------------------------
+  int64_t row = 0;
+  while (p < end && row < max_rows) {
+    char* nl = static_cast<char*>(std::memchr(p, '\n', end - p));
+    char* le = nl ? nl : end;
+    if (!(*p == '\n' || *p == '\r' || *p == '\0' || *p == '#') && p < le) {
+      // defaults / sentinels
+      time_us[row] = 0.0;
+      etype[row] = -1;
+      cid[row] = 0;
+      iidx[row] = 0;
+      prio[row] = -1;
+      alloc[row] = -1;
+      cpu[row] = 0.0f;
+      mem[row] = 0.0f;
+      char* q = p;
+      for (int col = 0; col < ncols && q <= le; ++col) {
+        char* c = q;
+        while (c < le && *c != ',') ++c;
+        int len = static_cast<int>(c - q);
+        while (len > 0 && (q[len - 1] == '\r' || q[len - 1] == ' ')) --len;
+        int role = col_role[col];
+        if (len > 0 && role >= 0) {
+          if (std::memchr(q, '"', len)) return -1;  // quoted: fall back
+          char* next = nullptr;
+          switch (role) {
+            case TIME:
+              time_us[row] = std::strtod(q, &next);
+              break;
+            case TYPE: {
+              if (std::isdigit(static_cast<unsigned char>(*q)) ||
+                  *q == '-' || *q == '+') {
+                etype[row] = static_cast<int32_t>(std::strtod(q, nullptr));
+              } else {
+                etype[row] = type_name(q, len);
+              }
+              break;
+            }
+            case CID:
+              cid[row] = static_cast<int64_t>(std::strtod(q, &next));
+              break;
+            case IIDX:
+              iidx[row] = static_cast<int64_t>(std::strtod(q, &next));
+              break;
+            case PRIO:
+              prio[row] = static_cast<int32_t>(std::strtod(q, &next));
+              break;
+            case ALLOC:
+              alloc[row] = static_cast<int64_t>(std::strtod(q, &next));
+              break;
+            case CPU:
+              cpu[row] = std::strtof(q, &next);
+              break;
+            case MEM:
+              mem[row] = std::strtof(q, &next);
+              break;
+          }
+        }
+        if (c >= le) break;
+        q = c + 1;
+      }
+      ++row;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return row;
+}
+
+}  // extern "C"

@@ -133,3 +133,86 @@ def test_rescheduled_instance_duration_uses_last_submit(tmp_path):
     cols = Borg2019Etl(str(inst)).read_cols()
     assert cols["arrival"][0] == 0.0
     assert np.isclose(cols["duration"][0], 100.0)
+
+
+def test_native_ingest_matches_dictreader(tmp_path):
+    """The native parser + vectorized aggregation must produce exactly the
+    DictReader path's columns — including duplicate SUBMITs (first wins),
+    EVICT→re-SUBMIT cycles (duration from the last submit), re-SUBMIT
+    after FINISH (still running → inf), and job-level fallbacks."""
+    from kubernetes_simulator_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    inst, coll = _write_trace(tmp_path)
+    # Append the tricky event patterns.
+    with open(inst, "a") as f:
+        # duplicate SUBMIT for (100, 1) later — first must win
+        f.write(f"{900 * _US},0,100,1,400,0,0.9,0.9\n")
+        # EVICT → re-SUBMIT → FINISH for (101, 2)
+        f.write(f"{800 * _US},EVICT,101,2,,,,\n")
+        f.write(f"{820 * _US},SUBMIT,101,2,100,0,0.05,0.01\n")
+        f.write(f"{880 * _US},FINISH,101,2,,,,\n")
+        # re-SUBMIT after FINISH for (102, 3): still running → inf;
+        # mixed-case type names must parse like _etype's v.upper()
+        f.write(f"{730 * _US},Kill,102,3,,,,\n")
+        f.write(f"{760 * _US},submit,102,3,,,0.05,0.01\n")
+        # task with NO priority/alloc fields → collection_events fallback
+        f.write(f"{910 * _US},SUBMIT,104,9,,,0.2,0.1\n")
+    etl = Borg2019Etl(inst, coll)
+    fast = etl._cols_from_raw(
+        native.read_borg2019_events(inst),
+        native.read_borg2019_events(coll),
+    )
+    slow = etl._cols_dictreader()
+    assert set(fast) == set(slow)
+    for k in slow:
+        np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
+    # And read_cols() takes the native path on this file.
+    auto = etl.read_cols()
+    for k in slow:
+        np.testing.assert_array_equal(auto[k], slow[k], err_msg=k)
+
+
+@pytest.mark.slow
+def test_million_row_ingest_throughput(tmp_path):
+    """VERDICT r2 #6 acceptance: a synthetic 1M-row real-schema file
+    ingests in single-digit seconds (the DictReader path costs minutes at
+    this size; the real table is billions of rows)."""
+    import time
+
+    from kubernetes_simulator_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    inst = tmp_path / "instance_events_1m.csv"
+    R = 1_000_000
+    rng = np.random.default_rng(0)
+    t = (600 + rng.integers(0, 86_400, R)) * _US
+    cid = 100 + rng.integers(0, 50_000, R)
+    iidx = rng.integers(0, 200, R)
+    prio = rng.choice([0, 100, 200, 360, 450], R)
+    alloc = np.where(rng.random(R) < 0.3, 9000 + (cid % 1000), 0)
+    cpu = rng.random(R).astype(np.float32) * 0.1
+    # Chunked formatting: one big join per 100k rows.
+    with open(inst, "w") as f:
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        for c0 in range(0, R, 100_000):
+            c1 = min(c0 + 100_000, R)
+            rows = [
+                f"{t[i]},0,{cid[i]},{iidx[i]},{prio[i]},{alloc[i]},"
+                f"{cpu[i]:.4f},0.01"
+                for i in range(c0, c1)
+            ]
+            f.write("\n".join(rows) + "\n")
+
+    etl = Borg2019Etl(str(inst))
+    t0 = time.perf_counter()
+    cols = etl.read_cols()
+    wall = time.perf_counter() - t0
+    assert len(cols["arrival"]) > 900_000  # (cid, iidx) mostly unique
+    assert wall < 10.0, f"1M-row ingest took {wall:.1f}s (target <10s)"

@@ -78,17 +78,27 @@ def test_two_process_dcn_matches_single_process():
             )
         )
     outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                pytest.fail("DCN worker timed out")
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            lines = [
+                l for l in out.splitlines() if l.startswith("DCN_RESULT ")
+            ]
+            assert lines, f"no result line:\n{out}\n{err}"
+            outs.append(
+                np.asarray(json.loads(lines[-1][len("DCN_RESULT "):]))
+            )
+    finally:
+        # A failed worker must not leave its sibling blocked in
+        # jax.distributed.initialize (~300 s timeout) as an orphan.
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            pytest.fail("DCN worker timed out")
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-        lines = [l for l in out.splitlines() if l.startswith("DCN_RESULT ")]
-        assert lines, f"no result line:\n{out}\n{err}"
-        outs.append(np.asarray(json.loads(lines[-1][len("DCN_RESULT "):])))
+                q.wait()
 
     # Both processes hold the full (replicated-at-gather) result.
     np.testing.assert_array_equal(outs[0], outs[1])
