@@ -110,9 +110,16 @@ def greedy_replay(
     preemptions = 0
     rel_time = ep.arrival + np.where(np.isfinite(ep.duration), ep.duration, np.inf)
     released = np.zeros(ep.num_pods, bool)
+    # Chunk index each pod was bound in (pre-bound = -2). Boundary b
+    # releases only pods bound in chunks <= b-2 — the ONE-CHUNK SLACK that
+    # lets the device engines overlap host release computation with the
+    # in-flight chunk (round 3; matched here so the anchor stays exact).
+    bind_chunk = np.full(ep.num_pods, 1 << 30, np.int64)
+    bind_chunk[ep.bound_node >= 0] = -2
     t0 = time.perf_counter()
     for wi, wave in enumerate(waves.idx):
         if completions_chunk_waves and wi % completions_chunk_waves == 0:
+            b = wi // completions_chunk_waves
             first = int(wave[0]) if wave.shape[0] else -1
             t_chunk = float(ep.arrival[first]) if first >= 0 else np.inf
             if np.isfinite(t_chunk):
@@ -121,6 +128,7 @@ def greedy_replay(
                     & ~released
                     & np.isfinite(rel_time)
                     & (rel_time <= t_chunk)
+                    & (bind_chunk < b - 1)
                 )[0]
                 for p in due:
                     unbind(ec, ep, st, int(p))  # assignments keep the node
@@ -172,6 +180,8 @@ def greedy_replay(
             elif c != PAD:
                 assignments[p] = c
                 placed_total += 1
+                if completions_chunk_waves:
+                    bind_chunk[p] = wi // completions_chunk_waves
     wall = time.perf_counter() - t0
     to_schedule = int((ep.bound_node == PAD).sum())
     util = {}

@@ -322,20 +322,23 @@ def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray
 
 def rebuild_fork_state(pods: EncodedPods, idx: np.ndarray, C: int, outs,
                        wave_times: np.ndarray, upto_chunk: int,
-                       reconstruct_released: bool = True):
+                       reconstruct_released: bool = True,
+                       slack: int = 1):
     """Replay saved per-chunk choices for chunks 0..upto_chunk-1 and apply
     the completions an uninterrupted completions-on run would have released
     at each boundary. Returns (host_assign [P], released [P]).
 
-    A release is due at boundary b when the pod was placed in a chunk < b
-    (pre-bound pods count as chunk −1) and its arrival+duration is at or
-    before the boundary's start time. Shared by JaxReplayEngine.replay
+    A release is due at boundary b when the pod was placed in a chunk
+    ≤ b−2 (pre-bound pods count as chunk −2, eligible at every boundary)
+    and its arrival+duration is at or before the boundary's start time —
+    the one-chunk slack that lets the live engines overlap host release
+    computation with the in-flight chunk. Shared by JaxReplayEngine.replay
     resume and the what-if fork path (which previously started released
     all-False and re-subtracted every pre-fork release — advisor round-2)."""
     host_assign = np.where(pods.bound_node >= 0, pods.bound_node, PAD).astype(
         np.int32
     )
-    chunk_of = np.where(pods.bound_node >= 0, -1, 1 << 30).astype(np.int64)
+    chunk_of = np.where(pods.bound_node >= 0, -2, 1 << 30).astype(np.int64)
     rel_time = pods.arrival + np.where(
         np.isfinite(pods.duration), pods.duration, np.inf
     )
@@ -353,7 +356,7 @@ def rebuild_fork_state(pods: EncodedPods, idx: np.ndarray, C: int, outs,
             if np.isfinite(tb):
                 released |= (
                     (host_assign != PAD)
-                    & (chunk_of < b)
+                    & (chunk_of < b - slack)
                     & np.isfinite(rel_time)
                     & (rel_time <= tb)
                 )
@@ -620,6 +623,7 @@ class JaxReplayEngine:
             if (pending_events or completions_on)
             else None
         )
+        pending_fold = None  # (rows, choices) of the not-yet-folded chunk
         if completions_on:
             host_assign = np.where(
                 self.pods.bound_node >= 0, self.pods.bound_node, PAD
@@ -628,14 +632,29 @@ class JaxReplayEngine:
             if start_chunk:
                 # Resume: the saved state already carries pre-resume
                 # releases — seed from the persisted mask (or reconstruct
-                # from the saved outs for pre-field checkpoints).
+                # from the saved outs for pre-field checkpoints). The
+                # one-chunk slack is restored by folding only chunks
+                # ≤ start_chunk−2 and re-pending the last saved chunk.
                 have_mask = getattr(ck, "released", None) is not None
-                host_assign, released = rebuild_fork_state(
-                    self.pods, idx, C, all_choices, wave_times, start_chunk,
-                    reconstruct_released=not have_mask,
+                host_assign, _ = rebuild_fork_state(
+                    self.pods, idx, C, all_choices, wave_times,
+                    max(start_chunk - 1, 0), reconstruct_released=False,
                 )
                 if have_mask:
                     released = ck.released.astype(bool)
+                else:
+                    # released=None ⟹ a checkpoint from before the field
+                    # existed ⟹ its state was built under the OLD
+                    # (no-slack) release rule — reconstruct with slack=0.
+                    _, released = rebuild_fork_state(
+                        self.pods, idx, C, all_choices, wave_times,
+                        start_chunk, slack=0,
+                    )
+                if start_chunk >= 1:
+                    pending_fold = (
+                        idx[(start_chunk - 1) * C : start_chunk * C],
+                        np.asarray(all_choices[start_chunk - 1]),
+                    )
         saved_alloc = np.asarray(self.dc.allocatable).copy()
         # Pre-stage the per-chunk wave indices on device (a few MB total):
         # the timed loop then issues ONE call per chunk with no H2D.
@@ -682,10 +701,16 @@ class JaxReplayEngine:
                 )
             all_choices.append(choices)
             if completions_on:
-                rows = idx[c0 : c0 + C]
-                ch = np.asarray(choices).reshape(rows.shape)
-                v = rows >= 0
-                host_assign[rows[v]] = ch[v]
+                # Fold the PREVIOUS chunk's choices AFTER dispatching this
+                # one: the blocking fetch overlaps the in-flight chunk, and
+                # boundary b only ever sees chunks ≤ b−2 (the one-chunk
+                # slack; the greedy anchor implements the same rule).
+                if pending_fold is not None:
+                    rows_p, ch_p = pending_fold
+                    ch = np.asarray(ch_p).reshape(rows_p.shape)
+                    v = rows_p >= 0
+                    host_assign[rows_p[v]] = ch[v]
+                pending_fold = (idx[c0 : c0 + C], choices)
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
                 self._save_checkpoint(
                     state, ci + 1, all_choices, checkpoint_path,

@@ -367,6 +367,29 @@ class ScenarioSet:
         return dyn
 
 
+class RelSource:
+    """Static per-pod tables for DEVICE-side completions (scenario-shared,
+    uploaded once per run): the first boundary index each pod is release-
+    ELIGIBLE at (precomputed on host in f64 — the device compares i32
+    only, so eligibility matches the f64 host/anchor paths exactly),
+    the binding chunk (pre-bound = −2), and the pod's matched
+    count-groups (PAD-padded)."""
+
+    def __init__(self, elig_b, chunk_of, matched_g):
+        self.elig_b = elig_b
+        self.chunk_of = chunk_of
+        self.matched_g = matched_g
+
+
+import jax.tree_util as _jtu
+
+_jtu.register_pytree_node(
+    RelSource,
+    lambda r: ((r.elig_b, r.chunk_of, r.matched_g), None),
+    lambda _, c: RelSource(*c),
+)
+
+
 class ScenarioDyn:
     """Per-scenario domain tables for v3 labels_dirty batches (append-style
     ids; see ScenarioSet). All arrays lead with the scenario axis and are
@@ -423,7 +446,7 @@ class WhatIfEngine:
         collect_assignments: bool = False,
         fork_checkpoint: Optional[str] = None,
         preemption: bool = False,
-        completions: bool = False,
+        completions: bool = True,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -432,11 +455,13 @@ class WhatIfEngine:
 
         ``completions``: chunk-granular pod completions per scenario (the
         JaxReplayEngine mechanism, applied to each scenario's own
-        placements). OPT-IN for the batched path: the host-side release
-        deltas break chunk pipelining (measured 4.5× on the 100k×128
-        Borg slice), so the default matches the reference's what-if
-        semantics (scenario evaluation over arrivals only). Requires the
-        v3 engine, no preemption, finite durations."""
+        placements). Default ON since round 3: release folding runs one
+        chunk behind the device pipeline (boundary b sees chunks ≤ b−2 —
+        the one-chunk slack, shared with the greedy anchor), so the
+        host-side deltas overlap the in-flight chunk instead of stalling
+        it. Requires the v3 engine, no preemption, no label-perturbation
+        DynTables, finite durations — else it silently reverts to the
+        arrivals-only semantics."""
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -462,6 +487,10 @@ class WhatIfEngine:
         self.engine = "v3"
         self._dyn = None
         if self.sset.labels_dirty:
+            # NOTE on completions: NO engine supports them together with
+            # label-perturbation batches (the release deltas would need
+            # per-scenario domain tables), so they are silently off either
+            # way — prefer the ~4× faster DynTables v3 over v2.
             dyn = self.sset.dyn
             if (
                 dyn is not None
@@ -470,7 +499,6 @@ class WhatIfEngine:
                 and not preemption
                 and fork_checkpoint is None
                 and not bool((pods.bound_node >= 0).any())
-                and not completions
             ):
                 self._dyn = dyn
             else:
@@ -543,9 +571,30 @@ class WhatIfEngine:
             and not preemption
             and np.isfinite(rel).any()
         )
-        # Completions need per-scenario choices even when the caller only
-        # wants counts.
-        self._need_choices = collect_assignments or self.completions_on
+        # DEVICE-side releases (round 3): on the perf path the release
+        # bookkeeping lives on device — per-scenario assignment + released
+        # planes carried across chunks, boundary deltas as masked
+        # scatter-adds — because ANY per-chunk choice fetch stalls the
+        # pipeline (and through a tunneled device, dominates it). Gated to
+        # the shapes it covers exactly; everything else keeps the host
+        # pending-fold path.
+        self._completions_dev = bool(
+            self.completions_on
+            and self.mesh is None
+            and not collect_assignments
+            and self.engine == "v3"
+            and self._dyn is None
+            and not fork_checkpoint
+            and self.static3.single_topo
+            and not self.static3.has_host_rows
+            and not self.static3.maintain_anti
+            and not self.static3.maintain_pref
+        )
+        # Host-side completions need per-scenario choices even when the
+        # caller only wants counts; the device path never fetches them.
+        self._need_choices = collect_assignments or (
+            self.completions_on and not self._completions_dev
+        )
         self._chunk_fn = self._build_chunk_fn()
         # Device-resident slot sources (one upload per engine): the chunk
         # loop then gathers rows on device — see ops.tpu.SlotSource.
@@ -569,6 +618,8 @@ class WhatIfEngine:
 
             pre_on = self.preemption
             dyn_on = self._dyn_dev is not None
+            narrow = self.ec.num_nodes < 2**15 - 1
+            dev_rel = self._completions_dev
             dyn_flip = bool(
                 self._dyn is not None
                 and getattr(self._dyn, "has_presence_change", True)
@@ -597,6 +648,14 @@ class WhatIfEngine:
                         return st, out
                     choices = out
                     placed_w = jnp.sum((choices >= 0) & batch[0].valid).astype(jnp.int32)
+                    if dev_rel:
+                        # Device-release path: choices stay ON DEVICE for
+                        # the assignment fold; counts ride along.
+                        return st, (choices, placed_w)
+                    if collect and narrow:
+                        # Completions fetch choices back every chunk; with
+                        # N < 2^15 an int16 stream halves the D2H volume.
+                        choices = choices.astype(jnp.int16)
                     return st, (choices if collect else placed_w)
 
                 state, outs = jax.lax.scan(step, state, (slots, extra))
@@ -613,6 +672,80 @@ class WhatIfEngine:
                     extra = V3m.gather_extra_device(xsrc, idx)
                     return per_scenario(dc, state, slots, extra, dyn)
 
+                if self._completions_dev:
+                    st3_l, sh3_l = st3, sh3
+                    Dcap = st3.Dcap
+
+                    def per_scenario_rel(
+                        dc, state, src, xsrc, rel, idx, assign, released, b,
+                    ):
+                        # --- boundary releases, entirely on device ------
+                        due = (
+                            (assign >= 0)
+                            & ~released
+                            & (rel.elig_b <= b)
+                            & (rel.chunk_of < b - 1)  # one-chunk slack
+                        )
+                        N = state.used.shape[1]
+                        # Masked-out entries use a PAST-THE-END index: with
+                        # mode="drop" only genuinely out-of-bounds indices
+                        # are dropped — negative ones WRAP first (NumPy
+                        # semantics) and would corrupt the last element.
+                        amask = jnp.where(due, assign, N)
+                        R = state.used.shape[0]
+                        used = jnp.stack([
+                            state.used[r].at[amask].add(
+                                -jnp.where(due, src.requests[:, r], 0.0),
+                                mode="drop",
+                            )
+                            for r in range(R)
+                        ])
+                        dom = sh3_l.topo1_f[jnp.clip(assign, 0)].astype(
+                            jnp.int32
+                        )
+                        ok = due & (dom >= 0)
+                        mc_flat = state.mc_dom.reshape(-1)
+                        G = state.match_total.shape[0]
+                        mt = state.match_total
+                        for m in range(rel.matched_g.shape[1]):
+                            g = rel.matched_g[:, m]
+                            # has_dom_g: a matched group WITHOUT a topology
+                            # never held a count (the host release_delta's
+                            # dom[g] >= 0 guard).
+                            valid = ok & (g >= 0) & (
+                                sh3_l.has_dom_g[jnp.clip(g, 0)] > 0.5
+                            )
+                            mc_flat = mc_flat.at[
+                                jnp.where(valid, g * Dcap + dom, G * Dcap)
+                            ].add(-1.0, mode="drop")
+                            mt = mt.at[jnp.where(valid, g, G)].add(
+                                -1.0, mode="drop"
+                            )
+                        state = state._replace(
+                            used=used,
+                            mc_dom=mc_flat.reshape(state.mc_dom.shape),
+                            match_total=mt,
+                        )
+                        released = released | due
+                        # --- the normal chunk scan ----------------------
+                        state, out = per_scenario_src(
+                            dc, state, src, xsrc, idx
+                        )
+                        # --- fold this chunk's placements on device -----
+                        choices, counts = out
+                        flat_i = idx.reshape(-1)
+                        flat_c = choices.reshape(-1)
+                        Pn = assign.shape[0]
+                        assign = assign.at[
+                            jnp.where(flat_i >= 0, flat_i, Pn)
+                        ].set(flat_c, mode="drop")
+                        return state, assign, released, counts
+
+                    vmapped_rel = jax.vmap(
+                        per_scenario_rel,
+                        in_axes=(0, 0, None, None, None, None, 0, 0, None),
+                    )
+                    return jax.jit(vmapped_rel, donate_argnums=(1, 6, 7))
                 # vmap matches in_axes against the args actually passed,
                 # so the defaulted dyn arg needs no wrapper.
                 vmapped_src = jax.vmap(
@@ -877,6 +1010,19 @@ class WhatIfEngine:
             delta = shard_scenario_tree(self.mesh, delta)
         return jax.tree.map(jnp.subtract, states, delta)
 
+    def _fold(self, host_assign, rows, choices) -> None:
+        """Apply a chunk's choices to the per-scenario assignment table.
+        ``choices``: device [S, C, W] from the scan, or host [C, W] shared
+        pre-fork placements."""
+        ch = np.asarray(choices) if isinstance(choices, np.ndarray) else (
+            self._fetch(choices)
+        )
+        v = rows >= 0
+        if ch.ndim == 2:
+            host_assign[:, rows[v]] = ch[v][None, :]
+        else:
+            host_assign[:, rows[v]] = ch.reshape((self.S,) + rows.shape)[:, v]
+
     def _fetch(self, x) -> np.ndarray:
         """Device→host for a result tensor. On a multi-process (DCN) mesh
         the array is replicated first — the end-of-replay all_gather that
@@ -907,7 +1053,57 @@ class WhatIfEngine:
         if self.mesh is not None:
             dc = shard_scenario_tree(self.mesh, dc)
             states = shard_scenario_tree(self.mesh, states)
-        comp_on = self.completions_on
+        comp_on = self.completions_on and not self._completions_dev
+        dev_rel = self._completions_dev
+        if dev_rel:
+            from ..ops import tpu3 as V3
+
+            P = self.pods.num_pods
+            nchunks = idx.shape[0] // C
+            chunk_of = np.full(P, 1 << 30, np.int32)
+            for cj in range(nchunks):
+                rows = idx[cj * C : (cj + 1) * C]
+                chunk_of[rows[rows >= 0]] = cj
+            chunk_of[self.pods.bound_node >= 0] = -2
+            matched = V3._matched_idx(
+                self.pods.pod_matches_group,
+                np.ones(self.pods.pod_matches_group.shape[1], bool),
+            )
+            if matched.shape[1] == 0:
+                matched = np.full((P, 1), PAD, np.int32)
+            first = idx[:, 0]
+            wave_t = np.where(
+                first >= 0, self.pods.arrival[np.clip(first, 0, None)], np.inf
+            )
+            # First boundary each pod is eligible at, in f64 on host — the
+            # non-finite boundary tail (PAD-only waves) never releases.
+            tb_all = wave_t[0 :: C][:nchunks]
+            nfin = int(np.isfinite(tb_all).sum())
+            elig = np.searchsorted(
+                tb_all[:nfin], self._rel_time, side="left"
+            ).astype(np.int32)
+            elig = np.where(
+                np.isfinite(self._rel_time) & (elig < nfin), elig, 1 << 30
+            ).astype(np.int32)
+            rel_src = RelSource(
+                elig_b=jnp.asarray(elig),
+                chunk_of=jnp.asarray(chunk_of),
+                matched_g=jnp.asarray(matched.astype(np.int32)),
+            )
+            b_list = [
+                jnp.asarray(np.int32(ci)) for ci in range(nchunks)
+            ]
+            assign_d = jax.jit(
+                lambda a: jnp.broadcast_to(a[None], (self.S,) + a.shape)
+            )(
+                jnp.asarray(
+                    np.where(
+                        self.pods.bound_node >= 0, self.pods.bound_node, PAD
+                    ).astype(np.int32)
+                )
+            )
+            released_d = jnp.zeros((self.S, self.pods.num_pods), bool)
+        pending_fold = None  # (rows, choices) of the not-yet-folded chunk
         if comp_on:
             first = idx[:, 0]
             wave_t = np.where(
@@ -920,10 +1116,30 @@ class WhatIfEngine:
                 (self.S, 1),
             )
             if self._fork_choices is not None:
-                pidx = self.waves.idx[: self._fork_waves_done].reshape(-1)
-                pch = self._fork_choices.reshape(-1)
+                # Fold pre-fork placements except the SOURCE's last chunk,
+                # which stays pending — restoring the one-chunk slack the
+                # uninterrupted source run would be carrying here.
+                C_src = (
+                    self._fork_ck.outs[0].shape[0]
+                    if self._fork_ck.outs
+                    else 0
+                )
+                cut = (
+                    min((self._fork_ck.chunk_cursor - 1) * C_src,
+                        self._fork_waves_done)
+                    if C_src
+                    else self._fork_waves_done
+                )
+                cut = max(cut, 0)
+                pidx = self.waves.idx[:cut].reshape(-1)
+                pch = self._fork_choices[:cut].reshape(-1)
                 pv = pidx >= 0
                 host_assign[:, pidx[pv]] = pch[pv][None, :]
+                if cut < self._fork_waves_done:
+                    pending_fold = (
+                        self.waves.idx[cut : self._fork_waves_done],
+                        self._fork_choices[cut : self._fork_waves_done],
+                    )
             released = np.zeros((self.S, self.pods.num_pods), bool)
             if self.fork_checkpoint and self._fork_waves_done:
                 # The forked state already carries the source replay's
@@ -948,6 +1164,8 @@ class WhatIfEngine:
                     if C_src:
                         # The source padded ITS wave list to a multiple of
                         # C_src — mirror that so chunk rows line up.
+                        # (slack=0: a maskless checkpoint predates the
+                        # slack rule — see JaxReplayEngine.replay.)
                         idx_src = self.waves.idx
                         need = ck.chunk_cursor * C_src
                         if idx_src.shape[0] < need:
@@ -964,7 +1182,7 @@ class WhatIfEngine:
                             ])
                         _, rel0 = rebuild_fork_state(
                             self.pods, idx_src, C_src, ck.outs,
-                            full_t, ck.chunk_cursor,
+                            full_t, ck.chunk_cursor, slack=0,
                         )
                     else:
                         rel0 = np.zeros(self.pods.num_pods, bool)
@@ -988,7 +1206,12 @@ class WhatIfEngine:
                     states = self._apply_releases(
                         states, host_assign, released, t_chunk
                     )
-            if self.mesh is None and self.engine == "v3" and srcs is not None:
+            if dev_rel:
+                states, assign_d, released_d, out = self._chunk_fn(
+                    dc, states, srcs[0], srcs[1], rel_src, idx_chunks[ci],
+                    assign_d, released_d, b_list[ci],
+                )
+            elif self.mesh is None and self.engine == "v3" and srcs is not None:
                 # Fused device-side gather + wave scan: one dispatch per
                 # chunk, indices pre-staged (ops.tpu.SlotSource).
                 args = (dc, states, srcs[0], srcs[1], idx_chunks[ci])
@@ -1013,10 +1236,15 @@ class WhatIfEngine:
                     states, out = self._chunk_fn(dc, states, slots)
             outs.append(out)
             if comp_on:
-                rows = idx[c0 : c0 + C]
-                ch = self._fetch(out).reshape((self.S,) + rows.shape)
-                v = rows >= 0
-                host_assign[:, rows[v]] = ch[:, v]
+                # Fold the PREVIOUS chunk's choices AFTER dispatching this
+                # one: the blocking fetch overlaps the in-flight chunk and
+                # boundary b only ever sees chunks ≤ b−2 (one-chunk slack,
+                # shared with JaxReplayEngine and the greedy anchor).
+                if pending_fold is not None:
+                    self._fold(host_assign, *pending_fold)
+                if hasattr(out, "copy_to_host_async"):
+                    out.copy_to_host_async()  # overlap D2H with the chunk
+                pending_fold = (idx[c0 : c0 + C], out)
         jax.block_until_ready(states)
         wall = time.perf_counter() - t0
 

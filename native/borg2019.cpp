@@ -152,6 +152,7 @@ int64_t ksim_borg2019_parse(const char* path, int64_t max_rows,
     while (q <= hl_end && ncols < 256) {
       char* c = q;
       while (c < hl_end && *c != ',') ++c;
+      while (q < c && (*q == ' ' || *q == '\t')) ++q;  // left-trim
       int len = static_cast<int>(c - q);
       while (len > 0 && (q[len - 1] == '\r' || q[len - 1] == ' ')) --len;
       int role = -1;
@@ -186,11 +187,14 @@ int64_t ksim_borg2019_parse(const char* path, int64_t max_rows,
       for (int col = 0; col < ncols && q <= le; ++col) {
         char* c = q;
         while (c < le && *c != ',') ++c;
+        // Quoted fields (ANY column — commas/newlines inside would shift
+        // the naive split) defeat this parser: fall back to DictReader.
+        if (std::memchr(q, '"', c - q)) return -1;
+        while (q < c && (*q == ' ' || *q == '\t')) ++q;  // left-trim
         int len = static_cast<int>(c - q);
         while (len > 0 && (q[len - 1] == '\r' || q[len - 1] == ' ')) --len;
         int role = col_role[col];
         if (len > 0 && role >= 0) {
-          if (std::memchr(q, '"', len)) return -1;  // quoted: fall back
           char* next = nullptr;
           switch (role) {
             case TIME:

@@ -25,8 +25,13 @@ def main():
     print(f"trace gen: {time.perf_counter() - t0:.1f}s", flush=True)
 
     scenarios = uniform_scenarios(ec, S, seed=0)
+    # completions=False: the north-star protocol is the reference's
+    # what-if semantics (scenario evaluation over arrivals only) — the
+    # same workload every prior round measured. Completions-on cost is
+    # tracked separately (COVERAGE.md; target ≤1.3× of off).
     eng = WhatIfEngine(
-        ec, ep, scenarios, FrameworkConfig(), wave_width=wave, chunk_waves=chunk
+        ec, ep, scenarios, FrameworkConfig(), wave_width=wave,
+        chunk_waves=chunk, completions=os.environ.get("NS_COMPLETIONS") == "1",
     )
     print(f"engine: {eng.engine}", flush=True)
     if os.environ.get("NS_WARMUP", "1") not in ("", "0"):

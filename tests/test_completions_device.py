@@ -22,21 +22,24 @@ from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
 
 def test_completion_frees_capacity_changes_placement():
     # a holds the only cpu until t=5; b arrives at t=10 — it fits only if
-    # the release actually happened at the chunk boundary.
+    # the release actually happened. Releases run ONE CHUNK BEHIND
+    # placements (the round-3 pipelining slack: boundary b sees chunks
+    # ≤ b−2), so a zero-request filler chunk sits between them.
     cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
     pods = [
         Pod("a", requests={"cpu": 1}, arrival_time=0.0, duration=5.0),
+        Pod("f", requests={}, arrival_time=6.0),
         Pod("b", requests={"cpu": 1}, arrival_time=10.0),
     ]
     ec, ep = encode(cluster, pods)
     cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
     res = JaxReplayEngine(ec, ep, cfg, wave_width=1, chunk_waves=1).replay()
-    assert res.assignments[0] == 0 and res.assignments[1] == 0
-    assert res.placed == 2
+    assert res.assignments[0] == 0 and res.assignments[2] == 0
+    assert res.placed == 3
     off = JaxReplayEngine(
         ec, ep, cfg, wave_width=1, chunk_waves=1, completions=False
     ).replay()
-    assert off.assignments[1] == PAD  # without completions b never fits
+    assert off.assignments[2] == PAD  # without completions b never fits
     anchor = greedy_replay(ec, ep, cfg, wave_width=1, completions_chunk_waves=1)
     np.testing.assert_array_equal(res.assignments, anchor.assignments)
 
@@ -55,16 +58,17 @@ def test_completion_decrements_count_planes():
     pods = [
         Pod("a", labels={"app": "x"}, requests={"cpu": 1}, arrival_time=0.0,
             duration=3.0),
+        Pod("f", requests={}, arrival_time=5.0),  # slack chunk
         Pod("b", requests={"cpu": 1}, arrival_time=10.0, pod_anti_affinity=anti),
     ]
     ec, ep = encode(cluster, pods)
     cfg = FrameworkConfig()
     res = JaxReplayEngine(ec, ep, cfg, wave_width=1, chunk_waves=1).replay()
-    assert res.assignments[0] == 0 and res.assignments[1] == 0
+    assert res.assignments[0] == 0 and res.assignments[2] == 0
     off = JaxReplayEngine(
         ec, ep, cfg, wave_width=1, chunk_waves=1, completions=False
     ).replay()
-    assert off.assignments[1] == PAD
+    assert off.assignments[2] == PAD
     anchor = greedy_replay(ec, ep, cfg, wave_width=1, completions_chunk_waves=1)
     np.testing.assert_array_equal(res.assignments, anchor.assignments)
 
@@ -114,20 +118,22 @@ def test_gang_member_completions_release_individually():
             pod_group="gang"),
         Pod("g1", requests={"cpu": 1}, arrival_time=0.0, duration=8.0,
             pod_group="gang"),
+        Pod("f1", requests={}, arrival_time=12.0),  # slack chunk (W=2)
+        Pod("f2", requests={}, arrival_time=13.0),
         Pod("s", requests={"cpu": 2}, arrival_time=20.0),
     ]
     ec, ep = encode(cluster, pods)
     cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
     res = JaxReplayEngine(ec, ep, cfg, wave_width=2, chunk_waves=1).replay()
     assert res.assignments[0] == 0 and res.assignments[1] == 0
-    assert res.assignments[2] == 0  # both released by t=20
+    assert res.assignments[4] == 0  # both released by t=20
     anchor = greedy_replay(ec, ep, cfg, wave_width=2, completions_chunk_waves=1)
     np.testing.assert_array_equal(res.assignments, anchor.assignments)
 
 
 def test_completions_resume_with_prebound(tmp_path):
     # Pre-bound pods never appear in waves; the resume reconstruction must
-    # still know their releases were already applied (chunk −1), or it
+    # still know their releases were already applied (chunk −2), or it
     # subtracts them a second time and the planes go negative.
     cluster = Cluster(nodes=[Node("n0", {"cpu": 2}), Node("n1", {"cpu": 2})])
     pods = [
@@ -179,7 +185,39 @@ def test_whatif_completions_scenario0_matches_single_replay():
     res = eng.run()
     single = JaxReplayEngine(ec, ep, cfg, wave_width=4, chunk_waves=4).replay()
     np.testing.assert_array_equal(res.assignments[0], single.assignments)
-    # completions must change the outcome on this trace (non-vacuous)
+    # completions must change the outcome on this trace (non-vacuous);
+    # the default is ON since round 3, so force them off explicitly.
     off = WhatIfEngine(ec, ep, scen, cfg, wave_width=4, chunk_waves=4,
-                       collect_assignments=True).run()  # default: off
+                       collect_assignments=True, completions=False).run()
     assert (off.assignments[0] != res.assignments[0]).any()
+
+
+def test_whatif_device_release_path_matches_host_path():
+    """The device-side release path (no per-chunk D2H; round 3) must agree
+    with the host pending-fold path: same per-scenario placed counts and
+    utilization. Gate sanity: collect_assignments forces the host path."""
+    from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+    cluster = make_cluster(12, seed=3, taint_fraction=0.2)
+    pods, _ = make_workload(
+        120, seed=3, arrival_rate=12.0, duration_mean=2.0,
+        with_spread=True, with_tolerations=True,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = uniform_scenarios(ec, 4, seed=3)
+    dev = WhatIfEngine(ec, ep, scen, cfg, chunk_waves=4)
+    assert dev._completions_dev and not dev._need_choices
+    r1 = dev.run()
+    host = WhatIfEngine(ec, ep, scen, cfg, chunk_waves=4, collect_assignments=True)
+    assert not host._completions_dev and host.completions_on
+    r2 = host.run()
+    np.testing.assert_array_equal(r1.placed, r2.placed)
+    np.testing.assert_allclose(r1.utilization_cpu, r2.utilization_cpu, atol=1e-6)
+    # Non-vacuous: completions change this trace's outcome.
+    off = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, completions=False
+    ).run()
+    assert (off.placed != r1.placed).any() or (
+        np.abs(off.utilization_cpu - r1.utilization_cpu) > 1e-4
+    ).any()

@@ -116,12 +116,28 @@ def test_whatif_fork_with_completions_no_double_release(tmp_path):
     res = wi.run()
     np.testing.assert_array_equal(res.assignments[0], full.assignments)
 
-    # Pre-field checkpoints (released=None) reconstruct from the outs.
-    saved.released = None
-    saved.save(ck)
-    wi2 = WhatIfEngine(
-        ec, ep, [Scenario()], cfg, chunk_waves=C,
-        fork_checkpoint=ck, collect_assignments=True, completions=True,
+    # Pre-field checkpoints (released=None) reconstruct from the outs with
+    # the LEGACY no-slack rule (such checkpoints can only have been written
+    # by pre-slack code). A maskless checkpoint from a modern run is not a
+    # state that can exist, so only the reconstruction plumbing is checked:
+    # it must run and produce a released mask without crashing.
+    from kubernetes_simulator_tpu.sim.jax_runtime import rebuild_fork_state
+
+    C_src = saved.outs[0].shape[0]
+    idx = JaxReplayEngine(ec, ep, cfg, chunk_waves=C).waves.idx
+    pad_to = ((idx.shape[0] + C_src - 1) // C_src) * C_src
+    if pad_to != idx.shape[0]:
+        idx = np.concatenate(
+            [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), -1, np.int32)]
+        )
+    wt = np.where(
+        idx[:, 0] >= 0, ep.arrival[np.clip(idx[:, 0], 0, None)], np.inf
     )
-    res2 = wi2.run()
-    np.testing.assert_array_equal(res2.assignments[0], full.assignments)
+    _, rel_legacy = rebuild_fork_state(
+        ep, idx, C_src, saved.outs, wt, saved.chunk_cursor, slack=0
+    )
+    _, rel_slack = rebuild_fork_state(
+        ep, idx, C_src, saved.outs, wt, saved.chunk_cursor, slack=1
+    )
+    # Legacy rule releases at least as much (chunk b−1 pods included).
+    assert (rel_legacy | rel_slack == rel_legacy).all()
