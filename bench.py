@@ -7,10 +7,15 @@ path over this framework's own CPU default plugin path on the same
 workload shape (per-placement rate ratio) — the honest available baseline.
 
 Workload: batched what-if (config #3 shape) — S scenarios × full default
-plugin set, measured on the real device; CPU rate measured on a pod
-subsample (it is orders of magnitude slower).
+plugin set, measured on the real device. Since round 4 the headline
+workload has finite pod durations (mean ``BENCH_DURATION_MEAN``), so the
+number exercises the DEFAULT-ON chunk-granular completions machinery;
+a durationless (arrivals-only) run ships in ``detail`` for cross-round
+continuity with r01–r03. CPU rate is measured on a pod subsample of the
+same workload (it is orders of magnitude slower).
 
-Env knobs: BENCH_NODES, BENCH_PODS, BENCH_SCENARIOS, BENCH_CPU_PODS.
+Env knobs: BENCH_NODES, BENCH_PODS, BENCH_SCENARIOS, BENCH_CPU_PODS,
+BENCH_RUNS, BENCH_DURATION_MEAN (seconds; 0 disables durations).
 """
 
 from __future__ import annotations
@@ -27,6 +32,14 @@ def main():
     pods_n = int(os.environ.get("BENCH_PODS", 20_000))
     S = int(os.environ.get("BENCH_SCENARIOS", 128))
     cpu_pods = int(os.environ.get("BENCH_CPU_PODS", 2000))
+    # Mean pod runtime: the 20k-pod workload spans ~200 s of arrivals at
+    # the default rate, so 50 s means most pods complete mid-replay and
+    # several chunk boundaries carry real release work.
+    dur_mean = float(os.environ.get("BENCH_DURATION_MEAN", 50.0))
+
+    from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
+
+    _cc()
 
     from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
     from kubernetes_simulator_tpu.models.encode import encode
@@ -35,17 +48,27 @@ def main():
     from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
 
     cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
-    pods, _ = make_workload(
-        pods_n, seed=0, with_affinity=True, with_spread=True, with_tolerations=True,
-        gang_fraction=0.02, gang_size=4,
-    )
+
+    def _make_pods(duration_mean):
+        pods, _ = make_workload(
+            pods_n, seed=0, with_affinity=True, with_spread=True,
+            with_tolerations=True, gang_fraction=0.02, gang_size=4,
+            duration_mean=duration_mean or None,
+        )
+        return pods
+
+    pods = _make_pods(dur_mean)
     ec, ep = encode(cluster, pods)
     cfg = FrameworkConfig()
 
-    # CPU default-path baseline on a subsample (same cluster).
+    # CPU default-path baseline on a subsample (same workload incl.
+    # durations — the greedy anchor mirrors the chunk-granular releases).
     pods_small = pods[:cpu_pods]
     ec_s, ep_s = encode(cluster, pods_small)
-    cpu_res = greedy_replay(ec_s, ep_s, FrameworkConfig())
+    cpu_res = greedy_replay(
+        ec_s, ep_s, FrameworkConfig(),
+        completions_chunk_waves=512 if dur_mean else None,
+    )
     cpu_pps = cpu_res.placements_per_sec
 
     # JAX what-if batch: compile once (warmup run), then N timed runs.
@@ -63,10 +86,32 @@ def main():
     res = results[0]  # placement counts are identical across runs
     value = res.total_placed / med_wall if med_wall > 0 else 0.0
     vs = value / cpu_pps if cpu_pps > 0 else 0.0
+
+    # Arrivals-only continuity run (the r01–r03 protocol, same shape
+    # minus durations) so rounds stay comparable across the change.
+    cont = {}
+    if dur_mean:
+        ec_c, ep_c = encode(cluster, _make_pods(None))
+        eng_c = WhatIfEngine(
+            ec_c, ep_c, uniform_scenarios(ec_c, S, seed=0), cfg,
+            chunk_waves=512,
+        )
+        eng_c.run()
+        runs_c = [eng_c.run() for _ in range(runs)]
+        walls_c = sorted(r.wall_clock_s for r in runs_c)
+        med_c = float(np.median(walls_c))
+        cont = {
+            "durationless_pps": round(
+                runs_c[0].total_placed / med_c if med_c > 0 else 0.0, 1
+            ),
+            "durationless_wall_median_s": round(med_c, 3),
+            "durationless_walls_s": [round(w, 3) for w in walls_c],
+        }
+
     print(
         json.dumps(
             {
-                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set)"
+                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, completions on)"
                 % (S, nodes, pods_n),
                 "value": round(value, 1),
                 "unit": "placements/sec",
@@ -78,9 +123,12 @@ def main():
                     "jax_walls_s": [round(w, 3) for w in walls],
                     "timed_runs": runs,
                     "jax_total_placed": res.total_placed,
+                    "completions_on": bool(res.completions_on),
+                    "duration_mean_s": dur_mean,
                     "cpu_default_path_pps": round(cpu_pps, 1),
                     "scenario0_placed": int(res.placed[0]),
                     "device": _device_kind(),
+                    **cont,
                 },
             }
         )

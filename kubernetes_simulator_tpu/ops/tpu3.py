@@ -36,9 +36,14 @@ floor-quantized score by one. The parity suites pin equality on realistic
 traces; whatif batches pick v2/v3 per batch (labels_dirty), so keep that
 caveat in mind when comparing across batches at extreme magnitudes.
 
-Not supported here (callers fall back to v2): scenario batches whose
-label perturbations change topology domains (whatif ``labels_dirty``) —
-v3 shares the node→domain tables across scenarios.
+Scenario batches whose label perturbations change topology domains
+(whatif ``labels_dirty``) stay on v3 via per-scenario DynTables (round
+3): append-style domain ids plus K sparse node→domain overrides applied
+as a correction matmul on top of the scenario-SHARED base expansion
+tables — see ``DynTables``/``make_wave_step3(dyn=...)`` below. Callers
+fall back to v2 only outside the DynTables envelope (host-scale topology
+changes, >32 perturbed nodes/scenario, pre-bound pods, preemption,
+forks — sim/whatif.py gates and reports via ``WhatIfEngine.engine``).
 """
 
 from __future__ import annotations

@@ -74,6 +74,16 @@ def _col(row: dict, *names, default=""):
     return default
 
 
+def _to_id(v: str) -> int:
+    """Integer id parse without a float round-trip (ids above 2^53 must
+    stay exact — the INT64 schema allows them); decimal/scientific
+    notation from float-typed re-exports still parses via float."""
+    try:
+        return int(v)
+    except ValueError:
+        return int(float(v))
+
+
 @dataclass
 class Borg2019Etl:
     """Streaming mapper: real-schema CSVs → encoded trace columns."""
@@ -153,6 +163,11 @@ class Borg2019Etl:
         sub = et_s == SUBMIT
         first_sub = np.minimum.reduceat(np.where(sub, pos_s, BIG), starts)
         has_sub = first_sub != BIG
+        # MAX submit TIME — matching the DictReader twin's
+        # ``last_submit[key] = max(t, last_submit)`` exactly, so the two
+        # paths stay value-identical even on traces not sorted by time
+        # (end events differ: both take the LAST end in file order,
+        # mirroring the dict's overwrite).
         last_sub_t = np.maximum.reduceat(
             np.where(sub, np.maximum(t_s, 0.0), -np.inf), starts
         )
@@ -192,13 +207,13 @@ class Borg2019Etl:
                 for row in csv.DictReader(f):
                     if _etype(_col(row, "type")) != SUBMIT:
                         continue
-                    cid = int(float(_col(row, "collection_id", default="0")))
+                    cid = _to_id(_col(row, "collection_id", default="0"))
                     p = _col(row, "priority")
                     if p != "":
-                        job_prio[cid] = int(float(p))
+                        job_prio[cid] = _to_id(p)
                     a = _col(row, "alloc_collection_id")
                     if a != "":
-                        job_alloc[cid] = int(float(a))
+                        job_alloc[cid] = _to_id(a)
 
         # One streaming pass over instance_events: the FIRST SUBMIT wins
         # the task row (arrival); FINISH/KILL record the end time. A
@@ -212,8 +227,8 @@ class Borg2019Etl:
         with open(self.instance_events, newline="") as f:
             for row in csv.DictReader(f):
                 et = _etype(_col(row, "type"))
-                cid = int(float(_col(row, "collection_id", default="0")))
-                iidx = int(float(_col(row, "instance_index", default="0")))
+                cid = _to_id(_col(row, "collection_id", default="0"))
+                iidx = _to_id(_col(row, "instance_index", default="0"))
                 key = (cid, iidx)
                 t = float(_col(row, "time", default="0")) * _US - _LEAD_S
                 if et == SUBMIT:
@@ -224,12 +239,12 @@ class Borg2019Etl:
                         continue
                     prio = _col(row, "priority")
                     prio = (
-                        int(float(prio)) if prio != ""
+                        _to_id(prio) if prio != ""
                         else job_prio.get(cid, 0)
                     )
                     alloc = _col(row, "alloc_collection_id")
                     alloc = (
-                        int(float(alloc)) if alloc != ""
+                        _to_id(alloc) if alloc != ""
                         else job_alloc.get(cid, 0)
                     )
                     cpu = float(

@@ -9,10 +9,13 @@ each wave boundary. Selected through the strategy registry ([BASELINE]: the
 CPU plugin path stays the default; `jax` is opt-in).
 
 Semantics = :mod:`.greedy` exactly (the parity anchor): arrival-order
-greedy, no queue/backoff/preemption. The event-driven features (completions,
-failure injection, preemption) live in the CPU engine; batched what-if over
-scenarios builds on this module via ``vmap``/``shard_map``
-(:mod:`.whatif`, :mod:`..parallel`).
+greedy waves with chunk-granular completions ON BY DEFAULT (pods with
+finite duration release resources and count contributions at chunk
+boundaries, one-chunk slack — see ``JaxReplayEngine.replay``). Tier
+preemption is opt-in (``preemption=True``). Exact-timestamp event
+ordering, queue re-ordering/backoff, and kube minimal-victims preemption
+remain CPU-event-engine-only; batched what-if over scenarios builds on
+this module via ``vmap``/``shard_map`` (:mod:`.whatif`, :mod:`..parallel`).
 """
 
 from __future__ import annotations
@@ -385,7 +388,7 @@ class JaxReplayEngine:
         engine: str = "v3",
         dmax_coarse: int = 128,
         preemption: bool = False,
-        completions: bool = True,
+        completions: Optional[bool] = None,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
@@ -402,6 +405,20 @@ class JaxReplayEngine:
 
         if preemption and engine != "v3":
             raise ValueError("device preemption requires engine='v3'")
+        if preemption and bool(np.isfinite(pods.duration).any()):
+            # Loud, not silent (round 4): tier preemption cannot honor
+            # completions (phantom counts cannot attribute releases).
+            msg = (
+                "device tier preemption runs ARRIVALS-ONLY: pods with "
+                "finite durations never release resources under "
+                "preemption=True"
+            )
+            if completions is True:
+                raise ValueError(msg)
+            if completions is not False:
+                import warnings
+
+                warnings.warn(msg, stacklevel=2)
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -614,7 +631,7 @@ class JaxReplayEngine:
             np.isfinite(self.pods.duration), self.pods.duration, np.inf
         )
         completions_on = bool(
-            self.completions
+            self.completions is not False  # None (the default) = on
             and not self.preemption
             and np.isfinite(rel_time).any()
         )

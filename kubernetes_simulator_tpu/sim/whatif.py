@@ -367,29 +367,6 @@ class ScenarioSet:
         return dyn
 
 
-class RelSource:
-    """Static per-pod tables for DEVICE-side completions (scenario-shared,
-    uploaded once per run): the first boundary index each pod is release-
-    ELIGIBLE at (precomputed on host in f64 — the device compares i32
-    only, so eligibility matches the f64 host/anchor paths exactly),
-    the binding chunk (pre-bound = −2), and the pod's matched
-    count-groups (PAD-padded)."""
-
-    def __init__(self, elig_b, chunk_of, matched_g):
-        self.elig_b = elig_b
-        self.chunk_of = chunk_of
-        self.matched_g = matched_g
-
-
-import jax.tree_util as _jtu
-
-_jtu.register_pytree_node(
-    RelSource,
-    lambda r: ((r.elig_b, r.chunk_of, r.matched_g), None),
-    lambda _, c: RelSource(*c),
-)
-
-
 class ScenarioDyn:
     """Per-scenario domain tables for v3 labels_dirty batches (append-style
     ids; see ScenarioSet). All arrays lead with the scenario axis and are
@@ -428,6 +405,11 @@ class WhatIfResult:
     placements_per_sec: float  # aggregate over all scenarios
     assignments: Optional[np.ndarray] = None  # [S, P] when collected
     utilization_cpu: Optional[np.ndarray] = None  # [S]
+    # Which semantics this batch actually ran under (round 4: two batches
+    # evaluated under different semantics must be programmatically
+    # distinguishable — advisor round 3).
+    completions_on: bool = False
+    engine: str = "v3"
 
 
 class WhatIfEngine:
@@ -446,7 +428,7 @@ class WhatIfEngine:
         collect_assignments: bool = False,
         fork_checkpoint: Optional[str] = None,
         preemption: bool = False,
-        completions: bool = True,
+        completions: Optional[bool] = None,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -455,13 +437,16 @@ class WhatIfEngine:
 
         ``completions``: chunk-granular pod completions per scenario (the
         JaxReplayEngine mechanism, applied to each scenario's own
-        placements). Default ON since round 3: release folding runs one
-        chunk behind the device pipeline (boundary b sees chunks ≤ b−2 —
-        the one-chunk slack, shared with the greedy anchor), so the
-        host-side deltas overlap the in-flight chunk instead of stalling
-        it. Requires the v3 engine, no preemption, no label-perturbation
-        DynTables, finite durations — else it silently reverts to the
-        arrivals-only semantics."""
+        placements). Default ON since round 3 (``None`` = on): release
+        folding runs one chunk behind the device pipeline (boundary b
+        sees chunks ≤ b−2 — the one-chunk slack, shared with the greedy
+        anchor), so the host-side deltas overlap the in-flight chunk
+        instead of stalling it. Requires the v3 engine and no preemption;
+        when a batch with finite durations cannot honor them the engine
+        WARNS and reverts to arrivals-only semantics — pass an explicit
+        ``completions=True`` to get a ``ValueError`` instead, or read
+        ``WhatIfResult.completions_on``. A trace with no finite durations
+        runs arrivals-only silently (the semantics are identical)."""
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -487,10 +472,10 @@ class WhatIfEngine:
         self.engine = "v3"
         self._dyn = None
         if self.sset.labels_dirty:
-            # NOTE on completions: NO engine supports them together with
-            # label-perturbation batches (the release deltas would need
-            # per-scenario domain tables), so they are silently off either
-            # way — prefer the ~4× faster DynTables v3 over v2.
+            # Completions are off for label-perturbation batches on either
+            # engine (the release deltas would need per-scenario domain
+            # tables) — the gate below WARNS/raises about it — so prefer
+            # the ~4× faster DynTables v3 over v2.
             dyn = self.sset.dyn
             if (
                 dyn is not None
@@ -564,13 +549,39 @@ class WhatIfEngine:
             np.isfinite(pods.duration), pods.duration, np.inf
         )
         self._rel_time = rel
-        self.completions_on = bool(
-            completions
-            and self.engine == "v3"
-            and self._dyn is None  # release deltas use base domain tables
-            and not preemption
-            and np.isfinite(rel).any()
-        )
+        # Loud, not silent (round 4): a batch that cannot honor the
+        # default-on completions WARNS (or raises, when the caller passed
+        # an explicit True); the outcome is exposed on the result. A trace
+        # with no finite durations is exempt — arrivals-only and
+        # completions-on semantics coincide there.
+        want = completions is not False  # None (the default) = on
+        have_durations = bool(np.isfinite(rel).any())
+        blockers = []
+        if self.engine != "v3":
+            blockers.append(
+                "the v2 fallback engine (label perturbations outside the "
+                "DynTables envelope)"
+            )
+        if preemption:
+            blockers.append("device tier preemption")
+        if self._dyn is not None:
+            blockers.append(
+                "labels_dirty DynTables batches (release deltas use the "
+                "base domain tables)"
+            )
+        self.completions_on = bool(want and have_durations and not blockers)
+        if want and have_durations and blockers:
+            msg = (
+                "what-if completions cannot be honored with "
+                + "; ".join(blockers)
+                + " — this batch runs ARRIVALS-ONLY (placed pods never "
+                "release resources)"
+            )
+            if completions is True:
+                raise ValueError(msg)
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
         # DEVICE-side releases (round 3): on the perf path the release
         # bookkeeping lives on device — per-scenario assignment + released
         # planes carried across chunks, boundary deltas as masked
@@ -677,38 +688,47 @@ class WhatIfEngine:
                     Dcap = st3.Dcap
 
                     def per_scenario_rel(
-                        dc, state, src, xsrc, rel, idx, assign, released, b,
+                        dc, state, src, xsrc, rel_ids, rel_req, rel_matched,
+                        idx, assign,
                     ):
                         # --- boundary releases, entirely on device ------
-                        due = (
-                            (assign >= 0)
-                            & ~released
-                            & (rel.elig_b <= b)
-                            & (rel.chunk_of < b - 1)  # one-chunk slack
-                        )
+                        # Only THIS boundary's release set: a pod's first
+                        # eligible boundary max(elig_b, chunk_of+2) is
+                        # STATIC (wave packing fixes chunk_of; durations
+                        # fix elig_b), so the per-boundary work is
+                        # O(S·K_b) gathers/scatters instead of the former
+                        # O(S·P) full-pod-axis pass — ~30× less release
+                        # work over a north-star run. The only dynamic
+                        # input is whether the pod was actually placed
+                        # (assign ≥ 0); each pod appears in exactly one
+                        # boundary's list, so no released mask is needed.
+                        P = assign.shape[0]
                         N = state.used.shape[1]
+                        safe = jnp.where(rel_ids < P, rel_ids, 0)
+                        node_k = assign[safe]  # [K]
+                        due = (rel_ids < P) & (node_k >= 0)
                         # Masked-out entries use a PAST-THE-END index: with
                         # mode="drop" only genuinely out-of-bounds indices
                         # are dropped — negative ones WRAP first (NumPy
                         # semantics) and would corrupt the last element.
-                        amask = jnp.where(due, assign, N)
+                        amask = jnp.where(due, node_k, N)
                         R = state.used.shape[0]
                         used = jnp.stack([
                             state.used[r].at[amask].add(
-                                -jnp.where(due, src.requests[:, r], 0.0),
+                                -jnp.where(due, rel_req[:, r], 0.0),
                                 mode="drop",
                             )
                             for r in range(R)
                         ])
-                        dom = sh3_l.topo1_f[jnp.clip(assign, 0)].astype(
+                        dom = sh3_l.topo1_f[jnp.clip(node_k, 0)].astype(
                             jnp.int32
                         )
                         ok = due & (dom >= 0)
                         mc_flat = state.mc_dom.reshape(-1)
                         G = state.match_total.shape[0]
                         mt = state.match_total
-                        for m in range(rel.matched_g.shape[1]):
-                            g = rel.matched_g[:, m]
+                        for m in range(rel_matched.shape[1]):
+                            g = rel_matched[:, m]
                             # has_dom_g: a matched group WITHOUT a topology
                             # never held a count (the host release_delta's
                             # dom[g] >= 0 guard).
@@ -726,7 +746,6 @@ class WhatIfEngine:
                             mc_dom=mc_flat.reshape(state.mc_dom.shape),
                             match_total=mt,
                         )
-                        released = released | due
                         # --- the normal chunk scan ----------------------
                         state, out = per_scenario_src(
                             dc, state, src, xsrc, idx
@@ -735,17 +754,18 @@ class WhatIfEngine:
                         choices, counts = out
                         flat_i = idx.reshape(-1)
                         flat_c = choices.reshape(-1)
-                        Pn = assign.shape[0]
                         assign = assign.at[
-                            jnp.where(flat_i >= 0, flat_i, Pn)
+                            jnp.where(flat_i >= 0, flat_i, P)
                         ].set(flat_c, mode="drop")
-                        return state, assign, released, counts
+                        return state, assign, counts
 
                     vmapped_rel = jax.vmap(
                         per_scenario_rel,
-                        in_axes=(0, 0, None, None, None, None, 0, 0, None),
+                        in_axes=(
+                            0, 0, None, None, None, None, None, None, 0
+                        ),
                     )
-                    return jax.jit(vmapped_rel, donate_argnums=(1, 6, 7))
+                    return jax.jit(vmapped_rel, donate_argnums=(1, 8))
                 # vmap matches in_axes against the args actually passed,
                 # so the defaulted dyn arg needs no wrapper.
                 vmapped_src = jax.vmap(
@@ -1060,7 +1080,7 @@ class WhatIfEngine:
 
             P = self.pods.num_pods
             nchunks = idx.shape[0] // C
-            chunk_of = np.full(P, 1 << 30, np.int32)
+            chunk_of = np.full(P, 1 << 30, np.int64)
             for cj in range(nchunks):
                 rows = idx[cj * C : (cj + 1) * C]
                 chunk_of[rows[rows >= 0]] = cj
@@ -1081,18 +1101,34 @@ class WhatIfEngine:
             nfin = int(np.isfinite(tb_all).sum())
             elig = np.searchsorted(
                 tb_all[:nfin], self._rel_time, side="left"
-            ).astype(np.int32)
-            elig = np.where(
-                np.isfinite(self._rel_time) & (elig < nfin), elig, 1 << 30
-            ).astype(np.int32)
-            rel_src = RelSource(
-                elig_b=jnp.asarray(elig),
-                chunk_of=jnp.asarray(chunk_of),
-                matched_g=jnp.asarray(matched.astype(np.int32)),
-            )
-            b_list = [
-                jnp.asarray(np.int32(ci)) for ci in range(nchunks)
-            ]
+            ).astype(np.int64)
+            elig_ok = np.isfinite(self._rel_time) & (elig < nfin)
+            # The boundary each pod releases at is STATIC: first boundary
+            # ≥ its eligibility that also respects the one-chunk slack
+            # (chunks ≤ b−2 folded). Bucket pods per boundary on host so
+            # the device touches only that boundary's K_b pods.
+            b_rel = np.maximum(elig, chunk_of + 2)
+            ok = elig_ok & (b_rel < nchunks)
+            pods_ok = np.nonzero(ok)[0].astype(np.int64)
+            b_ok = b_rel[pods_ok]
+            order = np.lexsort((pods_ok, b_ok))
+            pods_s = pods_ok[order]
+            b_s = b_ok[order]
+            counts = np.bincount(b_s, minlength=nchunks)
+            Kmax = max(int(counts.max(initial=0)), 1)
+            starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            pos = np.arange(len(pods_s)) - starts[b_s]
+            R = self.ec.num_resources
+            M = matched.shape[1]
+            rel_ids_np = np.full((nchunks, Kmax), P, np.int32)
+            rel_req_np = np.zeros((nchunks, Kmax, R), np.float32)
+            rel_mg_np = np.full((nchunks, Kmax, M), PAD, np.int32)
+            rel_ids_np[b_s, pos] = pods_s
+            rel_req_np[b_s, pos] = self.pods.requests[pods_s]
+            rel_mg_np[b_s, pos] = matched[pods_s]
+            rel_ids_c = [jnp.asarray(rel_ids_np[b]) for b in range(nchunks)]
+            rel_req_c = [jnp.asarray(rel_req_np[b]) for b in range(nchunks)]
+            rel_mg_c = [jnp.asarray(rel_mg_np[b]) for b in range(nchunks)]
             assign_d = jax.jit(
                 lambda a: jnp.broadcast_to(a[None], (self.S,) + a.shape)
             )(
@@ -1102,7 +1138,6 @@ class WhatIfEngine:
                     ).astype(np.int32)
                 )
             )
-            released_d = jnp.zeros((self.S, self.pods.num_pods), bool)
         pending_fold = None  # (rows, choices) of the not-yet-folded chunk
         if comp_on:
             first = idx[:, 0]
@@ -1207,9 +1242,9 @@ class WhatIfEngine:
                         states, host_assign, released, t_chunk
                     )
             if dev_rel:
-                states, assign_d, released_d, out = self._chunk_fn(
-                    dc, states, srcs[0], srcs[1], rel_src, idx_chunks[ci],
-                    assign_d, released_d, b_list[ci],
+                states, assign_d, out = self._chunk_fn(
+                    dc, states, srcs[0], srcs[1], rel_ids_c[ci],
+                    rel_req_c[ci], rel_mg_c[ci], idx_chunks[ci], assign_d,
                 )
             elif self.mesh is None and self.engine == "v3" and srcs is not None:
                 # Fused device-side gather + wave scan: one dispatch per
@@ -1332,6 +1367,8 @@ class WhatIfEngine:
             placements_per_sec=total / wall if wall > 0 else 0.0,
             assignments=assignments,
             utilization_cpu=util,
+            completions_on=self.completions_on,
+            engine=self.engine,
         )
 
 

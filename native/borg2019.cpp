@@ -142,7 +142,22 @@ int64_t ksim_borg2019_parse(const char* path, int64_t max_rows,
   char* end = buf.data + buf.size;
 
   // --- header ---------------------------------------------------------
-  while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  // Skip blank and '#'-comment lines before the header — the count path
+  // treats both as blanks, and a leading comment read as the header would
+  // silently miss the required columns and disable the fast path.
+  while (p < end) {
+    if (*p == '\n' || *p == '\r') {
+      ++p;
+      continue;
+    }
+    if (*p == '#') {
+      char* nl = static_cast<char*>(std::memchr(p, '\n', end - p));
+      if (!nl) return -1;  // comment-only file: no header
+      p = nl + 1;
+      continue;
+    }
+    break;
+  }
   char* hl_end = static_cast<char*>(std::memchr(p, '\n', end - p));
   if (!hl_end) hl_end = end;
   int col_role[256];
@@ -203,23 +218,35 @@ int64_t ksim_borg2019_parse(const char* path, int64_t max_rows,
             case TYPE: {
               if (std::isdigit(static_cast<unsigned char>(*q)) ||
                   *q == '-' || *q == '+') {
-                etype[row] = static_cast<int32_t>(std::strtod(q, nullptr));
+                etype[row] =
+                    static_cast<int32_t>(std::strtoll(q, nullptr, 10));
               } else {
                 etype[row] = type_name(q, len);
               }
               break;
             }
+            // Integer id columns parse with strtoll: ids above 2^53
+            // would silently lose precision through a double and could
+            // merge distinct tasks (real Borg-2019 ids are ~1e11-1e12,
+            // but the table schema is INT64). A field strtoll cannot
+            // fully consume (decimal/scientific notation from a
+            // float-typed re-export, e.g. "3.8e+11") is NOT truncated —
+            // the parser bails so callers fall back to DictReader.
             case CID:
-              cid[row] = static_cast<int64_t>(std::strtod(q, &next));
+              cid[row] = std::strtoll(q, &next, 10);
+              if (next != q + len) return -1;
               break;
             case IIDX:
-              iidx[row] = static_cast<int64_t>(std::strtod(q, &next));
+              iidx[row] = std::strtoll(q, &next, 10);
+              if (next != q + len) return -1;
               break;
             case PRIO:
-              prio[row] = static_cast<int32_t>(std::strtod(q, &next));
+              prio[row] = static_cast<int32_t>(std::strtoll(q, &next, 10));
+              if (next != q + len) return -1;
               break;
             case ALLOC:
-              alloc[row] = static_cast<int64_t>(std::strtod(q, &next));
+              alloc[row] = std::strtoll(q, &next, 10);
+              if (next != q + len) return -1;
               break;
             case CPU:
               cpu[row] = std::strtof(q, &next);

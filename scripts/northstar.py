@@ -8,6 +8,10 @@ Env knobs: NS_NODES, NS_TASKS, NS_S, NS_WAVE, NS_CHUNK.
 import os
 import time
 
+from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
+
+_cc()  # persistent XLA cache: a restart at the same shape compiles in ~s
+
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
 from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios

@@ -216,3 +216,112 @@ def test_million_row_ingest_throughput(tmp_path):
     wall = time.perf_counter() - t0
     assert len(cols["arrival"]) > 900_000  # (cid, iidx) mostly unique
     assert wall < 10.0, f"1M-row ingest took {wall:.1f}s (target <10s)"
+
+
+def test_native_parse_skips_leading_comment_lines(tmp_path):
+    """A '#'-comment line before the header must not be read AS the
+    header (which would miss the required columns and silently disable
+    the fast path) — count and parse agree on comment handling."""
+    from kubernetes_simulator_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    inst = tmp_path / "inst.csv"
+    with open(inst, "w") as f:
+        f.write("# exported 2019-05-01\n")
+        f.write("\n")
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        f.write(f"{600 * _US},0,1,0,100,0,0.1,0.1\n")
+        f.write(f"{700 * _US},6,1,0,,,,\n")
+    raw = native.read_borg2019_events(str(inst))
+    assert raw is not None and raw["etype"].shape[0] == 2
+    cols = Borg2019Etl(str(inst)).read_cols()
+    assert len(cols["arrival"]) == 1
+    assert np.isclose(cols["duration"][0], 100.0)
+
+
+def test_native_int64_ids_exact(tmp_path):
+    """Id columns above 2^53 must parse exactly (strtoll, not a double
+    round-trip) — two ids that differ only in the low bits stay
+    distinct tasks."""
+    from kubernetes_simulator_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    big = (1 << 60) + 1  # collapses to 1<<60 through a double
+    inst = tmp_path / "inst.csv"
+    with open(inst, "w") as f:
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        f.write(f"{600 * _US},0,{big},0,100,0,0.1,0.1\n")
+        f.write(f"{600 * _US},0,{big + 1},0,100,0,0.1,0.1\n")
+    raw = native.read_borg2019_events(str(inst))
+    assert raw is not None
+    assert raw["cid"][0] == big and raw["cid"][1] == big + 1
+    etl = Borg2019Etl(str(inst))
+    cols = etl.read_cols()
+    assert len(cols["arrival"]) == 2  # distinct tasks
+    # The DictReader fallback must keep them distinct too (no float
+    # round-trip through int(float(...)) — ids are INT64).
+    assert len(etl._cols_dictreader()["arrival"]) == 2
+
+
+def test_native_rejects_float_formatted_ids(tmp_path):
+    """Scientific/decimal-formatted id fields (float-typed re-exports)
+    must NOT be truncated by strtoll — the native parser bails and the
+    DictReader fallback parses them via float."""
+    from kubernetes_simulator_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    inst = tmp_path / "inst.csv"
+    with open(inst, "w") as f:
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        f.write(f"{600 * _US},0,3.80226759816e+11,0,100,0,0.1,0.1\n")
+    assert native.read_borg2019_events(str(inst)) is None  # fast path bails
+    cols = Borg2019Etl(str(inst)).read_cols()  # falls back
+    assert len(cols["arrival"]) == 1
+
+
+def test_unsorted_trace_paths_value_identical(tmp_path):
+    """On a trace NOT sorted by time, the native-raw and DictReader paths
+    must still produce identical columns (advisor round-3): both anchor
+    the duration at the MAX submit time (here 1600 → duration 100s, not
+    the file-order-last submit at 600 → 1100s)."""
+    inst = tmp_path / "inst.csv"
+    with open(inst, "w") as f:
+        f.write(
+            "time,type,collection_id,instance_index,priority,"
+            "alloc_collection_id,resource_request.cpus,"
+            "resource_request.memory\n"
+        )
+        # File order: submit@t=1600, submit@t=600 (out of order),
+        # FINISH@t=1700.
+        f.write(f"{1600 * _US},0,1,0,100,0,0.1,0.1\n")
+        f.write(f"{600 * _US},0,1,0,100,0,0.1,0.1\n")
+        f.write(f"{1700 * _US},6,1,0,,,,\n")
+    etl = Borg2019Etl(str(inst))
+    slow = etl._cols_dictreader()
+    from kubernetes_simulator_tpu import native
+
+    if native.available():
+        fast = etl._cols_from_raw(
+            native.read_borg2019_events(str(inst)), None
+        )
+        for k in slow:
+            np.testing.assert_array_equal(fast[k], slow[k], err_msg=k)
+    assert np.isclose(slow["duration"][0], 100.0)
+    # Arrival stays the FIRST submit in file order (insertion order) —
+    # but its time is clamped at 0 after lead-removal either way.
+    assert slow["arrival"][0] >= 0.0
