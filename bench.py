@@ -111,8 +111,13 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, completions on)"
-                % (S, nodes, pods_n),
+                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, %s)"
+                % (
+                    S, nodes, pods_n,
+                    "completions on"
+                    if res.completions_on
+                    else "arrivals-only",
+                ),
                 "value": round(value, 1),
                 "unit": "placements/sec",
                 "vs_baseline": round(vs, 2),

@@ -73,6 +73,8 @@ def cmd_whatif(args) -> int:
         chunk_waves=cfg.chunk_waves,
         mesh=mesh,
         preemption=cfg.device_preemption,
+        completions=cfg.whatif.completions,
+        retry_buffer=cfg.whatif.retry_buffer,
     )
     with device_trace(args.profile_dir):
         res = eng.run()
@@ -162,6 +164,14 @@ def validate_config(cfg) -> list:
                 )
     if cfg.whatif.scenarios < 0:
         errors.append("whatIf.scenarios: must be >= 0")
+    if cfg.whatif.retry_buffer < 0:
+        errors.append("whatIf.retryBuffer: must be >= 0")
+    if cfg.whatif.retry_buffer and cfg.device_preemption:
+        errors.append(
+            "whatIf.retryBuffer is not supported with devicePreemption"
+        )
+    if cfg.whatif.completions not in (None, True, False):
+        errors.append("whatIf.completions: must be true or false")
     if cfg.chunk_waves <= 0:
         errors.append("chunkWaves: must be > 0")
     if cfg.wave_width != "auto" and cfg.wave_width <= 0:

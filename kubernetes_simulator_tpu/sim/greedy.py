@@ -90,14 +90,37 @@ def greedy_replay(
     wave_width: int = 8,
     preemption: bool = False,
     completions_chunk_waves: Optional[int] = None,
+    retry_buffer: int = 0,
 ) -> ReplayResult:
     """``completions_chunk_waves``: mirror the device engines' chunk-granular
     completions — before each chunk of that many waves, pods whose
     ``arrival + duration`` is at or before the chunk's start time release
     their resources and count contributions (they stay in ``assignments``:
-    a completed pod ran to completion, it is not unschedulable)."""
+    a completed pod ran to completion, it is not unschedulable).
+
+    ``retry_buffer`` (round 4, [K8S] activeQ flush-on-event analogue):
+    non-gang pods that miss placement enter a FIFO retry buffer (capacity
+    ``retry_buffer``; overflow drops the newest — they stay permanently
+    unscheduled as before). At each chunk boundary, AFTER releases apply,
+    one bounded retry pass re-attempts every buffered pod in order;
+    placed pods leave the buffer and start at the boundary's time — they
+    release at the first boundary whose start time reaches ``t_b +
+    duration`` (computed in f32, exactly as the device does; at least
+    ``b+1``), through a pending list also capped at ``retry_buffer``
+    (overflow = the release is dropped and the pod holds its resources to
+    the end). Requires ``completions_chunk_waves``. Mirrors
+    WhatIfEngine(retry_buffer=...)'s device semantics exactly."""
     config = config or FrameworkConfig()
     config.enable_preemption = False  # greedy semantics: no kube PostFilter
+    if retry_buffer and not completions_chunk_waves:
+        raise ValueError("retry_buffer requires completions_chunk_waves")
+    if retry_buffer and preemption:
+        raise ValueError("retry_buffer is not supported with preemption")
+    if retry_buffer:
+        # Same rounding as the device twin (its retry pass reuses the
+        # W-wide wave step) — the two caps must agree or placed counts
+        # diverge once a buffer fills past the raw capacity.
+        retry_buffer = -(-retry_buffer // wave_width) * wave_width
     fw = SchedulerFramework(ec, ep, config)
     if waves is None:
         waves = pack_waves(ep, wave_width)
@@ -116,12 +139,36 @@ def greedy_replay(
     # in-flight chunk (round 3; matched here so the anchor stays exact).
     bind_chunk = np.full(ep.num_pods, 1 << 30, np.int64)
     bind_chunk[ep.bound_node >= 0] = -2
+    retry_q: List[int] = []  # FIFO waiting pods (ids)
+    pend: List[list] = []  # [relb, pod, node] retried-placed awaiting release
+    tb32 = None
+    if retry_buffer:
+        # Boundary start times in f32 (finite prefix), matching the
+        # device's staged f32 table bit-for-bit.
+        C = completions_chunk_waves
+        firsts = waves.idx[0::C, 0]
+        tb_all = np.where(
+            firsts >= 0, ep.arrival[np.clip(firsts, 0, None)], np.inf
+        )
+        nfin = int(np.isfinite(tb_all).sum())
+        tb32 = tb_all[:nfin].astype(np.float32)
     t0 = time.perf_counter()
     for wi, wave in enumerate(waves.idx):
         if completions_chunk_waves and wi % completions_chunk_waves == 0:
             b = wi // completions_chunk_waves
             first = int(wave[0]) if wave.shape[0] else -1
             t_chunk = float(ep.arrival[first]) if first >= 0 else np.inf
+            # 1. Pending releases of retried-placed pods (relb encodes
+            # the time comparison already — no finite-t gate).
+            still = []
+            for entry in pend:
+                if entry[0] <= b:
+                    unbind(ec, ep, st, int(entry[1]))
+                    released[entry[1]] = True
+                else:
+                    still.append(entry)
+            pend[:] = still
+            # 2. Static releases (pods that started at arrival).
             if np.isfinite(t_chunk):
                 due = np.nonzero(
                     (st.bound >= 0)
@@ -133,6 +180,31 @@ def greedy_replay(
                 for p in due:
                     unbind(ec, ep, st, int(p))  # assignments keep the node
                     released[p] = True
+            # 3. Bounded retry pass over the buffer, FIFO order.
+            if retry_buffer and retry_q:
+                still_q = []
+                for p in retry_q:
+                    res = fw.schedule_one(st, p)
+                    if res.node == PAD:
+                        still_q.append(p)
+                        continue
+                    bind(ec, ep, st, p, res.node)
+                    assignments[p] = res.node
+                    placed_total += 1
+                    # Release schedule: f32 boundary search, >= b+1 —
+                    # the pod STARTS now, not at arrival.
+                    dur = np.float32(ep.duration[p])
+                    if np.isfinite(dur) and len(pend) < retry_buffer:
+                        rb = int(
+                            np.searchsorted(
+                                tb32,
+                                np.float32(t_chunk) + dur,
+                                side="left",
+                            )
+                        )
+                        if rb < len(tb32):
+                            pend.append([max(rb, b + 1), p, res.node])
+                retry_q[:] = still_q
         slot_choice: List[int] = []
         slot_pods: List[int] = []
         evicted_in_wave: set = set()
@@ -182,6 +254,14 @@ def greedy_replay(
                 placed_total += 1
                 if completions_chunk_waves:
                     bind_chunk[p] = wi // completions_chunk_waves
+            elif (
+                retry_buffer
+                and g == PAD
+                and len(retry_q) < retry_buffer
+            ):
+                # Failed non-gang pod enters the retry buffer (slot
+                # order within the wave; overflow drops the newest).
+                retry_q.append(p)
     wall = time.perf_counter() - t0
     to_schedule = int((ep.bound_node == PAD).sum())
     util = {}

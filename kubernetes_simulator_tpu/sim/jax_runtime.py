@@ -307,16 +307,21 @@ def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepS
 
 def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray,
                     ev_node: np.ndarray, ev_tier: np.ndarray,
-                    pod_tier: np.ndarray, nongang: np.ndarray) -> None:
+                    pod_tier: np.ndarray, nongang: np.ndarray,
+                    released: Optional[np.ndarray] = None) -> None:
     """Reconstruct assignments under tier evictions, in place: walk waves
     in order, unassigning prior-wave lower-tier non-gang victims at each
     eviction event, then applying the wave's choices (in-wave victims are
-    already PAD in the device output). Shared by the replay engine and the
-    what-if collect path."""
+    already PAD in the device output). ``released``: completed pods keep
+    their assignment but can no longer be evicted (their resources are
+    gone — the device tier planes already dropped them). Shared by the
+    replay engine and the what-if collect path."""
     for w in range(idx.shape[0]):
         e = int(ev_node[w])
         if e >= 0:
             vict = (assignments == e) & (pod_tier < int(ev_tier[w])) & nongang
+            if released is not None:
+                vict &= ~released
             assignments[vict] = PAD
         ids = idx[w]
         ok = ids >= 0
@@ -398,27 +403,17 @@ class JaxReplayEngine:
         placed pods whose ``arrival + duration`` is at or before the chunk
         start release their resources and count contributions (host-computed
         delta planes subtracted from the carry). Active when the trace has
-        finite durations; not supported together with ``preemption`` (tier
-        planes cannot attribute releases) — preemption keeps the
-        no-completions semantics."""
+        finite durations. Works WITH ``preemption`` since round 4: releases
+        also drop the per-tier planes (pod tiers are static), folds run
+        eagerly so eviction events precede the next boundary's release
+        decisions, and evicted pods never release (their assignment is PAD
+        by the time their boundary arrives); completed pods can no longer
+        be evicted. Anchored by
+        ``greedy_replay(preemption=True, completions_chunk_waves=...)``."""
         from ..ops import tpu3 as V3
 
         if preemption and engine != "v3":
             raise ValueError("device preemption requires engine='v3'")
-        if preemption and bool(np.isfinite(pods.duration).any()):
-            # Loud, not silent (round 4): tier preemption cannot honor
-            # completions (phantom counts cannot attribute releases).
-            msg = (
-                "device tier preemption runs ARRIVALS-ONLY: pods with "
-                "finite durations never release resources under "
-                "preemption=True"
-            )
-            if completions is True:
-                raise ValueError(msg)
-            if completions is not False:
-                import warnings
-
-                warnings.warn(msg, stacklevel=2)
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -522,6 +517,37 @@ class JaxReplayEngine:
             delta = V3.DevState3.from_host(
                 used_d, mc_d, aa_d, pw_d, self.ec, self.static3
             )
+            if self.preemption and len(rel_idx):
+                # Tier planes drop completed pods too (pod tiers are
+                # static, so releases ARE attributable — the former
+                # exclusivity only held for evicted pods, which never
+                # release because their assignment is PAD by walk time).
+                # NON-GANG ONLY: the tier planes never accumulate gang
+                # pods (gangs are not evictable — the wave step and
+                # from_host both gate on group_id == PAD), so a gang
+                # completion must not be subtracted from them either.
+                st3 = self.static3
+                ng = self.pods.group_id[rel_idx] == PAD
+                ng_idx = np.asarray(rel_idx)[ng]
+                ng_nodes = np.asarray(rel_nodes)[ng]
+                R, N = self.ec.num_resources, self.ec.num_nodes
+                ut = np.zeros((st3.Tt, R, N), np.float32)
+                nt = np.zeros((st3.Tt, N), np.float32)
+                if ng_idx.size:
+                    t_arr = st3.pod_tier[ng_idx]
+                    np.add.at(nt, (t_arr, ng_nodes), 1.0)
+                    np.add.at(
+                        ut,
+                        (
+                            t_arr[:, None],
+                            np.arange(R)[None, :],
+                            ng_nodes[:, None],
+                        ),
+                        self.pods.requests[ng_idx],
+                    )
+                delta = delta._replace(
+                    used_tier=jnp.asarray(ut), npods_tier=jnp.asarray(nt)
+                )
         else:
             gdom = self._gdom
             delta = T.DevState(
@@ -632,7 +658,6 @@ class JaxReplayEngine:
         )
         completions_on = bool(
             self.completions is not False  # None (the default) = on
-            and not self.preemption
             and np.isfinite(rel_time).any()
         )
         wave_times = (
@@ -641,6 +666,22 @@ class JaxReplayEngine:
             else None
         )
         pending_fold = None  # (rows, choices) of the not-yet-folded chunk
+        nongang = self.pods.group_id == PAD
+        if completions_on and self.preemption:
+            # Completions × preemption (round 4): folds run EAGERLY (the
+            # chunk's eviction events must land in the host bookkeeping
+            # BEFORE the next boundary's release decisions, or a pod the
+            # device evicted would "release" resources it no longer
+            # holds). The one-chunk slack therefore becomes an explicit
+            # bind-chunk check instead of a fold lag; the pipeline eats
+            # one blocking fetch per chunk — correctness over overlap for
+            # this opt-in combination.
+            W_ = idx.shape[1]
+            flat = idx.reshape(-1)
+            v = flat >= 0
+            chunk_of_arr = np.full(self.pods.num_pods, 1 << 30, np.int64)
+            chunk_of_arr[flat[v]] = np.nonzero(v)[0] // (C * W_)
+            chunk_of_arr[self.pods.bound_node >= 0] = -2
         if completions_on:
             host_assign = np.where(
                 self.pods.bound_node >= 0, self.pods.bound_node, PAD
@@ -694,14 +735,30 @@ class JaxReplayEngine:
                     self._apply_node_events(due, saved_alloc)
                     pending_events = pending_events[len(due):]
             if completions_on:
+                if self.preemption and pending_fold is not None:
+                    # Eager eviction-aware fold of the previous chunk.
+                    rows_p, out_p = pending_fold
+                    preemption_walk(
+                        host_assign, rows_p,
+                        np.asarray(out_p[0]).reshape(rows_p.shape),
+                        np.asarray(out_p[1]), np.asarray(out_p[2]),
+                        self.static3.pod_tier, nongang,
+                        released=released,
+                    )
+                    pending_fold = None
                 t_chunk = wave_times[c0]
                 if np.isfinite(t_chunk):
-                    due_p = np.nonzero(
+                    due_m = (
                         (host_assign != PAD)
                         & ~released
                         & np.isfinite(rel_time)
                         & (rel_time <= t_chunk)
-                    )[0]
+                    )
+                    if self.preemption:
+                        # Folds are eager here, so the one-chunk slack
+                        # is the explicit bind-chunk rule.
+                        due_m &= chunk_of_arr < ci - 1
+                    due_p = np.nonzero(due_m)[0]
                     if due_p.size:
                         state = self._apply_release(
                             state, due_p, host_assign[due_p]
@@ -717,7 +774,9 @@ class JaxReplayEngine:
                     self.dc, state, T.gather_slots(self.pods, idx[c0 : c0 + C])
                 )
             all_choices.append(choices)
-            if completions_on:
+            if completions_on and self.preemption:
+                pending_fold = (idx[c0 : c0 + C], choices)
+            elif completions_on:
                 # Fold the PREVIOUS chunk's choices AFTER dispatching this
                 # one: the blocking fetch overlaps the in-flight chunk, and
                 # boundary b only ever sees chunks ≤ b−2 (the one-chunk
@@ -744,7 +803,28 @@ class JaxReplayEngine:
 
         preemptions = 0
         to_schedule = int((idx >= 0).sum())
-        if self.preemption:
+        if self.preemption and completions_on:
+            # The incremental eviction-aware folds ARE the walk; finish
+            # the last pending chunk and read the result off the host
+            # bookkeeping (a fresh full walk would replay evictions
+            # against completed pods with the wrong interleaving).
+            if pending_fold is not None:
+                rows_p, out_p = pending_fold
+                preemption_walk(
+                    host_assign, rows_p,
+                    np.asarray(out_p[0]).reshape(rows_p.shape),
+                    np.asarray(out_p[1]), np.asarray(out_p[2]),
+                    self.static3.pod_tier, nongang, released=released,
+                )
+            assignments = host_assign
+            scheduled = self.pods.bound_node == PAD
+            placed = int((assignments[scheduled] >= 0).sum())
+            preemptions = int(
+                np.concatenate(
+                    [np.asarray(c[4]) for c in all_choices]
+                ).sum()
+            )
+        elif self.preemption:
             finals = np.concatenate([np.asarray(c[0]) for c in all_choices])
             ev_node = np.concatenate([np.asarray(c[1]) for c in all_choices])
             ev_tier = np.concatenate([np.asarray(c[2]) for c in all_choices])

@@ -429,6 +429,7 @@ class WhatIfEngine:
         fork_checkpoint: Optional[str] = None,
         preemption: bool = False,
         completions: Optional[bool] = None,
+        retry_buffer: int = 0,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -446,7 +447,19 @@ class WhatIfEngine:
         WARNS and reverts to arrivals-only semantics — pass an explicit
         ``completions=True`` to get a ``ValueError`` instead, or read
         ``WhatIfResult.completions_on``. A trace with no finite durations
-        runs arrivals-only silently (the semantics are identical)."""
+        runs arrivals-only silently (the semantics are identical).
+
+        ``retry_buffer`` (round 4): device-path unschedulable RETRY — the
+        [K8S] activeQ flush-on-event analogue. Non-gang pods that miss
+        placement enter a per-scenario FIFO buffer (capacity rounded up
+        to a wave multiple; overflow drops the newest); at every chunk
+        boundary, after releases apply, one bounded retry pass re-runs
+        the normal wave step over the buffer. Pods placed on retry start
+        AT THE BOUNDARY: they release at the first boundary whose start
+        time reaches ``t_b + duration`` (f32), at least ``b+1``, via a
+        pending list capped at the same size. Semantics anchored by
+        ``greedy_replay(retry_buffer=...)``. Requires the device-release
+        completions path; 0 = off (the r01–r03 semantics)."""
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -601,11 +614,27 @@ class WhatIfEngine:
             and not self.static3.maintain_anti
             and not self.static3.maintain_pref
         )
+        self.retry_buffer = int(retry_buffer)
+        if self.retry_buffer:
+            # Round up to a wave multiple (the retry pass reuses the
+            # normal W-wide wave step).
+            self.retry_buffer = (
+                -(-self.retry_buffer // wave_width) * wave_width
+            )
+            if not self._completions_dev:
+                raise ValueError(
+                    "retry_buffer requires the device-release completions "
+                    "path (v3 engine, finite durations, no mesh/"
+                    "collect_assignments/preemption/fork/label-"
+                    "perturbation, single-topology trace)"
+                )
         # Host-side completions need per-scenario choices even when the
         # caller only wants counts; the device path never fetches them.
         self._need_choices = collect_assignments or (
             self.completions_on and not self._completions_dev
         )
+        self._rel_fn_cache: Dict[int, Callable] = {}
+        self._dev_rel_stage: Optional[dict] = None
         self._chunk_fn = self._build_chunk_fn()
         # Device-resident slot sources (one upload per engine): the chunk
         # loop then gathers rows on device — see ops.tpu.SlotSource.
@@ -687,51 +716,37 @@ class WhatIfEngine:
                     st3_l, sh3_l = st3, sh3
                     Dcap = st3.Dcap
 
-                    def per_scenario_rel(
-                        dc, state, src, xsrc, rel_ids, rel_req, rel_matched,
-                        idx, assign,
-                    ):
-                        # --- boundary releases, entirely on device ------
-                        # Only THIS boundary's release set: a pod's first
-                        # eligible boundary max(elig_b, chunk_of+2) is
-                        # STATIC (wave packing fixes chunk_of; durations
-                        # fix elig_b), so the per-boundary work is
-                        # O(S·K_b) gathers/scatters instead of the former
-                        # O(S·P) full-pod-axis pass — ~30× less release
-                        # work over a north-star run. The only dynamic
-                        # input is whether the pod was actually placed
-                        # (assign ≥ 0); each pod appears in exactly one
-                        # boundary's list, so no released mask is needed.
-                        P = assign.shape[0]
+                    def release(state, nodes, due, reqs, mgs):
+                        """Subtract ``due`` pods' resource rows + matched
+                        count-group contributions (the device twin of the
+                        host release_delta, K-sized)."""
                         N = state.used.shape[1]
-                        safe = jnp.where(rel_ids < P, rel_ids, 0)
-                        node_k = assign[safe]  # [K]
-                        due = (rel_ids < P) & (node_k >= 0)
-                        # Masked-out entries use a PAST-THE-END index: with
-                        # mode="drop" only genuinely out-of-bounds indices
-                        # are dropped — negative ones WRAP first (NumPy
-                        # semantics) and would corrupt the last element.
-                        amask = jnp.where(due, node_k, N)
+                        # Masked-out entries use a PAST-THE-END index:
+                        # with mode="drop" only genuinely out-of-bounds
+                        # indices are dropped — negative ones WRAP first
+                        # (NumPy semantics) and would corrupt the last
+                        # element.
+                        amask = jnp.where(due, nodes, N)
                         R = state.used.shape[0]
                         used = jnp.stack([
                             state.used[r].at[amask].add(
-                                -jnp.where(due, rel_req[:, r], 0.0),
+                                -jnp.where(due, reqs[:, r], 0.0),
                                 mode="drop",
                             )
                             for r in range(R)
                         ])
-                        dom = sh3_l.topo1_f[jnp.clip(node_k, 0)].astype(
+                        dom = sh3_l.topo1_f[jnp.clip(nodes, 0)].astype(
                             jnp.int32
                         )
                         ok = due & (dom >= 0)
                         mc_flat = state.mc_dom.reshape(-1)
                         G = state.match_total.shape[0]
                         mt = state.match_total
-                        for m in range(rel_matched.shape[1]):
-                            g = rel_matched[:, m]
-                            # has_dom_g: a matched group WITHOUT a topology
-                            # never held a count (the host release_delta's
-                            # dom[g] >= 0 guard).
+                        for m in range(mgs.shape[1]):
+                            g = mgs[:, m]
+                            # has_dom_g: a matched group WITHOUT a
+                            # topology never held a count (the host
+                            # release_delta's dom[g] >= 0 guard).
                             valid = ok & (g >= 0) & (
                                 sh3_l.has_dom_g[jnp.clip(g, 0)] > 0.5
                             )
@@ -741,31 +756,193 @@ class WhatIfEngine:
                             mt = mt.at[jnp.where(valid, g, G)].add(
                                 -1.0, mode="drop"
                             )
-                        state = state._replace(
+                        return state._replace(
                             used=used,
                             mc_dom=mc_flat.reshape(state.mc_dom.shape),
                             match_total=mt,
                         )
-                        # --- the normal chunk scan ----------------------
+
+                    def per_scenario_rel(
+                        dc, state, src, xsrc, idx, b, vassign,
+                    ):
+                        # Static releases run in the separate bucketed
+                        # _release_fn BEFORE this call (ordering by data
+                        # dependency on state/vassign). Here: the normal
+                        # chunk scan + the WAVE-ORDER assignment fold —
+                        # a dynamic_update_slice (pure DMA), not a
+                        # [C·W]-index scatter: choices land at their flat
+                        # wave positions, which is exactly how the static
+                        # release lists address them (rel_pos).
                         state, out = per_scenario_src(
                             dc, state, src, xsrc, idx
                         )
-                        # --- fold this chunk's placements on device -----
                         choices, counts = out
-                        flat_i = idx.reshape(-1)
-                        flat_c = choices.reshape(-1)
-                        assign = assign.at[
-                            jnp.where(flat_i >= 0, flat_i, P)
-                        ].set(flat_c, mode="drop")
-                        return state, assign, counts
+                        vassign = jax.lax.dynamic_update_slice(
+                            vassign,
+                            choices.reshape(-1),
+                            (b * idx.size,),
+                        )
+                        return state, vassign, counts
+
+                    if self.retry_buffer:
+                        RB = self.retry_buffer
+                        RBW = RB // wave_width
+                        BIG = 1 << 30
+
+                        def per_scenario_retry(
+                            dc, state, src, xsrc, mgt, durt, tbt,
+                            idx, t_b, b,
+                            vassign, rbuf, rcount,
+                            pend_id, pend_node, pend_relb,
+                        ):
+                            """The device-release chunk call with the
+                            bounded unschedulable-retry pass (semantics:
+                            sim.greedy.greedy_replay(retry_buffer=...)).
+                            Static releases ran in the separate bucketed
+                            _release_fn before this call. Order here:
+                            pend releases → retry pass → buffer
+                            compaction → main chunk scan (with failure
+                            appends) → assignment fold."""
+                            d = T.Derived.build(dc)
+                            cmasks = V3.class_masks(dc, d, st3, spec, reps)
+                            wave_step = V3.make_wave_step3(
+                                dc, d, sh3, st3, wave_width, spec, cmasks
+                            )
+                            # 1. releases of retried-placed pods whose
+                            # boundary arrived (relb encodes the f32 time
+                            # comparison already).
+                            due_p = (pend_id >= 0) & (pend_relb <= b)
+                            reqs_p = src.requests[jnp.clip(pend_id, 0)]
+                            mgs_p = mgt[jnp.clip(pend_id, 0)]
+                            state = release(
+                                state, pend_node, due_p, reqs_p, mgs_p
+                            )
+                            # 2. bounded retry pass: the NORMAL wave step
+                            # over the buffer (empty slots are invalid
+                            # no-ops), FIFO order preserved by the wave
+                            # packing below.
+                            rb_waves = rbuf.reshape(RBW, wave_width)
+                            slots_r = T.gather_slots_device(src, rb_waves)
+                            extra_r = V3.gather_extra_device(xsrc, rb_waves)
+                            state, choices_r = jax.lax.scan(
+                                wave_step, state, (slots_r, extra_r)
+                            )
+                            flat_cr = choices_r.reshape(RB)
+                            placed_r = (flat_cr >= 0) & (rbuf >= 0)
+                            retry_placed = placed_r.sum().astype(jnp.int32)
+                            # 3. pend append (placed pods start NOW: f32
+                            # boundary search, at least b+1) + stable
+                            # compaction, drop-newest on overflow.
+                            dur_r = durt[jnp.clip(rbuf, 0)]
+                            rbn = jnp.searchsorted(
+                                tbt, t_b + dur_r, side="left"
+                            )
+                            relb_new = jnp.where(
+                                placed_r & (rbn < tbt.shape[0]),
+                                jnp.maximum(rbn, b + 1),
+                                BIG,
+                            ).astype(jnp.int32)
+                            add = placed_r & (relb_new < BIG)
+                            keep_old = (pend_id >= 0) & ~due_p
+                            ids_cat = jnp.concatenate([
+                                jnp.where(keep_old, pend_id, -1),
+                                jnp.where(add, rbuf, -1),
+                            ])
+                            node_cat = jnp.concatenate(
+                                [pend_node, flat_cr]
+                            )
+                            relb_cat = jnp.concatenate(
+                                [pend_relb, relb_new]
+                            )
+                            op = jnp.argsort(ids_cat < 0, stable=True)[:RB]
+                            pend_id = jnp.where(
+                                ids_cat[op] >= 0, ids_cat[op], -1
+                            ).astype(jnp.int32)
+                            pend_node = node_cat[op].astype(jnp.int32)
+                            pend_relb = relb_cat[op].astype(jnp.int32)
+                            # 4. rbuf compaction: placed pods leave; the
+                            # rest keep FIFO order.
+                            keep_q = (rbuf >= 0) & (flat_cr < 0)
+                            oq = jnp.argsort(~keep_q, stable=True)
+                            rbuf = jnp.where(
+                                keep_q[oq], rbuf[oq], -1
+                            ).astype(jnp.int32)
+                            rcount = keep_q.sum().astype(jnp.int32)
+                            # 5. main chunk scan with failure appends.
+                            slots = T.gather_slots_device(src, idx)
+                            extra = V3.gather_extra_device(xsrc, idx)
+
+                            def step(carry, xs):
+                                st, rbuf, rcount = carry
+                                slots_w, extra_w, rows = xs
+                                st, choices = wave_step(
+                                    st, (slots_w, extra_w)
+                                )
+                                placed_w = jnp.sum(
+                                    (choices >= 0) & slots_w.valid
+                                ).astype(jnp.int32)
+                                fail = (
+                                    (choices < 0)
+                                    & slots_w.valid
+                                    & (slots_w.group < 0)
+                                )
+                                posk = (
+                                    rcount
+                                    + jnp.cumsum(fail.astype(jnp.int32))
+                                    - 1
+                                )
+                                pos = jnp.where(
+                                    fail & (posk < RB), posk, RB
+                                )
+                                rbuf = rbuf.at[pos].set(rows, mode="drop")
+                                rcount = jnp.minimum(
+                                    rcount + fail.sum(), RB
+                                ).astype(jnp.int32)
+                                return (st, rbuf, rcount), (
+                                    choices, placed_w
+                                )
+
+                            (state, rbuf, rcount), (choices, counts) = (
+                                jax.lax.scan(
+                                    step,
+                                    (state, rbuf, rcount),
+                                    (slots, extra, idx),
+                                )
+                            )
+                            # 6. fold arrival-chunk placements at their
+                            # flat wave positions (retried placements do
+                            # NOT enter vassign: their releases ride pend
+                            # exclusively, and their arrival slot keeps
+                            # PAD so the static entry never fires).
+                            vassign = jax.lax.dynamic_update_slice(
+                                vassign,
+                                choices.reshape(-1),
+                                (b * idx.size,),
+                            )
+                            return (
+                                state, vassign, rbuf, rcount,
+                                pend_id, pend_node, pend_relb,
+                                (counts, retry_placed),
+                            )
+
+                        vmapped_retry = jax.vmap(
+                            per_scenario_retry,
+                            in_axes=(
+                                0, 0, None, None, None, None, None,
+                                None, None, None,
+                                0, 0, 0, 0, 0, 0,
+                            ),
+                        )
+                        return jax.jit(
+                            vmapped_retry,
+                            donate_argnums=(1, 10, 11, 12, 13, 14, 15),
+                        )
 
                     vmapped_rel = jax.vmap(
                         per_scenario_rel,
-                        in_axes=(
-                            0, 0, None, None, None, None, None, None, 0
-                        ),
+                        in_axes=(0, 0, None, None, None, None, 0),
                     )
-                    return jax.jit(vmapped_rel, donate_argnums=(1, 8))
+                    return jax.jit(vmapped_rel, donate_argnums=(1, 6))
                 # vmap matches in_axes against the args actually passed,
                 # so the defaulted dyn arg needs no wrapper.
                 vmapped_src = jax.vmap(
@@ -827,6 +1004,86 @@ class WhatIfEngine:
             out_shardings=(shard, shard),
             donate_argnums=(1,),
         )
+
+    def _release_fn(self, K: int):
+        """Jitted static-release application for a pow2 bucket size K
+        (device-release path). Separate from the chunk program so each
+        boundary pays only its own (bucketed) release-list width instead
+        of the global maximum — the Borg duration distribution makes the
+        max ~2.4× the mean.
+
+        The update is a scan over 256-wide one-hot COMMIT blocks (the
+        wave-commit trick, measured 4×+ faster than a [K]-index scatter
+        on TPU — scatter serializes colliding indices): each block builds
+        the [Wr, N] placement one-hot once and contracts it with both the
+        request rows (→ used delta) and the matched-group matrix (→ a
+        node-space [G, N] released-count accumulator). The count planes
+        then drop to domain space through ONE static node→domain one-hot
+        matmul; match_total is its row sum. Exactness: one-hot operands
+        are 0/1 (each product term exact) and the summed quantities are
+        the bucketed k8s magnitudes the engine already relies on being
+        associative-exact (ops/tpu3.py module docstring)."""
+        fn = self._rel_fn_cache.get(K)
+        if fn is not None:
+            return fn
+        sh3 = self.shared3
+        Dcap = self.static3.Dcap
+        N = self.ec.num_nodes
+        Gr = int(sh3.has_dom_g.shape[0])  # the state planes' group width
+        Wr = min(K, 256)
+        nb = K // Wr
+        # Static node→domain one-hot (scenario-shared), has_dom_g-gated:
+        # rows for dom<0 nodes are all-zero, so entries at domainless
+        # nodes contribute to neither mc_dom nor match_total (the old
+        # scatter's `ok` mask).
+        dom_i = sh3.topo1_f.astype(jnp.int32)  # [N]
+        dom_oh = (
+            (dom_i[:, None] == jnp.arange(Dcap, dtype=jnp.int32)[None, :])
+            & (dom_i[:, None] >= 0)
+        ).astype(jnp.float32)  # [N, Dcap]
+        gate_g = (sh3.has_dom_g > 0.5).astype(jnp.float32)  # [G]
+
+        def rel_one(state, vassign, rel_pos, rel_req, rel_mg):
+            node_k = vassign[rel_pos]  # sentinel pos → the PAD tail slot
+            nd = jnp.where(node_k >= 0, node_k, -1)  # -1 matches no node
+            iota = jnp.arange(N, dtype=jnp.int32)
+            M = rel_mg.shape[1]
+            R = rel_req.shape[1]
+
+            def body(carry, xs):
+                u, rc = carry
+                nd_b, req_b, mg_b = xs  # [Wr], [Wr, R], [Wr, M]
+                oh = (nd_b[:, None] == iota[None, :]).astype(jnp.float32)
+                u = u - jnp.einsum("wn,wr->rn", oh, req_b)
+                mm = (
+                    mg_b[:, :, None]
+                    == jnp.arange(Gr, dtype=jnp.int32)[None, None, :]
+                ).sum(1).astype(jnp.float32)  # [Wr, G]
+                rc = rc + jnp.einsum("wn,wg->gn", oh, mm)
+                return (u, rc), None
+
+            (used, rc), _ = jax.lax.scan(
+                body,
+                (state.used, jnp.zeros((Gr, N), jnp.float32)),
+                (
+                    nd.reshape(nb, Wr),
+                    rel_req.reshape(nb, Wr, R),
+                    rel_mg.reshape(nb, Wr, M),
+                ),
+            )
+            delta = (rc * gate_g[:, None]) @ dom_oh  # [G, Dcap]
+            return state._replace(
+                used=used,
+                mc_dom=state.mc_dom - delta,
+                match_total=state.match_total - delta.sum(-1),
+            )
+
+        fn = jax.jit(
+            jax.vmap(rel_one, in_axes=(0, 0, None, None, None)),
+            donate_argnums=(0,),
+        )
+        self._rel_fn_cache[K] = fn
+        return fn
 
     def _state_proto(self):
         if self.engine == "v3":
@@ -1058,6 +1315,101 @@ class WhatIfEngine:
             x = self._replicate_fn(x)
         return np.asarray(x)
 
+    def _stage_dev_rel(self, idx: np.ndarray, C: int) -> dict:
+        """Host bucketing + device staging for the device-release path —
+        all static per engine (wave packing, durations, chunk layout), so
+        it runs once; repeated run() calls reuse the device arrays."""
+        from ..ops import tpu3 as V3
+
+        P = self.pods.num_pods
+        W = idx.shape[1]
+        nchunks = idx.shape[0] // C
+        flat_all = idx.reshape(-1)
+        vmask = flat_all >= 0
+        # Flat WAVE position per pod — release entries address the
+        # vassign fold by position (static), not by pod id.
+        pos_of = np.full(P, -1, np.int64)
+        pos_of[flat_all[vmask]] = np.nonzero(vmask)[0]
+        chunk_of = np.full(P, 1 << 30, np.int64)
+        chunk_of[flat_all[vmask]] = np.nonzero(vmask)[0] // (C * W)
+        prebound = np.nonzero(self.pods.bound_node >= 0)[0]
+        Wtot = flat_all.shape[0]
+        # Pre-bound pods live in a static tail region of vassign; the
+        # final slot is a dedicated PAD sentinel (padded release entries
+        # point there and read "not placed").
+        chunk_of[prebound] = -2
+        pos_of[prebound] = Wtot + np.arange(prebound.size)
+        SENT = Wtot + prebound.size
+        matched = V3._matched_idx(
+            self.pods.pod_matches_group,
+            np.ones(self.pods.pod_matches_group.shape[1], bool),
+        )
+        if matched.shape[1] == 0:
+            matched = np.full((P, 1), PAD, np.int32)
+        first = idx[:, 0]
+        wave_t = np.where(
+            first >= 0, self.pods.arrival[np.clip(first, 0, None)], np.inf
+        )
+        # First boundary each pod is eligible at, in f64 on host — the
+        # non-finite boundary tail (PAD-only waves) never releases.
+        tb_all = wave_t[0 :: C][:nchunks]
+        nfin = int(np.isfinite(tb_all).sum())
+        elig = np.searchsorted(
+            tb_all[:nfin], self._rel_time, side="left"
+        ).astype(np.int64)
+        elig_ok = np.isfinite(self._rel_time) & (elig < nfin)
+        # The boundary each pod releases at is STATIC: first boundary ≥
+        # its eligibility that also respects the one-chunk slack (chunks
+        # ≤ b−2 folded). Bucket pods per boundary on host so the device
+        # touches only that boundary's K_b pods (padded to a pow2
+        # bucket, NOT the global max — the Borg duration skew makes the
+        # max ~2.4× the mean).
+        b_rel = np.maximum(elig, chunk_of + 2)
+        ok = elig_ok & (b_rel < nchunks) & (pos_of >= 0)
+        pods_ok = np.nonzero(ok)[0].astype(np.int64)
+        b_ok = b_rel[pods_ok]
+        order = np.lexsort((pods_ok, b_ok))
+        pods_s = pods_ok[order]
+        b_s = b_ok[order]
+        counts = np.bincount(b_s, minlength=nchunks)
+        starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        R = self.ec.num_resources
+        Mm = matched.shape[1]
+        rel_calls = []  # per boundary: None | (pos, req, mg) device
+        for bb in range(nchunks):
+            k = int(counts[bb])
+            if k == 0:
+                rel_calls.append(None)
+                continue
+            Kp = 1 << max(12, (k - 1).bit_length())
+            seg = pods_s[starts[bb] : starts[bb] + k]
+            posb = np.full(Kp, SENT, np.int64)
+            posb[:k] = pos_of[seg]
+            reqb = np.zeros((Kp, R), np.float32)
+            reqb[:k] = self.pods.requests[seg]
+            mgb = np.full((Kp, Mm), PAD, np.int32)
+            mgb[:k] = matched[seg]
+            rel_calls.append((
+                jnp.asarray(posb.astype(np.int32)),
+                jnp.asarray(reqb),
+                jnp.asarray(mgb),
+            ))
+        va = np.full(Wtot + prebound.size + 1, PAD, np.int32)
+        va[Wtot : Wtot + prebound.size] = self.pods.bound_node[prebound]
+        stg = {
+            "rel_calls": rel_calls,
+            "b_c": [jnp.asarray(np.int32(bb)) for bb in range(nchunks)],
+            "va": jnp.asarray(va),
+        }
+        if self.retry_buffer:
+            stg["mgt"] = jnp.asarray(matched.astype(np.int32))
+            stg["durt"] = jnp.asarray(self.pods.duration.astype(np.float32))
+            stg["tbt"] = jnp.asarray(tb_all[:nfin].astype(np.float32))
+            stg["tb_c"] = [
+                jnp.asarray(np.float32(tb_all[b])) for b in range(nchunks)
+            ]
+        return stg
+
     def run(self) -> WhatIfResult:
         states = self._init_states()  # sets fork bookkeeping first
         idx = self.waves.idx
@@ -1076,68 +1428,28 @@ class WhatIfEngine:
         comp_on = self.completions_on and not self._completions_dev
         dev_rel = self._completions_dev
         if dev_rel:
-            from ..ops import tpu3 as V3
-
-            P = self.pods.num_pods
-            nchunks = idx.shape[0] // C
-            chunk_of = np.full(P, 1 << 30, np.int64)
-            for cj in range(nchunks):
-                rows = idx[cj * C : (cj + 1) * C]
-                chunk_of[rows[rows >= 0]] = cj
-            chunk_of[self.pods.bound_node >= 0] = -2
-            matched = V3._matched_idx(
-                self.pods.pod_matches_group,
-                np.ones(self.pods.pod_matches_group.shape[1], bool),
-            )
-            if matched.shape[1] == 0:
-                matched = np.full((P, 1), PAD, np.int32)
-            first = idx[:, 0]
-            wave_t = np.where(
-                first >= 0, self.pods.arrival[np.clip(first, 0, None)], np.inf
-            )
-            # First boundary each pod is eligible at, in f64 on host — the
-            # non-finite boundary tail (PAD-only waves) never releases.
-            tb_all = wave_t[0 :: C][:nchunks]
-            nfin = int(np.isfinite(tb_all).sum())
-            elig = np.searchsorted(
-                tb_all[:nfin], self._rel_time, side="left"
-            ).astype(np.int64)
-            elig_ok = np.isfinite(self._rel_time) & (elig < nfin)
-            # The boundary each pod releases at is STATIC: first boundary
-            # ≥ its eligibility that also respects the one-chunk slack
-            # (chunks ≤ b−2 folded). Bucket pods per boundary on host so
-            # the device touches only that boundary's K_b pods.
-            b_rel = np.maximum(elig, chunk_of + 2)
-            ok = elig_ok & (b_rel < nchunks)
-            pods_ok = np.nonzero(ok)[0].astype(np.int64)
-            b_ok = b_rel[pods_ok]
-            order = np.lexsort((pods_ok, b_ok))
-            pods_s = pods_ok[order]
-            b_s = b_ok[order]
-            counts = np.bincount(b_s, minlength=nchunks)
-            Kmax = max(int(counts.max(initial=0)), 1)
-            starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
-            pos = np.arange(len(pods_s)) - starts[b_s]
-            R = self.ec.num_resources
-            M = matched.shape[1]
-            rel_ids_np = np.full((nchunks, Kmax), P, np.int32)
-            rel_req_np = np.zeros((nchunks, Kmax, R), np.float32)
-            rel_mg_np = np.full((nchunks, Kmax, M), PAD, np.int32)
-            rel_ids_np[b_s, pos] = pods_s
-            rel_req_np[b_s, pos] = self.pods.requests[pods_s]
-            rel_mg_np[b_s, pos] = matched[pods_s]
-            rel_ids_c = [jnp.asarray(rel_ids_np[b]) for b in range(nchunks)]
-            rel_req_c = [jnp.asarray(rel_req_np[b]) for b in range(nchunks)]
-            rel_mg_c = [jnp.asarray(rel_mg_np[b]) for b in range(nchunks)]
-            assign_d = jax.jit(
+            # Everything here is static per engine — staged ONCE and
+            # cached (a second run() pays zero host bucketing/upload).
+            if self._dev_rel_stage is None:
+                self._dev_rel_stage = self._stage_dev_rel(idx, C)
+            stg = self._dev_rel_stage
+            rel_calls, b_c = stg["rel_calls"], stg["b_c"]
+            # vassign is donated through the chunk calls — fresh per run.
+            vassign_d = jax.jit(
                 lambda a: jnp.broadcast_to(a[None], (self.S,) + a.shape)
-            )(
-                jnp.asarray(
-                    np.where(
-                        self.pods.bound_node >= 0, self.pods.bound_node, PAD
-                    ).astype(np.int32)
+            )(stg["va"])
+            if self.retry_buffer:
+                RB = self.retry_buffer
+                mgt_d, durt_d = stg["mgt"], stg["durt"]
+                tbt_d, tb_c = stg["tbt"], stg["tb_c"]
+                zs = lambda fill, dt: jnp.full(
+                    (self.S, RB), fill, dtype=dt
                 )
-            )
+                rbuf_d = zs(PAD, jnp.int32)
+                rcount_d = jnp.zeros(self.S, jnp.int32)
+                pend_id_d = zs(PAD, jnp.int32)
+                pend_node_d = zs(PAD, jnp.int32)
+                pend_relb_d = zs(0, jnp.int32)
         pending_fold = None  # (rows, choices) of the not-yet-folded chunk
         if comp_on:
             first = idx[:, 0]
@@ -1242,9 +1554,27 @@ class WhatIfEngine:
                         states, host_assign, released, t_chunk
                     )
             if dev_rel:
-                states, assign_d, out = self._chunk_fn(
-                    dc, states, srcs[0], srcs[1], rel_ids_c[ci],
-                    rel_req_c[ci], rel_mg_c[ci], idx_chunks[ci], assign_d,
+                # Static releases first (the bucketed fn; ordering is by
+                # data dependency on states/vassign), then the chunk.
+                rc = rel_calls[ci]
+                if rc is not None:
+                    states = self._release_fn(rc[0].shape[0])(
+                        states, vassign_d, *rc
+                    )
+            if dev_rel and self.retry_buffer:
+                (
+                    states, vassign_d, rbuf_d, rcount_d,
+                    pend_id_d, pend_node_d, pend_relb_d, out,
+                ) = self._chunk_fn(
+                    dc, states, srcs[0], srcs[1], mgt_d, durt_d, tbt_d,
+                    idx_chunks[ci], tb_c[ci], b_c[ci],
+                    vassign_d, rbuf_d, rcount_d,
+                    pend_id_d, pend_node_d, pend_relb_d,
+                )
+            elif dev_rel:
+                states, vassign_d, out = self._chunk_fn(
+                    dc, states, srcs[0], srcs[1], idx_chunks[ci],
+                    b_c[ci], vassign_d,
                 )
             elif self.mesh is None and self.engine == "v3" and srcs is not None:
                 # Fused device-side gather + wave scan: one dispatch per
@@ -1331,6 +1661,21 @@ class WhatIfEngine:
                     .sum(axis=1)
                     .astype(np.int32)
                 )
+            elif self.retry_buffer:
+                # (counts [S, C], retry_placed [S]) per chunk: placements
+                # from arrival waves plus boundary retry passes.
+                placed = self._fetch(
+                    jax.jit(
+                        lambda o: (
+                            jnp.concatenate(
+                                [c for c, _ in o], axis=1
+                            ).sum(axis=1, dtype=jnp.int32)
+                            + jnp.stack([r for _, r in o], axis=1).sum(
+                                axis=1, dtype=jnp.int32
+                            )
+                        )
+                    )(outs)
+                ).astype(np.int32)
             else:
                 # Device-side reduce, ONE small D2H: per-array np.asarray
                 # round-trips through the tunneled device add seconds.

@@ -88,6 +88,11 @@ class WhatIfSpec:
     node_down_p: float = 0.02
     capacity_p: float = 0.3
     taint_p: float = 0.1
+    # None = default-on completions (warn when unhonorable); True/False
+    # are the explicit forms (sim.whatif.WhatIfEngine docstring).
+    completions: object = None
+    # Device-path unschedulable retry buffer width (0 = off).
+    retry_buffer: int = 0
 
 
 @dataclass
@@ -162,6 +167,8 @@ class SimConfig:
             node_down_p=float(wi.get("nodeDownP", 0.02)),
             capacity_p=float(wi.get("capacityP", 0.3)),
             taint_p=float(wi.get("taintP", 0.1)),
+            completions=wi.get("completions"),
+            retry_buffer=int(wi.get("retryBuffer", 0)),
         )
         cfg.output = d.get("output")
         ww = d.get("waveWidth", 8)

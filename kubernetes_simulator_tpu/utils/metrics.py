@@ -54,6 +54,8 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
         "total_placed": res.total_placed,
         "wall_clock_s": round(res.wall_clock_s, 4),
         "placements_per_sec": round(res.placements_per_sec, 1),
+        "completions_on": bool(res.completions_on),
+        "engine": res.engine,
         **base,
     }
     for s in range(res.placed.shape[0]):

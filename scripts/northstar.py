@@ -2,11 +2,24 @@
 tasks x S what-if scenarios on one chip. The v5e-8 projection is this slice
 at S_total = 8 x S with scenario data-parallelism over the mesh.
 
-Env knobs: NS_NODES, NS_TASKS, NS_S, NS_WAVE, NS_CHUNK.
+Since round 4 the protocol reports BOTH semantics:
+- completions ON (the HEADLINE: the framework's default-on L4 semantics —
+  placed pods with finite durations release capacity at chunk boundaries);
+- arrivals-only (completions=False — the r01-r03 protocol, kept for
+  cross-round continuity).
+
+Env knobs: NS_NODES, NS_TASKS, NS_S, NS_WAVE, NS_CHUNK, NS_WARMUP,
+NS_MODE=both|completions|arrivals, NS_RETRY (retry-buffer width for the
+completions run; 0 = off).
 """
 
 import os
+import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
 
@@ -17,42 +30,61 @@ from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
 from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
 
 
-def main():
-    nodes = int(os.environ.get("NS_NODES", 10_000))
-    tasks = int(os.environ.get("NS_TASKS", 1_000_000))
-    S = int(os.environ.get("NS_S", 128))
-    wave = int(os.environ.get("NS_WAVE", 8))
-    chunk = int(os.environ.get("NS_CHUNK", 2048))
-
-    t0 = time.perf_counter()
-    ec, ep, _ = make_borg_encoded(BorgSpec(nodes=nodes, tasks=tasks, seed=0))
-    print(f"trace gen: {time.perf_counter() - t0:.1f}s", flush=True)
-
-    scenarios = uniform_scenarios(ec, S, seed=0)
-    # completions=False: the north-star protocol is the reference's
-    # what-if semantics (scenario evaluation over arrivals only) — the
-    # same workload every prior round measured. Completions-on cost is
-    # tracked separately (COVERAGE.md; target ≤1.3× of off).
+def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0):
+    kw = dict(retry_buffer=retry) if retry else {}
     eng = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), wave_width=wave,
-        chunk_waves=chunk, completions=os.environ.get("NS_COMPLETIONS") == "1",
+        chunk_waves=chunk, completions=completions, **kw,
     )
-    print(f"engine: {eng.engine}", flush=True)
+    tag = "completions" if completions else "arrivals-only"
+    if retry:
+        tag += f"+retry{retry}"
+    print(f"[{tag}] engine: {eng.engine}", flush=True)
     if os.environ.get("NS_WARMUP", "1") not in ("", "0"):
         t0 = time.perf_counter()
         eng.run()
-        print(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s", flush=True)
+        print(
+            f"[{tag}] warmup (incl. compile): {time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
     t0 = time.perf_counter()
     res = eng.run()
     wall = time.perf_counter() - t0
     placed = int(res.placed.sum())
     attempts = S * tasks
     print(
-        f"S={S} N={nodes} P={tasks} W={wave} C={chunk}: wall={wall:.1f}s "
-        f"placed={placed} attempts/s={attempts / wall / 1e6:.3f}M "
-        f"placements/s={placed / wall / 1e6:.3f}M",
+        f"[{tag}] S={S} N={ec.num_nodes} P={tasks} W={wave} C={chunk}: "
+        f"wall={wall:.1f}s placed={placed} "
+        f"attempts/s={attempts / wall / 1e6:.3f}M "
+        f"placements/s={placed / wall / 1e6:.3f}M "
+        f"completions_on={res.completions_on}",
         flush=True,
     )
+    return wall
+
+
+def main():
+    nodes = int(os.environ.get("NS_NODES", 10_000))
+    tasks = int(os.environ.get("NS_TASKS", 1_000_000))
+    S = int(os.environ.get("NS_S", 128))
+    wave = int(os.environ.get("NS_WAVE", 8))
+    chunk = int(os.environ.get("NS_CHUNK", 4096))
+    mode = os.environ.get("NS_MODE", "both")
+    retry = int(os.environ.get("NS_RETRY", 0))
+    if os.environ.get("NS_COMPLETIONS") == "1":  # r03 compat spelling
+        mode = "completions"
+    elif os.environ.get("NS_COMPLETIONS") == "0":
+        mode = "arrivals"
+
+    t0 = time.perf_counter()
+    ec, ep, _ = make_borg_encoded(BorgSpec(nodes=nodes, tasks=tasks, seed=0))
+    print(f"trace gen: {time.perf_counter() - t0:.1f}s", flush=True)
+    scenarios = uniform_scenarios(ec, S, seed=0)
+
+    if mode in ("both", "completions"):
+        run_mode(ec, ep, scenarios, S, tasks, wave, chunk, True, retry)
+    if mode in ("both", "arrivals"):
+        run_mode(ec, ep, scenarios, S, tasks, wave, chunk, False)
 
 
 if __name__ == "__main__":

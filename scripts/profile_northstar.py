@@ -16,6 +16,11 @@ Env knobs: NS_NODES, NS_TASKS, NS_S, NS_WAVE, NS_CHUNK, PROFILE_DIR,
 PROFILE_CHUNKS (how many chunks to run under the trace).
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import gzip
 import json
 import os

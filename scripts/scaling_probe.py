@@ -1,5 +1,11 @@
 """Perf probe: how does per-pod step cost scale with S (scenarios) and N
 (nodes)? Finds whether the wave scan is latency- or compute-bound."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import time
 
 import numpy as np

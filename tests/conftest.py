@@ -18,3 +18,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache for the suite: the tests compile the
+# same chunk programs every run, and compile time dominates the wall
+# (round-4 task: default suite under its 5-minute claim). Keyed by HLO,
+# so code changes miss cleanly; KSIM_COMPILE_CACHE=0 opts out.
+from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
+
+_cc()
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

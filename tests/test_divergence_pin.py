@@ -1,0 +1,50 @@
+"""Chunk-granularity divergence pin at PRODUCTION chunk sizes (round 4;
+SURVEY.md §4.3 determinism row, VERDICT r2 task #8 / r3 #4): the device
+engine's chunk-boundary completions vs the CPU event engine's
+exact-timestamp semantics, measured as a placed-count bound on a
+completion-heavy Borg-shaped trace whose duration/chunk-span AND
+per-node-contention ratios match the production (north-star) regime.
+
+Measured 2026-07-30 (CPU event engine = exact reference):
+- 1250 nodes × 65536 tasks, mean_duration 28800 s (duration = 1.33×
+  chunk span), C=2048 (4 chunks): gap 0.00% (65536/65536, retry on or
+  off); C=4096 (2 chunks): gap 0.53% (65187).
+- 1250 nodes × 32768 tasks, mean_duration 57600 s, C=2048 (2 chunks):
+  gap 0.00% — the shape asserted below (CPU engine ~150 s).
+- Cautionary negative shape: durations ≪ chunk span (100 nodes,
+  duration 19 s vs 410 s span) batches all releases at a few boundaries
+  and arrival-order greedy drops 89% of placements — granular
+  completions need chunk span ≲ mean duration; see COVERAGE.md.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
+from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
+from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+
+@pytest.mark.slow
+def test_chunk_granularity_divergence_production_chunks():
+    ec, ep, _ = make_borg_encoded(
+        BorgSpec(nodes=1250, tasks=32_768, seed=0, mean_duration=57_600.0)
+    )
+    cfg = FrameworkConfig()
+    cpu = CpuReplayEngine(ec, ep, cfg).replay()
+    assert cpu.placed > 0
+
+    res = WhatIfEngine(ec, ep, [Scenario()], cfg, chunk_waves=2048).run()
+    assert res.completions_on
+    gap = abs(int(res.placed[0]) - cpu.placed) / cpu.placed
+    # The coarseness is a NUMBER, not a vibe (measured 0.00% here; the
+    # bound is deliberately loose against generator drift).
+    assert gap <= 0.05, (gap, int(res.placed[0]), cpu.placed)
+
+    # Retry at release boundaries only closes the gap further.
+    rb = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, chunk_waves=2048, retry_buffer=2048
+    ).run()
+    gap_rb = abs(int(rb.placed[0]) - cpu.placed) / cpu.placed
+    assert gap_rb <= gap + 1e-9, (gap_rb, gap)

@@ -1,0 +1,208 @@
+"""Device-path unschedulable RETRY at release boundaries (round 4;
+SURVEY.md §2 L3 — the [K8S] activeQ flush-on-event analogue for the
+arrival-order device engine). Anchor = greedy_replay(retry_buffer=...);
+the device twin is WhatIfEngine(retry_buffer=...)'s bounded boundary
+retry pass."""
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.sim.greedy import greedy_replay
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+
+def test_retry_places_after_release_tiny():
+    # b fails while a holds the only cpu; a's completion frees it at a
+    # boundary and the retry pass places b. Without retry b stays
+    # unscheduled forever (the r01-r03 device semantics).
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod("a", requests={"cpu": 1}, arrival_time=0.0, duration=3.0),
+        Pod("b", requests={"cpu": 1}, arrival_time=1.0),
+        Pod("f1", requests={}, arrival_time=6.0),
+        Pod("f2", requests={}, arrival_time=8.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=1, completions_chunk_waves=1, retry_buffer=1
+    )
+    assert anchor.assignments[1] == 0  # b placed on retry
+    assert anchor.placed == 4
+    eng = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=1, chunk_waves=1,
+        retry_buffer=1,
+    )
+    res = eng.run()
+    assert int(res.placed[0]) == anchor.placed
+    no_retry = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=1, chunk_waves=1
+    ).run()
+    assert int(no_retry.placed[0]) == 3  # b permanently missed
+
+
+def test_retry_parity_random_contended():
+    """Contended workload (tight capacity, short durations): device placed
+    counts must equal the anchor's, scenario by scenario, and retry must
+    place strictly more than no-retry (non-vacuous)."""
+    cluster = make_cluster(3, seed=11)
+    pods, _ = make_workload(
+        120, seed=11, arrival_rate=60.0, duration_mean=1.5,
+        with_spread=True, with_tolerations=True,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    W, C, RB = 4, 4, 8
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=W, completions_chunk_waves=C,
+        retry_buffer=RB,
+    )
+    eng = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=W, chunk_waves=C,
+        retry_buffer=RB,
+    )
+    assert eng._completions_dev
+    res = eng.run()
+    assert int(res.placed[0]) == anchor.placed
+    no_retry = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=W, chunk_waves=C
+    ).run()
+    assert anchor.placed > int(no_retry.placed[0])
+    # The anchor's retried pods really are late placements, not arrivals.
+    base = greedy_replay(
+        ec, ep, cfg, wave_width=W, completions_chunk_waves=C
+    )
+    retried = (anchor.assignments >= 0) & (base.assignments == PAD)
+    assert retried.any()
+
+
+def test_retry_buffer_overflow_drops_newest():
+    """With a 1-slot buffer only the FIRST failed pod retries; the rest
+    stay permanently unscheduled — device and anchor agree."""
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod("a", requests={"cpu": 1}, arrival_time=0.0, duration=2.0),
+        Pod("b", requests={"cpu": 1}, arrival_time=0.5, duration=100.0),
+        Pod("c", requests={"cpu": 1}, arrival_time=0.6, duration=100.0),
+        Pod("f1", requests={}, arrival_time=5.0),
+        Pod("f2", requests={}, arrival_time=8.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=1, completions_chunk_waves=1, retry_buffer=1
+    )
+    # b took the only buffer slot; c was dropped.
+    assert anchor.assignments[1] == 0 and anchor.assignments[2] == PAD
+    eng = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=1, chunk_waves=1,
+        retry_buffer=1,
+    )
+    res = eng.run()
+    assert int(res.placed[0]) == anchor.placed == 4
+
+
+def test_retry_placed_pod_releases_later():
+    """A pod placed on retry starts AT the boundary and must itself
+    release t_b + duration later, freeing capacity for a third pod —
+    pinned against the anchor's pending-release bookkeeping."""
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod("a", requests={"cpu": 1}, arrival_time=0.0, duration=2.0),
+        Pod("b", requests={"cpu": 1}, arrival_time=0.5, duration=1.0),
+        Pod("f1", requests={}, arrival_time=4.0),
+        Pod("f2", requests={}, arrival_time=6.0),
+        # b retried ~t=4, releases by t=6+; c then fits via retry too.
+        Pod("c", requests={"cpu": 1}, arrival_time=5.0),
+        Pod("f3", requests={}, arrival_time=8.0),
+        Pod("f4", requests={}, arrival_time=10.0),
+        Pod("f5", requests={}, arrival_time=12.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=1, completions_chunk_waves=1, retry_buffer=2
+    )
+    assert anchor.assignments[1] == 0 and anchor.assignments[4] == 0
+    eng = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=1, chunk_waves=1,
+        retry_buffer=2,
+    )
+    res = eng.run()
+    assert int(res.placed[0]) == anchor.placed
+
+
+def test_retry_requires_device_release_path():
+    cluster = make_cluster(4, seed=0)
+    pods, _ = make_workload(16, seed=0)  # no durations
+    ec, ep = encode(cluster, pods)
+    with pytest.raises(ValueError, match="retry_buffer requires"):
+        WhatIfEngine(
+            ec, ep, [Scenario()], FrameworkConfig(), retry_buffer=8
+        )
+
+
+def test_retry_gang_pods_excluded():
+    """Gang pods never enter the retry buffer (all-or-nothing groups
+    cannot re-commit individually) — device and anchor agree."""
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 2})])
+    pods = [
+        Pod("a", requests={"cpu": 2}, arrival_time=0.0, duration=2.0),
+        Pod("g0", requests={"cpu": 1}, arrival_time=0.5, pod_group="g"),
+        Pod("g1", requests={"cpu": 1}, arrival_time=0.5, pod_group="g"),
+        Pod("s", requests={"cpu": 1}, arrival_time=0.7),
+        Pod("f1", requests={}, arrival_time=5.0),
+        Pod("f2", requests={}, arrival_time=8.0),
+        Pod("f3", requests={}, arrival_time=10.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=2, completions_chunk_waves=1, retry_buffer=2
+    )
+    # s retried and placed; the gang stays unplaced (never buffered).
+    assert anchor.assignments[3] == 0
+    assert anchor.assignments[1] == PAD and anchor.assignments[2] == PAD
+    eng = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=2, chunk_waves=1,
+        retry_buffer=2,
+    )
+    res = eng.run()
+    assert int(res.placed[0]) == anchor.placed
+
+
+def test_retry_multi_scenario_counts():
+    """Perturbed scenarios run the same retry machinery per scenario;
+    scenario 0 equals the anchor and a capacity-halved scenario places
+    no more than the base."""
+    from kubernetes_simulator_tpu.sim.whatif import Perturbation
+
+    cluster = make_cluster(6, seed=13)
+    pods, _ = make_workload(
+        100, seed=13, arrival_rate=25.0, duration_mean=1.2,
+        with_spread=True,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = [
+        Scenario(),
+        Scenario([
+            Perturbation(
+                "scale_capacity", nodes=np.arange(3), resource="cpu",
+                factor=0.5,
+            )
+        ]),
+    ]
+    eng = WhatIfEngine(
+        ec, ep, scen, cfg, wave_width=4, chunk_waves=4, retry_buffer=8
+    )
+    res = eng.run()
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=4, completions_chunk_waves=4, retry_buffer=8
+    )
+    assert int(res.placed[0]) == anchor.placed
+    assert int(res.placed[1]) <= int(res.placed[0])

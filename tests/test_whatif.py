@@ -284,3 +284,25 @@ def test_labels_dirty_mesh_matches_unsharded():
     assert sharded.engine == "v3" and sharded._dyn is not None
     res2 = sharded.run()
     np.testing.assert_array_equal(res.assignments, res2.assignments)
+
+
+def test_config5_scale_1024_scenarios_mesh():
+    """[BASELINE] config #5 at its STATED scenario count: 1024 scenarios
+    mesh-sharded over the 8 virtual devices (tiny nodes/pods so the smoke
+    stays cheap — the point is exercising S=1024 end-to-end, 128
+    scenarios per device, not just divisibility)."""
+    assert len(jax.devices()) == 8
+    ec, ep = small_case(seed=9, n=12, p=48)
+    scen = uniform_scenarios(ec, 1024, seed=9)
+    cfg = FrameworkConfig()
+    mesh = make_mesh()
+    res = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, mesh=mesh
+    ).run()
+    assert res.placed.shape == (1024,)
+    assert int(res.placed[0]) > 0
+    # Scenario 0 (unperturbed) equals the single-replay anchor.
+    single = JaxReplayEngine(ec, ep, cfg, chunk_waves=4).replay()
+    assert int(res.placed[0]) == int(
+        (single.assignments[ep.bound_node == -1] >= 0).sum()
+    )
