@@ -170,7 +170,10 @@ def validate_config(cfg) -> list:
         errors.append(
             "whatIf.retryBuffer is not supported with devicePreemption"
         )
-    if cfg.whatif.completions not in (None, True, False):
+    if not (
+        cfg.whatif.completions is None
+        or isinstance(cfg.whatif.completions, bool)
+    ):
         errors.append("whatIf.completions: must be true or false")
     if cfg.chunk_waves <= 0:
         errors.append("chunkWaves: must be > 0")

@@ -167,7 +167,14 @@ class SimConfig:
             node_down_p=float(wi.get("nodeDownP", 0.02)),
             capacity_p=float(wi.get("capacityP", 0.3)),
             taint_p=float(wi.get("taintP", 0.1)),
-            completions=wi.get("completions"),
+            # int 0/1 coerce to real bools — the engine distinguishes
+            # None/True/False by IDENTITY (explicit True must hard-error
+            # when unhonorable; 0 must actually disable).
+            completions=(
+                bool(wi["completions"])
+                if isinstance(wi.get("completions"), (bool, int))
+                else wi.get("completions")
+            ),
             retry_buffer=int(wi.get("retryBuffer", 0)),
         )
         cfg.output = d.get("output")

@@ -221,3 +221,53 @@ def test_whatif_device_release_path_matches_host_path():
     assert (off.placed != r1.placed).any() or (
         np.abs(off.utilization_cpu - r1.utilization_cpu) > 1e-4
     ).any()
+
+
+def test_whatif_device_release_full_plugin_envelope():
+    """Round 4: the device-release path covers anti/pref count planes,
+    multi-topology traces and singleton host-scale rows (the bench /
+    config-3 workload shape). Device vs host pending-fold vs greedy
+    anchor, plus the JaxReplayEngine twin, all value-identical."""
+    from kubernetes_simulator_tpu.sim.whatif import (
+        Scenario,
+        WhatIfEngine,
+        uniform_scenarios,
+    )
+
+    cluster = make_cluster(12, seed=5, taint_fraction=0.2)
+    pods, _ = make_workload(
+        140, seed=5, arrival_rate=14.0, duration_mean=2.0,
+        with_affinity=True, with_spread=True, with_tolerations=True,
+        gang_fraction=0.05, gang_size=2,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = uniform_scenarios(ec, 4, seed=5)
+    dev = WhatIfEngine(ec, ep, scen, cfg, chunk_waves=4)
+    # The point of this test: affinity terms force the planes the
+    # round-3 gate excluded — the path must still be the device one.
+    assert dev.static3.maintain_anti or dev.static3.maintain_pref
+    assert dev.static3.has_host_rows or not dev.static3.single_topo
+    assert dev._completions_dev
+    r1 = dev.run()
+    host = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, collect_assignments=True
+    )
+    assert not host._completions_dev
+    r2 = host.run()
+    np.testing.assert_array_equal(r1.placed, r2.placed)
+    np.testing.assert_allclose(
+        r1.utilization_cpu, r2.utilization_cpu, atol=1e-6
+    )
+    # Scenario 0 == the single-replay engine == the greedy anchor.
+    single = JaxReplayEngine(ec, ep, cfg, chunk_waves=4).replay()
+    anchor = greedy_replay(ec, ep, cfg, completions_chunk_waves=4)
+    np.testing.assert_array_equal(single.assignments, anchor.assignments)
+    assert int(r1.placed[0]) == int(
+        (anchor.assignments[ep.bound_node == PAD] >= 0).sum()
+    )
+    # Non-vacuous: releases must matter on this trace.
+    off = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, completions=False
+    ).run()
+    assert (off.placed != r1.placed).any()

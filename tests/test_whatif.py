@@ -26,7 +26,6 @@ def small_case(seed=0, n=15, p=80):
     return encode(cluster, pods)
 
 
-@pytest.mark.slow
 def test_base_scenario_matches_single_replay():
     """Scenario 0 (unperturbed) must equal the plain jax engine exactly."""
     ec, ep = small_case()
@@ -82,7 +81,6 @@ def test_vmap_matches_looped_perturbed_scenarios():
     assert (res.assignments[3] == ref4.assignments).all()
 
 
-@pytest.mark.slow
 def test_mesh_sharded_matches_unsharded():
     """shard_map-equivalent sharded run over 8 virtual devices must equal
     the single-device vmap bit-for-bit."""
