@@ -592,6 +592,21 @@ class WhatIfEngine:
         # completions-on semantics coincide there.
         want = completions is not False  # None (the default) = on
         have_durations = bool(np.isfinite(rel).any())
+        # Structural eligibility of the DEVICE-release path (used both
+        # for the gate below and to decide whether a DynTables batch can
+        # honor completions at all — the host fold path cannot apply
+        # per-scenario domain corrections, the device commit blocks can).
+        dev_ok = False
+        if self.engine == "v3":
+            s3 = self.static3
+            dev_ok = bool(
+                self.mesh is None
+                and not collect_assignments
+                and fork_checkpoint is None
+                and s3.single_g[s3.mc_h_ids].all()
+                and s3.single_g[s3.anti_h_ids].all()
+                and s3.single_g[s3.pref_h_ids].all()
+            )
         blockers = []
         if self.engine != "v3":
             blockers.append(
@@ -600,10 +615,20 @@ class WhatIfEngine:
             )
         if preemption:
             blockers.append("device tier preemption")
-        if self._dyn is not None:
+        if self._dyn is not None and not dev_ok:
+            why = []
+            if self.mesh is not None:
+                why.append("mesh")
+            if collect_assignments:
+                why.append("collect_assignments")
+            if fork_checkpoint is not None:
+                why.append("fork_checkpoint")
+            if self.engine == "v3" and not why:
+                why.append("non-singleton host-scale count planes")
             blockers.append(
-                "labels_dirty DynTables batches (release deltas use the "
-                "base domain tables)"
+                "labels_dirty DynTables batches off the device-release "
+                f"path ({'/'.join(why) or 'v2 engine'} — per-scenario "
+                "release domain corrections need the device path)"
             )
         self.completions_on = bool(want and have_durations and not blockers)
         if want and have_durations and blockers:
@@ -631,27 +656,12 @@ class WhatIfEngine:
         # an [N, N]-class regroup; hostname, the host-scale case that
         # exists in practice, is singleton). Everything else keeps the
         # host pending-fold path.
-        host_singleton = False
-        if self.engine == "v3":
-            s3 = self.static3
-            host_singleton = bool(
-                s3.single_g[s3.mc_h_ids].all()
-                and s3.single_g[s3.anti_h_ids].all()
-                and s3.single_g[s3.pref_h_ids].all()
-            )
-        self._completions_dev = bool(
-            self.completions_on
-            and self.mesh is None
-            and not collect_assignments
-            and self.engine == "v3"
-            and self._dyn is None
-            and not fork_checkpoint
-            and host_singleton
-        )
+        self._completions_dev = bool(self.completions_on and dev_ok)
         # The retry pass's pending-release helper still updates only the
         # used/mc planes — retry keeps the narrow (round-3) envelope.
         self._rel_simple = bool(
             self.engine == "v3"
+            and self._dyn is None
             and self.static3.single_topo
             and not self.static3.has_host_rows
             and not self.static3.maintain_anti
@@ -807,7 +817,7 @@ class WhatIfEngine:
                         )
 
                     def per_scenario_rel(
-                        dc, state, src, xsrc, idx, b, vassign,
+                        dc, state, src, xsrc, idx, b, vassign, dyn=None,
                     ):
                         # Static releases run in the separate bucketed
                         # _release_fn BEFORE this call (ordering by data
@@ -818,7 +828,7 @@ class WhatIfEngine:
                         # wave positions, which is exactly how the static
                         # release lists address them (rel_pos).
                         state, out = per_scenario_src(
-                            dc, state, src, xsrc, idx
+                            dc, state, src, xsrc, idx, dyn
                         )
                         choices, counts = out
                         vassign = jax.lax.dynamic_update_slice(
@@ -984,7 +994,11 @@ class WhatIfEngine:
 
                     vmapped_rel = jax.vmap(
                         per_scenario_rel,
-                        in_axes=(0, 0, None, None, None, None, 0),
+                        in_axes=(
+                            (0, 0, None, None, None, None, 0, 0)
+                            if dyn_on
+                            else (0, 0, None, None, None, None, 0)
+                        ),
                     )
                     return jax.jit(vmapped_rel, donate_argnums=(1, 6))
                 # vmap matches in_axes against the args actually passed,
@@ -1067,7 +1081,9 @@ class WhatIfEngine:
         are 0/1 (each product term exact) and the summed quantities are
         the bucketed k8s magnitudes the engine already relies on being
         associative-exact (ops/tpu3.py module docstring)."""
-        fn = self._rel_fn_cache.get(K)
+        dyn_mode = self._dyn is not None
+        key = (K, dyn_mode)
+        fn = self._rel_fn_cache.get(key)
         if fn is not None:
             return fn
         from ..ops import tpu3 as V3
@@ -1115,8 +1131,16 @@ class WhatIfEngine:
                 delta = delta.at[ids].set(rc[ids] @ oh_t)
             return delta
 
+        # Anti/pref accumulators exist only when the trace carries the
+        # terms (static) — the Borg north-star shape keeps the exact
+        # round-3 commit-block cost.
+        want_an = bool(st3.maintain_anti)
+        want_pf = bool(st3.maintain_pref)
+        nparts = 1 + want_an + want_pf
+
         def rel_one(state, vassign, rel_pos, rel_req, rel_mg,
-                    rel_anti, rel_pref, rel_prefw):
+                    rel_anti, rel_pref, rel_prefw,
+                    ov_nodes=None, ov_gdom=None, ov_old=None):
             node_k = vassign[rel_pos]  # sentinel pos → the PAD tail slot
             nd = jnp.where(node_k >= 0, node_k, -1)  # -1 matches no node
             iota = jnp.arange(N, dtype=jnp.int32)
@@ -1127,20 +1151,21 @@ class WhatIfEngine:
                 nd_b, req_b, mg_b, an_b, pf_b, pw_b = xs
                 oh = (nd_b[:, None] == iota[None, :]).astype(jnp.float32)
                 u = u - jnp.einsum("wn,wr->rn", oh, req_b)
-                mm_mc = (mg_b[:, :, None] == ar_G).sum(1)
-                mm_an = (an_b[:, :, None] == ar_G).sum(1)
-                mm_pf = (
-                    (pf_b[:, :, None] == ar_G) * pw_b[:, :, None]
-                ).sum(1)
-                mm = jnp.concatenate(
-                    [mm_mc, mm_an, mm_pf], axis=1
-                ).astype(jnp.float32)  # [Wr, 3G]
+                parts = [(mg_b[:, :, None] == ar_G).sum(1)]
+                if want_an:
+                    parts.append((an_b[:, :, None] == ar_G).sum(1))
+                if want_pf:
+                    parts.append(
+                        ((pf_b[:, :, None] == ar_G) * pw_b[:, :, None])
+                        .sum(1)
+                    )
+                mm = jnp.concatenate(parts, axis=1).astype(jnp.float32)
                 rc = rc + jnp.einsum("wn,wk->kn", oh, mm)
                 return (u, rc), None
 
             (used, rc), _ = jax.lax.scan(
                 body,
-                (state.used, jnp.zeros((3 * G, N), jnp.float32)),
+                (state.used, jnp.zeros((nparts * G, N), jnp.float32)),
                 (
                     nd.reshape(nb, Wr),
                     rel_req.reshape(nb, Wr, R),
@@ -1151,24 +1176,66 @@ class WhatIfEngine:
                 ),
             )
             # Valid-domain masking ONCE (covers both the coarse matmuls'
-            # zero rows and the host-plane rows).
-            rc = rc * jnp.tile(vdom, (3, 1))
-            rc_mc, rc_an, rc_pf = jnp.split(rc, 3, axis=0)
+            # zero rows and the host-plane rows). The RAW accumulator is
+            # kept for the per-scenario DynTables correction: a node the
+            # scenario relabeled releases into its OVERRIDDEN domain
+            # (and base validity doesn't apply — a node that gained the
+            # key releases into the appended domain the bind counted).
+            rc_raw = rc
+            rc = rc * jnp.tile(vdom, (nparts, 1))
+            chunks = jnp.split(rc, nparts, axis=0)
+            raw_chunks = jnp.split(rc_raw, nparts, axis=0)
+            rc_mc = chunks[0]
+            rc_an = chunks[1] if want_an else None
+            rc_pf = chunks[1 + want_an] if want_pf else None
+
+            if dyn_mode:
+                safe_ov = jnp.where(ov_nodes >= 0, ov_nodes, 0)
+                ok_ov = (ov_nodes >= 0).astype(jnp.float32)  # [K32]
+                ar_D = jnp.arange(Dcap, dtype=jnp.float32)
+                mk_oh = lambda a: (
+                    (a[..., None] == ar_D) & (a[..., None] >= 0)
+                ).astype(jnp.float32)  # [G, K, Dcap]
+                doh = mk_oh(ov_gdom) - mk_oh(ov_old)
+
+                def corr_of(raw):
+                    rv = raw[:, safe_ov] * ok_ov[None, :]  # [G, K32]
+                    return jnp.einsum("gk,gkd->gd", rv, doh)
+            else:
+                corr_of = None
+
+            def dom_delta(base, raw):
+                d = coarse_delta(base)
+                return d + corr_of(raw) if dyn_mode else d
+
+            mc_delta = dom_delta(rc_mc, raw_chunks[0])
             new = {
                 "used": used,
-                "mc_dom": state.mc_dom - coarse_delta(rc_mc),
-                "match_total": state.match_total - rc_mc.sum(-1),
+                "mc_dom": state.mc_dom - mc_delta,
+                "match_total": (
+                    state.match_total
+                    - (
+                        rc_mc.sum(-1)
+                        + corr_of(raw_chunks[0]).sum(-1)
+                        if dyn_mode
+                        else rc_mc.sum(-1)
+                    )
+                ),
             }
-            if st3.maintain_anti:
-                new["anti_dom"] = state.anti_dom - coarse_delta(rc_an)
-            if st3.maintain_pref:
-                new["pref_dom"] = state.pref_dom - coarse_delta(rc_pf)
+            if want_an:
+                new["anti_dom"] = state.anti_dom - dom_delta(
+                    rc_an, raw_chunks[1]
+                )
+            if want_pf:
+                new["pref_dom"] = state.pref_dom - dom_delta(
+                    rc_pf, raw_chunks[1 + want_an]
+                )
             for key, ids, rcx in (
                 ("mc_host", h_sel[0], rc_mc),
                 ("anti_host", h_sel[1], rc_an),
                 ("pref_host", h_sel[2], rc_pf),
             ):
-                if ids.shape[0]:
+                if ids.shape[0] and rcx is not None:
                     plane = getattr(state, key)
                     new[key] = plane - rcx[ids].astype(plane.dtype)
             return state._replace(**new)
@@ -1176,11 +1243,15 @@ class WhatIfEngine:
         fn = jax.jit(
             jax.vmap(
                 rel_one,
-                in_axes=(0, 0, None, None, None, None, None, None),
+                in_axes=(
+                    (0, 0, None, None, None, None, None, None, 0, 0, 0)
+                    if dyn_mode
+                    else (0, 0, None, None, None, None, None, None)
+                ),
             ),
             donate_argnums=(0,),
         )
-        self._rel_fn_cache[K] = fn
+        self._rel_fn_cache[key] = fn
         return fn
 
     def _state_proto(self):
@@ -1680,9 +1751,16 @@ class WhatIfEngine:
                 # data dependency on states/vassign), then the chunk.
                 rc = rel_calls[ci]
                 if rc is not None:
-                    states = self._release_fn(rc[0].shape[0])(
-                        states, vassign_d, *rc
-                    )
+                    args = (states, vassign_d) + rc
+                    if self._dyn is not None:
+                        # Per-scenario domain overrides: releases of
+                        # relabeled nodes land in the overridden domain.
+                        args = args + (
+                            self._dyn_dev.ov_nodes,
+                            self._dyn_dev.ov_gdom,
+                            self._dyn_dev.ov_old,
+                        )
+                    states = self._release_fn(rc[0].shape[0])(*args)
             if dev_rel and self.retry_buffer:
                 (
                     states, vassign_d, rbuf_d, rcount_d,
@@ -1694,10 +1772,13 @@ class WhatIfEngine:
                     pend_id_d, pend_node_d, pend_relb_d,
                 )
             elif dev_rel:
-                states, vassign_d, out = self._chunk_fn(
+                args = (
                     dc, states, srcs[0], srcs[1], idx_chunks[ci],
                     b_c[ci], vassign_d,
                 )
+                if dyn_sharded is not None:
+                    args = args + (dyn_sharded,)
+                states, vassign_d, out = self._chunk_fn(*args)
             elif self.mesh is None and self.engine == "v3" and srcs is not None:
                 # Fused device-side gather + wave scan: one dispatch per
                 # chunk, indices pre-staged (ops.tpu.SlotSource).

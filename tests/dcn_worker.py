@@ -17,6 +17,14 @@ import sys
 def main() -> None:
     import jax
 
+    # Persistent compile cache like the parent suite (conftest enables it
+    # process-locally, which subprocesses would otherwise miss — their
+    # from-scratch compiles are what the communicate() timeout guards).
+    from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
+
+    _cc()
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
     from kubernetes_simulator_tpu.parallel.mesh import init_distributed, make_mesh
 
     init_distributed(

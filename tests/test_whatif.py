@@ -304,3 +304,64 @@ def test_config5_scale_1024_scenarios_mesh():
     assert int(res.placed[0]) == int(
         (single.assignments[ep.bound_node == -1] >= 0).sum()
     )
+
+
+def test_labels_dirty_with_completions_device_path():
+    """Round 4: labels_dirty × completions — supported by the DEVICE
+    release path (per-scenario domain corrections ride the commit
+    blocks). Each perturbed scenario's placed count must equal a
+    from-scratch replay of the explicitly perturbed cluster with
+    completions on; the un-dirty twin batch confirms completions stay
+    on (completions_on=True) rather than silently dropping."""
+    import copy
+
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+    cluster = make_cluster(6, seed=17, taint_fraction=0.1)
+    zkey = "topology.kubernetes.io/zone"
+    del cluster.nodes[5].labels[zkey]  # gaining case
+    pods, _ = make_workload(
+        400, seed=17, arrival_rate=40.0, duration_mean=1.5,
+        with_spread=True, with_tolerations=True,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = [
+        Scenario(),
+        Scenario([  # existing value move
+            Perturbation("set_label", nodes=np.array([0, 3]), key=zkey,
+                         value="zone-1"),
+        ]),
+        Scenario([  # NEW value → appended domain id
+            Perturbation("set_label", nodes=np.array([2]), key=zkey,
+                         value="zz-new"),
+        ]),
+        Scenario([  # unlabeled node gains the key
+            Perturbation("set_label", nodes=np.array([5]), key=zkey,
+                         value="zone-0"),
+        ]),
+    ]
+    eng = WhatIfEngine(ec, ep, scen, cfg, chunk_waves=4)
+    assert eng.engine == "v3" and eng._dyn is not None
+    assert eng.completions_on and eng._completions_dev
+    res = eng.run()
+    assert res.completions_on
+
+    for si, sc in enumerate(scen):
+        c2 = copy.deepcopy(cluster)
+        for pt in sc.perturbations:
+            for n in np.asarray(pt.nodes).tolist():
+                c2.nodes[n].labels[pt.key] = pt.value
+        ec2, ep2 = encode(c2, pods)
+        single = JaxReplayEngine(ec2, ep2, cfg, chunk_waves=4).replay()
+        assert int(res.placed[si]) == single.placed, (
+            f"scenario {si}: whatif {int(res.placed[si])} vs "
+            f"from-scratch {single.placed}"
+        )
+
+    # Non-vacuous: completions change the outcome on this trace.
+    off = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, completions=False
+    ).run()
+    assert (off.placed != res.placed).any()
