@@ -480,15 +480,15 @@ class WhatIfEngine:
         self.D = max(self.sset.max_domains, 1)
         # v3 unless the labels_dirty batch falls outside the DynTables
         # envelope (per-scenario domain tables; round 3): host-scale
-        # topologies, pre-bound pods, preemption, forks, completions and
-        # >32 perturbed nodes per scenario stay on the v2 parity engine.
+        # topologies, pre-bound pods, preemption, forks and >32 perturbed
+        # nodes per scenario stay on the v2 parity engine.
         self.engine = "v3"
         self._dyn = None
         if self.sset.labels_dirty:
-            # Completions are off for label-perturbation batches on either
-            # engine (the release deltas would need per-scenario domain
-            # tables) — the gate below WARNS/raises about it — so prefer
-            # the ~4× faster DynTables v3 over v2.
+            # DynTables batches honor completions on the DEVICE-release
+            # path since round 4 (per-scenario release domain
+            # corrections); off that path the gate below WARNS/raises.
+            # Either way prefer the ~4× faster DynTables v3 over v2.
             dyn = self.sset.dyn
             if (
                 dyn is not None
@@ -616,19 +616,19 @@ class WhatIfEngine:
         if preemption:
             blockers.append("device tier preemption")
         if self._dyn is not None and not dev_ok:
+            # _dyn is only set with fork_checkpoint None and engine v3,
+            # so the failing dev_ok condition is one of these three.
             why = []
             if self.mesh is not None:
                 why.append("mesh")
             if collect_assignments:
                 why.append("collect_assignments")
-            if fork_checkpoint is not None:
-                why.append("fork_checkpoint")
-            if self.engine == "v3" and not why:
+            if not why:
                 why.append("non-singleton host-scale count planes")
             blockers.append(
                 "labels_dirty DynTables batches off the device-release "
-                f"path ({'/'.join(why) or 'v2 engine'} — per-scenario "
-                "release domain corrections need the device path)"
+                f"path ({'/'.join(why)} — per-scenario release domain "
+                "corrections need the device path)"
             )
         self.completions_on = bool(want and have_durations and not blockers)
         if want and have_durations and blockers:

@@ -1,8 +1,10 @@
 """Randomized parity stress: host greedy anchor vs the v3 device engine
 (and v2 cross-checks) across the full feature-knob space — affinity,
 spread, tolerations, gangs, extended resources, forced host planes,
-tier preemption, odd wave widths. Not part of the CI suite (slow);
-run ad hoc before releases:
+tier preemption, odd wave widths, and (round 4) finite durations with
+chunk-granular completions, preemption × completions, and the boundary
+retry buffer (what-if device path vs the anchor). Not part of the CI
+suite (slow); run ad hoc before releases:
 
     JAX_PLATFORMS=cpu python scripts/fuzz_parity.py [trials] [master_seed]
 
@@ -46,7 +48,14 @@ def run_fuzz(trials: int, master: int):
       cluster = make_cluster(n_nodes, seed=seed, taint_fraction=float(rng.choice([0.0, 0.2, 0.5])),
                              num_zones=int(rng.choice([2, 4, 8])),
                              extended_resources={"google.com/tpu": (8, 0.25)} if ext else None)
-      pods, _ = make_workload(n_pods, seed=seed, extended_resource=ext, **kw)
+      # Durations → chunk-granular completions (default ON in the device
+      # engines; anchor mirrors with completions_chunk_waves).
+      dm = float(rng.choice([0.0, 2.0, 8.0]))
+      pods, _ = make_workload(
+          n_pods, seed=seed, extended_resource=ext,
+          arrival_rate=float(rng.choice([20.0, 60.0])),
+          duration_mean=dm or None, **kw,
+      )
       ec, ep = encode(cluster, pods)
       preempt = bool(rng.random() < 0.4)
       dmax = int(rng.choice([0, 4, 128])) if not preempt else 128
@@ -54,12 +63,15 @@ def run_fuzz(trials: int, master: int):
       wave_width = int(rng.choice([5, 8, 13]))
       if kw["gang_fraction"] and kw["gang_size"] > wave_width:
           wave_width = 8
+      C = int(rng.choice([4, 16]))
       try:
-          a = greedy_replay(ec, ep, cfg, wave_width=wave_width, preemption=preempt)
-          d = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
+          a = greedy_replay(ec, ep, cfg, wave_width=wave_width, preemption=preempt,
+                            completions_chunk_waves=C if dm else None)
+          d = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width, chunk_waves=C,
                               dmax_coarse=dmax, preemption=preempt).replay()
           if not preempt:
-              v2 = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width, engine="v2").replay()
+              v2 = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
+                                   chunk_waves=C, engine="v2").replay()
               assert (v2.assignments == a.assignments).all(), f"v2 mismatch trial={trial}"
 
       except ValueError as e:
@@ -72,8 +84,36 @@ def run_fuzz(trials: int, master: int):
       if not ok:
           fails += 1
           print(f"FAIL trial={trial} seed={seed} nodes={n_nodes} pods={n_pods} "
-                f"kw={kw} preempt={preempt} dmax={dmax} W={wave_width} mism={mism} "
-                f"placed {a.placed} vs {d.placed} evict {a.preemptions} vs {d.preemptions}")
+                f"kw={kw} preempt={preempt} dmax={dmax} W={wave_width} C={C} dm={dm} "
+                f"mism={mism} placed {a.placed} vs {d.placed} "
+                f"evict {a.preemptions} vs {d.preemptions}")
+      # Boundary retry: the what-if device path vs the anchor (narrow
+      # envelope: no affinity/spread count planes, no preemption).
+      if (
+          dm
+          and not preempt
+          and not kw["with_affinity"]
+          and not kw["with_spread"]
+          and not ext
+      ):
+          from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+          RB = int(rng.choice([8, 32]))
+          try:
+              wi = WhatIfEngine(ec, ep, [Scenario()], cfg,
+                                wave_width=wave_width, chunk_waves=C,
+                                retry_buffer=RB)
+          except ValueError:
+              wi = None  # outside the retry envelope for this trace
+          if wi is not None:
+              cases += 1
+              ar = greedy_replay(ec, ep, cfg, wave_width=wave_width,
+                                 completions_chunk_waves=C, retry_buffer=RB)
+              wres = wi.run()
+              if int(wres.placed[0]) != ar.placed:
+                  fails += 1
+                  print(f"RETRY-FAIL trial={trial} seed={seed} RB={RB} C={C} "
+                        f"W={wave_width} placed {int(wres.placed[0])} vs {ar.placed}")
   return cases, fails
 
 
