@@ -457,9 +457,12 @@ class WhatIfEngine:
         the normal wave step over the buffer. Pods placed on retry start
         AT THE BOUNDARY: they release at the first boundary whose start
         time reaches ``t_b + duration`` (f32), at least ``b+1``, via a
-        pending list capped at the same size. Semantics anchored by
+        pending list capped at the same size (its releases ride the same
+        commit-block core as the static lists, so the full default
+        plugin set is covered). Semantics anchored by
         ``greedy_replay(retry_buffer=...)``. Requires the device-release
-        completions path; 0 = off (the r01–r03 semantics)."""
+        completions path without DynTables; 0 = off (the r01–r03
+        semantics)."""
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -657,16 +660,7 @@ class WhatIfEngine:
         # exists in practice, is singleton). Everything else keeps the
         # host pending-fold path.
         self._completions_dev = bool(self.completions_on and dev_ok)
-        # The retry pass's pending-release helper still updates only the
-        # used/mc planes — retry keeps the narrow (round-3) envelope.
-        self._rel_simple = bool(
-            self.engine == "v3"
-            and self._dyn is None
-            and self.static3.single_topo
-            and not self.static3.has_host_rows
-            and not self.static3.maintain_anti
-            and not self.static3.maintain_pref
-        )
+
         self.retry_buffer = int(retry_buffer)
         if self.retry_buffer:
             # Round up to a wave multiple (the retry pass reuses the
@@ -674,20 +668,21 @@ class WhatIfEngine:
             self.retry_buffer = (
                 -(-self.retry_buffer // wave_width) * wave_width
             )
-            if not (self._completions_dev and self._rel_simple):
+            if not (self._completions_dev and self._dyn is None):
                 raise ValueError(
                     "retry_buffer requires the device-release completions "
-                    "path on its narrow envelope (v3 engine, finite "
-                    "durations, no mesh/collect_assignments/preemption/"
-                    "fork/label-perturbation, single-topology trace "
-                    "without host-scale or anti/pref count planes)"
+                    "path (v3 engine, finite durations, no mesh/"
+                    "collect_assignments/preemption/fork, singleton "
+                    "host-scale topologies) without label-perturbation "
+                    "DynTables"
                 )
         # Host-side completions need per-scenario choices even when the
         # caller only wants counts; the device path never fetches them.
         self._need_choices = collect_assignments or (
             self.completions_on and not self._completions_dev
         )
-        self._rel_fn_cache: Dict[int, Callable] = {}
+        self._rel_fn_cache: Dict[tuple, Callable] = {}
+        self._rel_core: Optional[Callable] = None
         self._dev_rel_stage: Optional[dict] = None
         self._chunk_fn = self._build_chunk_fn()
         # Device-resident slot sources (one upload per engine): the chunk
@@ -767,55 +762,6 @@ class WhatIfEngine:
                     return per_scenario(dc, state, slots, extra, dyn)
 
                 if self._completions_dev:
-                    st3_l, sh3_l = st3, sh3
-                    Dcap = st3.Dcap
-
-                    def release(state, nodes, due, reqs, mgs):
-                        """Subtract ``due`` pods' resource rows + matched
-                        count-group contributions (the device twin of the
-                        host release_delta, K-sized)."""
-                        N = state.used.shape[1]
-                        # Masked-out entries use a PAST-THE-END index:
-                        # with mode="drop" only genuinely out-of-bounds
-                        # indices are dropped — negative ones WRAP first
-                        # (NumPy semantics) and would corrupt the last
-                        # element.
-                        amask = jnp.where(due, nodes, N)
-                        R = state.used.shape[0]
-                        used = jnp.stack([
-                            state.used[r].at[amask].add(
-                                -jnp.where(due, reqs[:, r], 0.0),
-                                mode="drop",
-                            )
-                            for r in range(R)
-                        ])
-                        dom = sh3_l.topo1_f[jnp.clip(nodes, 0)].astype(
-                            jnp.int32
-                        )
-                        ok = due & (dom >= 0)
-                        mc_flat = state.mc_dom.reshape(-1)
-                        G = state.match_total.shape[0]
-                        mt = state.match_total
-                        for m in range(mgs.shape[1]):
-                            g = mgs[:, m]
-                            # has_dom_g: a matched group WITHOUT a
-                            # topology never held a count (the host
-                            # release_delta's dom[g] >= 0 guard).
-                            valid = ok & (g >= 0) & (
-                                sh3_l.has_dom_g[jnp.clip(g, 0)] > 0.5
-                            )
-                            mc_flat = mc_flat.at[
-                                jnp.where(valid, g * Dcap + dom, G * Dcap)
-                            ].add(-1.0, mode="drop")
-                            mt = mt.at[jnp.where(valid, g, G)].add(
-                                -1.0, mode="drop"
-                            )
-                        return state._replace(
-                            used=used,
-                            mc_dom=mc_flat.reshape(state.mc_dom.shape),
-                            match_total=mt,
-                        )
-
                     def per_scenario_rel(
                         dc, state, src, xsrc, idx, b, vassign, dyn=None,
                     ):
@@ -843,8 +789,11 @@ class WhatIfEngine:
                         RBW = RB // wave_width
                         BIG = 1 << 30
 
+                        rel_core = self._release_core()
+
                         def per_scenario_retry(
-                            dc, state, src, xsrc, mgt, durt, tbt,
+                            dc, state, src, xsrc, mgt, antit, preft,
+                            prefwt, durt, tbt,
                             idx, t_b, b,
                             vassign, rbuf, rcount,
                             pend_id, pend_node, pend_relb,
@@ -866,10 +815,12 @@ class WhatIfEngine:
                             # boundary arrived (relb encodes the f32 time
                             # comparison already).
                             due_p = (pend_id >= 0) & (pend_relb <= b)
-                            reqs_p = src.requests[jnp.clip(pend_id, 0)]
-                            mgs_p = mgt[jnp.clip(pend_id, 0)]
-                            state = release(
-                                state, pend_node, due_p, reqs_p, mgs_p
+                            safe_p = jnp.clip(pend_id, 0)
+                            nd_p = jnp.where(due_p, pend_node, -1)
+                            state = rel_core(
+                                state, nd_p, src.requests[safe_p],
+                                mgt[safe_p], antit[safe_p],
+                                preft[safe_p], prefwt[safe_p],
                             )
                             # 2. bounded retry pass: the NORMAL wave step
                             # over the buffer (empty slots are invalid
@@ -984,12 +935,13 @@ class WhatIfEngine:
                             in_axes=(
                                 0, 0, None, None, None, None, None,
                                 None, None, None,
+                                None, None, None,
                                 0, 0, 0, 0, 0, 0,
                             ),
                         )
                         return jax.jit(
                             vmapped_retry,
-                            donate_argnums=(1, 10, 11, 12, 13, 14, 15),
+                            donate_argnums=(1, 13, 14, 15, 16, 17, 18),
                         )
 
                     vmapped_rel = jax.vmap(
@@ -1063,43 +1015,25 @@ class WhatIfEngine:
             donate_argnums=(1,),
         )
 
-    def _release_fn(self, K: int):
-        """Jitted static-release application for a pow2 bucket size K
-        (device-release path). Separate from the chunk program so each
-        boundary pays only its own (bucketed) release-list width instead
-        of the global maximum — the Borg duration distribution makes the
-        max ~2.4× the mean.
-
-        The update is a scan over 256-wide one-hot COMMIT blocks (the
-        wave-commit trick, measured 4×+ faster than a [K]-index scatter
-        on TPU — scatter serializes colliding indices): each block builds
-        the [Wr, N] placement one-hot once and contracts it with both the
-        request rows (→ used delta) and the matched-group matrix (→ a
-        node-space [G, N] released-count accumulator). The count planes
-        then drop to domain space through ONE static node→domain one-hot
-        matmul; match_total is its row sum. Exactness: one-hot operands
-        are 0/1 (each product term exact) and the summed quantities are
-        the bucketed k8s magnitudes the engine already relies on being
-        associative-exact (ops/tpu3.py module docstring)."""
-        dyn_mode = self._dyn is not None
-        key = (K, dyn_mode)
-        fn = self._rel_fn_cache.get(key)
-        if fn is not None:
-            return fn
+    def _release_core(self):
+        """Shared device release-update core (cached): subtract a K-list
+        of released placements from every carried plane via one-hot
+        commit blocks — used by the bucketed static-release fns AND the
+        retry path's pending releases. Covers the full plane set: used,
+        coarse domain planes (per-topology static matmuls), singleton
+        host-scale rows, anti/pref when the trace carries the terms,
+        match_total. Returns ``core(state, nd, req, mg, an, pf, pw,
+        want_raw=False)``; with ``want_raw`` also returns the UNMASKED
+        node-space accumulator stack (the DynTables correction input)."""
+        if self._rel_core is not None:
+            return self._rel_core
         from ..ops import tpu3 as V3
 
-        sh3, st3 = self.shared3, self.static3
+        st3 = self.static3
         ec = self.ec
         Dcap = st3.Dcap
         N = ec.num_nodes
         G = st3.G
-        Wr = min(K, 256)
-        nb = K // Wr
-        # Static structure (scenario-shared): per-group node→domain map,
-        # validity mask, per-topology domain one-hots for the coarse
-        # groups, and the host-plane row selections (singleton domains —
-        # the gate guarantees it — so the node-space released
-        # accumulator IS the plane delta).
         gdom = V3._gdom_table(ec, G)  # [G, N] np
         gate_np = np.asarray(
             (ec.group_topo[:G] >= 0) & (st3.nd_g > 0), np.float32
@@ -1124,6 +1058,9 @@ class WhatIfEngine:
             for ids in (st3.mc_h_ids, st3.anti_h_ids, st3.pref_h_ids)
         ]
         ar_G = jnp.arange(G, dtype=jnp.int32)[None, None, :]
+        want_an = bool(st3.maintain_anti)
+        want_pf = bool(st3.maintain_pref)
+        nparts = 1 + want_an + want_pf
 
         def coarse_delta(rc):
             delta = jnp.zeros((G, Dcap), jnp.float32)
@@ -1131,20 +1068,13 @@ class WhatIfEngine:
                 delta = delta.at[ids].set(rc[ids] @ oh_t)
             return delta
 
-        # Anti/pref accumulators exist only when the trace carries the
-        # terms (static) — the Borg north-star shape keeps the exact
-        # round-3 commit-block cost.
-        want_an = bool(st3.maintain_anti)
-        want_pf = bool(st3.maintain_pref)
-        nparts = 1 + want_an + want_pf
-
-        def rel_one(state, vassign, rel_pos, rel_req, rel_mg,
-                    rel_anti, rel_pref, rel_prefw,
-                    ov_nodes=None, ov_gdom=None, ov_old=None):
-            node_k = vassign[rel_pos]  # sentinel pos → the PAD tail slot
-            nd = jnp.where(node_k >= 0, node_k, -1)  # -1 matches no node
+        def core(state, nd, req_rows, mg_rows, an_rows, pf_rows, pw_rows,
+                 want_raw=False):
+            K = nd.shape[0]
+            Wr = 256 if K % 256 == 0 else K
+            nb = K // Wr
             iota = jnp.arange(N, dtype=jnp.int32)
-            R = rel_req.shape[1]
+            R = req_rows.shape[1]
 
             def body(carry, xs):
                 u, rc = carry
@@ -1168,76 +1098,116 @@ class WhatIfEngine:
                 (state.used, jnp.zeros((nparts * G, N), jnp.float32)),
                 (
                     nd.reshape(nb, Wr),
-                    rel_req.reshape(nb, Wr, R),
-                    rel_mg.reshape(nb, Wr, rel_mg.shape[1]),
-                    rel_anti.reshape(nb, Wr, rel_anti.shape[1]),
-                    rel_pref.reshape(nb, Wr, rel_pref.shape[1]),
-                    rel_prefw.reshape(nb, Wr, rel_prefw.shape[1]),
+                    req_rows.reshape(nb, Wr, R),
+                    mg_rows.reshape(nb, Wr, mg_rows.shape[1]),
+                    an_rows.reshape(nb, Wr, an_rows.shape[1]),
+                    pf_rows.reshape(nb, Wr, pf_rows.shape[1]),
+                    pw_rows.reshape(nb, Wr, pw_rows.shape[1]),
                 ),
             )
-            # Valid-domain masking ONCE (covers both the coarse matmuls'
-            # zero rows and the host-plane rows). The RAW accumulator is
-            # kept for the per-scenario DynTables correction: a node the
-            # scenario relabeled releases into its OVERRIDDEN domain
-            # (and base validity doesn't apply — a node that gained the
-            # key releases into the appended domain the bind counted).
             rc_raw = rc
             rc = rc * jnp.tile(vdom, (nparts, 1))
             chunks = jnp.split(rc, nparts, axis=0)
-            raw_chunks = jnp.split(rc_raw, nparts, axis=0)
             rc_mc = chunks[0]
             rc_an = chunks[1] if want_an else None
             rc_pf = chunks[1 + want_an] if want_pf else None
-
-            if dyn_mode:
-                safe_ov = jnp.where(ov_nodes >= 0, ov_nodes, 0)
-                ok_ov = (ov_nodes >= 0).astype(jnp.float32)  # [K32]
-                ar_D = jnp.arange(Dcap, dtype=jnp.float32)
-                mk_oh = lambda a: (
-                    (a[..., None] == ar_D) & (a[..., None] >= 0)
-                ).astype(jnp.float32)  # [G, K, Dcap]
-                doh = mk_oh(ov_gdom) - mk_oh(ov_old)
-
-                def corr_of(raw):
-                    rv = raw[:, safe_ov] * ok_ov[None, :]  # [G, K32]
-                    return jnp.einsum("gk,gkd->gd", rv, doh)
-            else:
-                corr_of = None
-
-            def dom_delta(base, raw):
-                d = coarse_delta(base)
-                return d + corr_of(raw) if dyn_mode else d
-
-            mc_delta = dom_delta(rc_mc, raw_chunks[0])
             new = {
                 "used": used,
-                "mc_dom": state.mc_dom - mc_delta,
-                "match_total": (
-                    state.match_total
-                    - (
-                        rc_mc.sum(-1)
-                        + corr_of(raw_chunks[0]).sum(-1)
-                        if dyn_mode
-                        else rc_mc.sum(-1)
-                    )
-                ),
+                "mc_dom": state.mc_dom - coarse_delta(rc_mc),
+                "match_total": state.match_total - rc_mc.sum(-1),
             }
             if want_an:
-                new["anti_dom"] = state.anti_dom - dom_delta(
-                    rc_an, raw_chunks[1]
-                )
+                new["anti_dom"] = state.anti_dom - coarse_delta(rc_an)
             if want_pf:
-                new["pref_dom"] = state.pref_dom - dom_delta(
-                    rc_pf, raw_chunks[1 + want_an]
-                )
-            for key, ids, rcx in (
+                new["pref_dom"] = state.pref_dom - coarse_delta(rc_pf)
+            for pkey, ids, rcx in (
                 ("mc_host", h_sel[0], rc_mc),
                 ("anti_host", h_sel[1], rc_an),
                 ("pref_host", h_sel[2], rc_pf),
             ):
                 if ids.shape[0] and rcx is not None:
-                    plane = getattr(state, key)
-                    new[key] = plane - rcx[ids].astype(plane.dtype)
+                    plane = getattr(state, pkey)
+                    new[pkey] = plane - rcx[ids].astype(plane.dtype)
+            out = state._replace(**new)
+            return (out, rc_raw) if want_raw else out
+
+        core.nparts = nparts
+        core.want_an = want_an
+        core.want_pf = want_pf
+        self._rel_core = core
+        return core
+
+    def _release_fn(self, K: int):
+        """Jitted static-release application for a pow2 bucket size K
+        (device-release path). Separate from the chunk program so each
+        boundary pays only its own (bucketed) release-list width instead
+        of the global maximum — the Borg duration distribution makes the
+        max ~2.4× the mean.
+
+        The update is a scan over 256-wide one-hot COMMIT blocks (the
+        wave-commit trick, measured 4×+ faster than a [K]-index scatter
+        on TPU — scatter serializes colliding indices): each block builds
+        the [Wr, N] placement one-hot once and contracts it with both the
+        request rows (→ used delta) and the matched-group matrix (→ a
+        node-space [G, N] released-count accumulator). The count planes
+        then drop to domain space through ONE static node→domain one-hot
+        matmul; match_total is its row sum. Exactness: one-hot operands
+        are 0/1 (each product term exact) and the summed quantities are
+        the bucketed k8s magnitudes the engine already relies on being
+        associative-exact (ops/tpu3.py module docstring)."""
+        dyn_mode = self._dyn is not None
+        key = (K, dyn_mode)
+        fn = self._rel_fn_cache.get(key)
+        if fn is not None:
+            return fn
+        core = self._release_core()
+        Dcap = self.static3.Dcap
+        nparts = core.nparts
+        want_an, want_pf = core.want_an, core.want_pf
+
+        def rel_one(state, vassign, rel_pos, rel_req, rel_mg,
+                    rel_anti, rel_pref, rel_prefw,
+                    ov_nodes=None, ov_gdom=None, ov_old=None):
+            node_k = vassign[rel_pos]  # sentinel pos → the PAD tail slot
+            nd = jnp.where(node_k >= 0, node_k, -1)  # -1 matches no node
+            if not dyn_mode:
+                return core(
+                    state, nd, rel_req, rel_mg, rel_anti, rel_pref,
+                    rel_prefw,
+                )
+            # DynTables correction layered on the base update: a node the
+            # scenario relabeled releases into its OVERRIDDEN domain (and
+            # base validity doesn't apply — a node that gained the key
+            # releases into the appended domain the bind counted). Uses
+            # the UNMASKED accumulator; old/new one-hots encode validity.
+            state, rc_raw = core(
+                state, nd, rel_req, rel_mg, rel_anti, rel_pref,
+                rel_prefw, want_raw=True,
+            )
+            raw_chunks = jnp.split(rc_raw, nparts, axis=0)
+            safe_ov = jnp.where(ov_nodes >= 0, ov_nodes, 0)
+            ok_ov = (ov_nodes >= 0).astype(jnp.float32)  # [K32]
+            ar_D = jnp.arange(Dcap, dtype=jnp.float32)
+            mk_oh = lambda a: (
+                (a[..., None] == ar_D) & (a[..., None] >= 0)
+            ).astype(jnp.float32)  # [G, K, Dcap]
+            doh = mk_oh(ov_gdom) - mk_oh(ov_old)
+
+            def corr_of(raw):
+                rv = raw[:, safe_ov] * ok_ov[None, :]  # [G, K32]
+                return jnp.einsum("gk,gkd->gd", rv, doh)
+
+            corr_mc = corr_of(raw_chunks[0])
+            new = {
+                "mc_dom": state.mc_dom - corr_mc,
+                "match_total": state.match_total - corr_mc.sum(-1),
+            }
+            if want_an:
+                new["anti_dom"] = state.anti_dom - corr_of(raw_chunks[1])
+            if want_pf:
+                new["pref_dom"] = state.pref_dom - corr_of(
+                    raw_chunks[1 + want_an]
+                )
             return state._replace(**new)
 
         fn = jax.jit(
@@ -1596,6 +1566,9 @@ class WhatIfEngine:
         }
         if self.retry_buffer:
             stg["mgt"] = jnp.asarray(matched.astype(np.int32))
+            stg["antit"] = jnp.asarray(anti_t)
+            stg["preft"] = jnp.asarray(pref_t)
+            stg["prefwt"] = jnp.asarray(prefw_t)
             stg["durt"] = jnp.asarray(self.pods.duration.astype(np.float32))
             stg["tbt"] = jnp.asarray(tb_all[:nfin].astype(np.float32))
             stg["tb_c"] = [
@@ -1634,6 +1607,9 @@ class WhatIfEngine:
             if self.retry_buffer:
                 RB = self.retry_buffer
                 mgt_d, durt_d = stg["mgt"], stg["durt"]
+                antit_d, preft_d, prefwt_d = (
+                    stg["antit"], stg["preft"], stg["prefwt"]
+                )
                 tbt_d, tb_c = stg["tbt"], stg["tb_c"]
                 zs = lambda fill, dt: jnp.full(
                     (self.S, RB), fill, dtype=dt
@@ -1766,7 +1742,8 @@ class WhatIfEngine:
                     states, vassign_d, rbuf_d, rcount_d,
                     pend_id_d, pend_node_d, pend_relb_d, out,
                 ) = self._chunk_fn(
-                    dc, states, srcs[0], srcs[1], mgt_d, durt_d, tbt_d,
+                    dc, states, srcs[0], srcs[1], mgt_d, antit_d,
+                    preft_d, prefwt_d, durt_d, tbt_d,
                     idx_chunks[ci], tb_c[ci], b_c[ci],
                     vassign_d, rbuf_d, rcount_d,
                     pend_id_d, pend_node_d, pend_relb_d,

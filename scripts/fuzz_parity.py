@@ -87,15 +87,11 @@ def run_fuzz(trials: int, master: int):
                 f"kw={kw} preempt={preempt} dmax={dmax} W={wave_width} C={C} dm={dm} "
                 f"mism={mism} placed {a.placed} vs {d.placed} "
                 f"evict {a.preemptions} vs {d.preemptions}")
-      # Boundary retry: the what-if device path vs the anchor (narrow
-      # envelope: no affinity/spread count planes, no preemption).
-      if (
-          dm
-          and not preempt
-          and not kw["with_affinity"]
-          and not kw["with_spread"]
-          and not ext
-      ):
+      # Boundary retry: the what-if device path vs the anchor (round-4
+      # widened envelope — affinity/spread count planes included; only
+      # preemption and DynTables stay out). Sampled at 40% — each retry
+      # sub-trial compiles its own what-if program.
+      if dm and not preempt and rng.random() < 0.4:
           from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
 
           RB = int(rng.choice([8, 32]))
@@ -103,8 +99,12 @@ def run_fuzz(trials: int, master: int):
               wi = WhatIfEngine(ec, ep, [Scenario()], cfg,
                                 wave_width=wave_width, chunk_waves=C,
                                 retry_buffer=RB)
-          except ValueError:
-              wi = None  # outside the retry envelope for this trace
+          except ValueError as e:
+              # Only the retry-envelope rejection may be skipped; any
+              # other construction error must fail the fuzz loudly.
+              if "retry_buffer requires" not in str(e):
+                  raise
+              wi = None
           if wi is not None:
               cases += 1
               ar = greedy_replay(ec, ep, cfg, wave_width=wave_width,

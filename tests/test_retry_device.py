@@ -206,3 +206,34 @@ def test_retry_multi_scenario_counts():
     )
     assert int(res.placed[0]) == anchor.placed
     assert int(res.placed[1]) <= int(res.placed[0])
+
+
+def test_retry_full_plugin_envelope_parity():
+    """Round 4 widening: retry works on traces WITH anti/pref count
+    planes, multi-topology spread and singleton host rows — the pend
+    release rides the same commit-block core as the static lists.
+    Device placed counts == anchor, and retry matters."""
+    cluster = make_cluster(3, seed=23)
+    pods, _ = make_workload(
+        150, seed=23, arrival_rate=60.0, duration_mean=1.5,
+        with_affinity=True, with_spread=True, with_tolerations=True,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    W, C, RB = 4, 4, 8
+    eng = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=W, chunk_waves=C,
+        retry_buffer=RB,
+    )
+    assert eng.static3.maintain_anti or eng.static3.maintain_pref
+    assert eng.static3.has_host_rows or not eng.static3.single_topo
+    res = eng.run()
+    anchor = greedy_replay(
+        ec, ep, cfg, wave_width=W, completions_chunk_waves=C,
+        retry_buffer=RB,
+    )
+    assert int(res.placed[0]) == anchor.placed
+    no_retry = greedy_replay(
+        ec, ep, cfg, wave_width=W, completions_chunk_waves=C
+    )
+    assert anchor.placed > no_retry.placed  # non-vacuous
