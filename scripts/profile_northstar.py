@@ -52,8 +52,13 @@ def main():
     print(f"trace gen: {time.perf_counter() - t0:.1f}s", flush=True)
 
     scenarios = uniform_scenarios(ec, S, seed=0)
+    # completions=False: profile the arrivals chunk program (the shared
+    # core; the completions-on path adds the bucketed release fns and the
+    # vassign fold on top — phase-attribute those with blocking timers,
+    # the pattern in the round-4 COVERAGE perf log).
     eng = WhatIfEngine(
-        ec, ep, scenarios, FrameworkConfig(), wave_width=wave, chunk_waves=chunk
+        ec, ep, scenarios, FrameworkConfig(), wave_width=wave,
+        chunk_waves=chunk, completions=False,
     )
     print(f"engine: {eng.engine}  W={wave} C={chunk} S={S} N={nodes}", flush=True)
     assert eng.engine == "v3", "profiler targets the v3 scan"
