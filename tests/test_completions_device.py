@@ -271,3 +271,27 @@ def test_whatif_device_release_full_plugin_envelope():
         ec, ep, scen, cfg, chunk_waves=4, completions=False
     ).run()
     assert (off.placed != r1.placed).any()
+
+
+def test_whatif_prebound_release_device_path():
+    """Pre-bound pods live in vassign's static tail: their completion
+    releases at the eligibility boundary through the device path, freeing
+    capacity for later arrivals — pinned against the anchor."""
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 1})])
+    pods = [
+        Pod("pre", requests={"cpu": 1}, arrival_time=0.0, duration=1.0,
+            node_name="n0"),
+        Pod("f1", requests={}, arrival_time=2.0),
+        Pod("f2", requests={}, arrival_time=3.0),
+        Pod("b", requests={"cpu": 1}, arrival_time=5.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    eng = WhatIfEngine(ec, ep, [Scenario()], cfg, wave_width=1, chunk_waves=1)
+    assert eng._completions_dev
+    res = eng.run()
+    anchor = greedy_replay(ec, ep, cfg, wave_width=1, completions_chunk_waves=1)
+    assert anchor.assignments[3] == 0  # b fits once pre released
+    assert int(res.placed[0]) == anchor.placed == 3
