@@ -1,12 +1,13 @@
-"""Worker for the 2-process DCN test (SURVEY §5 distributed backend):
-launched as a subprocess with 4 virtual CPU devices, joins the
-jax.distributed coordinator, runs a tiny mesh-sharded what-if over the 8
-GLOBAL devices, and prints per-scenario placed counts as one JSON line.
+"""Worker for the multi-process DCN tests (SURVEY §5 distributed
+backend): launched as one of DCN_NPROC subprocesses with 8//DCN_NPROC
+virtual CPU devices each, joins the jax.distributed coordinator, runs a
+tiny mesh-sharded what-if over the 8 GLOBAL devices, and prints
+per-scenario placed counts as one JSON line.
 
-Env (set by the parent test): DCN_COORD, DCN_NPROC, DCN_PID.
-Platform env (JAX_PLATFORMS=cpu, --xla_force_host_platform_device_count=4)
-must be set BEFORE jax import — the parent passes it through the
-environment, not this module.
+Env (set by the parent test): DCN_COORD, DCN_NPROC, DCN_PID. Platform env
+(JAX_PLATFORMS=cpu, --xla_force_host_platform_device_count=…) must be set
+BEFORE jax import — the parent passes it through the environment, not
+this module.
 """
 
 import json
@@ -32,9 +33,10 @@ def main() -> None:
         num_processes=int(os.environ["DCN_NPROC"]),
         process_id=int(os.environ["DCN_PID"]),
     )
-    assert jax.process_count() == int(os.environ["DCN_NPROC"])
+    nproc = int(os.environ["DCN_NPROC"])
+    assert jax.process_count() == nproc
     assert jax.device_count() == 8, jax.devices()
-    assert len(jax.local_devices()) == 4
+    assert len(jax.local_devices()) == 8 // nproc
 
     import numpy as np
 
@@ -49,7 +51,7 @@ def main() -> None:
     )
     ec, ep = encode(cluster, pods)
     scenarios = uniform_scenarios(ec, 8, seed=21, p_capacity=0.5, p_taint=0.3)
-    mesh = make_mesh()  # 8 global devices across the 2 processes
+    mesh = make_mesh()  # 8 global devices across the processes
     res = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), mesh=mesh, chunk_waves=4
     ).run()

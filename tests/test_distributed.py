@@ -1,10 +1,11 @@
-"""2-process jax.distributed (DCN) execution of the mesh-sharded what-if
-(SURVEY §5 distributed communication backend; VERDICT r2 #5: the path must
-have a passing caller, not just exist).
+"""Multi-process jax.distributed (DCN) execution of the mesh-sharded
+what-if (SURVEY §5 distributed communication backend; VERDICT r2 #5: the
+path must have a passing caller, not just exist).
 
-Two subprocesses × 4 virtual CPU devices join a local coordinator; the
-scenario mesh spans all 8 global devices; per-scenario placed counts must
-equal the single-process 8-device run bit-for-bit."""
+nproc subprocesses × 8//nproc virtual CPU devices join a local
+coordinator; the scenario mesh spans all 8 global devices; per-scenario
+placed counts must equal the single-process 8-device run bit-for-bit.
+Default suite runs the 2-process split; the 4-process variant is slow."""
 
 import json
 import os
@@ -29,7 +30,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _reference_placed_cached():
+    return _reference_placed_impl()
+
+
 def _reference_placed() -> np.ndarray:
+    return _reference_placed_cached()
+
+
+def _reference_placed_impl() -> np.ndarray:
     """Single-process 8-device reference (same trace/scenarios/seed)."""
     cluster = make_cluster(12, seed=21, taint_fraction=0.2)
     pods, _ = make_workload(
@@ -45,14 +58,16 @@ def _reference_placed() -> np.ndarray:
     return res.placed
 
 
-def test_two_process_dcn_matches_single_process():
+def _run_dcn(nproc: int) -> None:
     port = _free_port()
     env_base = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "XLA_FLAGS": (
+            f"--xla_force_host_platform_device_count={8 // nproc}"
+        ),
         "DCN_COORD": f"127.0.0.1:{port}",
-        "DCN_NPROC": "2",
+        "DCN_NPROC": str(nproc),
         # Workers import the repo package from the checkout. Any axon
         # sitecustomize dir is dropped: it pre-imports jax and initializes
         # the backend before jax.distributed gets a chance.
@@ -66,7 +81,7 @@ def test_two_process_dcn_matches_single_process():
         ),
     }
     procs = []
-    for pid in range(2):
+    for pid in range(nproc):
         env = dict(env_base, DCN_PID=str(pid))
         procs.append(
             subprocess.Popen(
@@ -105,6 +120,19 @@ def test_two_process_dcn_matches_single_process():
                 q.kill()
                 q.wait()
 
-    # Both processes hold the full (replicated-at-gather) result.
-    np.testing.assert_array_equal(outs[0], outs[1])
+    # Every process holds the full (replicated-at-gather) result.
+    for o in outs[1:]:
+        np.testing.assert_array_equal(outs[0], o)
     np.testing.assert_array_equal(outs[0], _reference_placed())
+
+
+def test_two_process_dcn_matches_single_process():
+    _run_dcn(2)
+
+
+@pytest.mark.slow
+def test_four_process_dcn_matches_single_process():
+    """4 processes x 2 virtual devices each — the same mesh, a deeper
+    process split (SURVEY §5 distributed backend: multi-host beyond a
+    pair)."""
+    _run_dcn(4)
