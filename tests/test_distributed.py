@@ -58,7 +58,7 @@ def _reference_placed_impl() -> np.ndarray:
     return res.placed
 
 
-def _run_dcn(nproc: int) -> None:
+def _run_dcn(nproc: int, timeout: int = 180) -> None:
     port = _free_port()
     env_base = {
         **os.environ,
@@ -97,11 +97,11 @@ def _run_dcn(nproc: int) -> None:
         for p in procs:
             try:
                 # Healthy runs finish in ~35 s (round-4 measurement);
-                # 180 s bounds a flaky coordinator bind without turning
-                # the fast suite into a 7-minute hang (VERDICT r3 weak
-                # #5 — the kill-on-failure cleanup below already reaps
-                # the sibling).
-                out, err = p.communicate(timeout=180)
+                # the bound catches a flaky coordinator bind without
+                # turning the fast suite into a 7-minute hang (VERDICT
+                # r3 weak #5 — the kill-on-failure cleanup below already
+                # reaps the siblings).
+                out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 pytest.fail("DCN worker timed out")
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
@@ -134,5 +134,7 @@ def test_two_process_dcn_matches_single_process():
 def test_four_process_dcn_matches_single_process():
     """4 processes x 2 virtual devices each — the same mesh, a deeper
     process split (SURVEY §5 distributed backend: multi-host beyond a
-    pair)."""
-    _run_dcn(4)
+    pair). Slow-marked; the wider budget absorbs 4 fresh per-process
+    compiles on a loaded machine (it timed out at 180 s once when the
+    full suite shared the host with a TPU run)."""
+    _run_dcn(4, timeout=420)
