@@ -170,11 +170,12 @@ def validate_config(cfg) -> list:
         errors.append(
             "whatIf.retryBuffer is not supported with devicePreemption"
         )
-    if not (
-        cfg.whatif.completions is None
-        or isinstance(cfg.whatif.completions, bool)
-    ):
-        errors.append("whatIf.completions: must be true or false")
+    if cfg.whatif.retry_buffer and cfg.whatif.completions is False:
+        errors.append(
+            "whatIf.retryBuffer requires the device-release path; remove "
+            "whatIf.completions: false (the retry pass runs at completion "
+            "boundaries)"
+        )
     if cfg.chunk_waves <= 0:
         errors.append("chunkWaves: must be > 0")
     if cfg.wave_width != "auto" and cfg.wave_width <= 0:
@@ -188,7 +189,13 @@ def validate_config(cfg) -> list:
 
 
 def cmd_validate(args) -> int:
-    cfg = SimConfig.load(args.config)
+    try:
+        cfg = SimConfig.load(args.config)
+    except ValueError as e:
+        # Parse-time schema errors (e.g. non-bool whatIf.completions)
+        # still come out as the JSON error report, not a traceback.
+        print(json.dumps({"errors": [str(e)]}, indent=2))
+        return 1
     errors = validate_config(cfg)
     nodes = cfg.borg.nodes if cfg.borg else cfg.cluster.nodes
     tasks = (

@@ -18,21 +18,24 @@ import os
 from pathlib import Path
 
 _DEFAULT_DIR = "~/.cache/ksim_tpu_xla"
-_enabled = False
+_configured_dir: str | None = None
 
 
 def enable(cache_dir: str | None = None) -> str | None:
     """Idempotently enable the persistent compilation cache. Returns the
-    cache directory, or None when disabled/unavailable."""
-    global _enabled
+    cache directory JAX is actually configured with, or None when
+    disabled/unavailable. A repeat call with a different ``cache_dir``
+    returns the originally-configured path (JAX keeps using it), never
+    the ignored new one."""
+    global _configured_dir
     if os.environ.get("KSIM_COMPILE_CACHE", "1") in ("", "0"):
         return None
     path = Path(
         cache_dir
         or os.environ.get("KSIM_COMPILE_CACHE_DIR", _DEFAULT_DIR)
     ).expanduser()
-    if _enabled:
-        return str(path)
+    if _configured_dir is not None:
+        return _configured_dir
     try:
         path.mkdir(parents=True, exist_ok=True)
         import jax
@@ -43,5 +46,5 @@ def enable(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:  # noqa: BLE001 — a broken cache must never be fatal
         return None
-    _enabled = True
-    return str(path)
+    _configured_dir = str(path)
+    return _configured_dir

@@ -95,6 +95,18 @@ class WhatIfSpec:
     retry_buffer: int = 0
 
 
+def _coerce_completions(v: object) -> Optional[bool]:
+    """None stays None (default-on with warn); bool/int coerce to bool;
+    everything else is a config error, not a truthy surprise."""
+    if v is None:
+        return None
+    if isinstance(v, (bool, int)):
+        return bool(v)
+    raise ValueError(
+        f"whatIf.completions: must be true or false, got {v!r}"
+    )
+
+
 @dataclass
 class SimConfig:
     strategy: str = "cpu"
@@ -169,12 +181,11 @@ class SimConfig:
             taint_p=float(wi.get("taintP", 0.1)),
             # int 0/1 coerce to real bools — the engine distinguishes
             # None/True/False by IDENTITY (explicit True must hard-error
-            # when unhonorable; 0 must actually disable).
-            completions=(
-                bool(wi["completions"])
-                if isinstance(wi.get("completions"), (bool, int))
-                else wi.get("completions")
-            ),
+            # when unhonorable; 0 must actually disable). Anything else
+            # (e.g. the string "yes") raises HERE rather than silently
+            # behaving as default-on in engines built without CLI
+            # validate_config.
+            completions=_coerce_completions(wi.get("completions")),
             retry_buffer=int(wi.get("retryBuffer", 0)),
         )
         cfg.output = d.get("output")

@@ -226,3 +226,56 @@ class TestValidate:
         out = capsys.readouterr().out
         assert rc == 0
         assert '"errors": []' in out
+
+    def test_rejects_retry_buffer_with_completions_off(self, tmp_path, capsys):
+        """ADVICE r4: retryBuffer + completions:false must fail at
+        validate with a message naming completions, not later at engine
+        construction with a release-path message that never mentions it."""
+        from kubernetes_simulator_tpu.cli import main
+
+        cfg = self._write(
+            tmp_path,
+            {
+                "strategy": "jax",
+                "whatIf": {
+                    "scenarios": 4,
+                    "retryBuffer": 64,
+                    "completions": False,
+                },
+            },
+        )
+        rc = main(["validate", cfg])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "retryBuffer" in out and "completions" in out
+
+    def test_non_bool_completions_raises_at_parse(self):
+        """ADVICE r4: a string whatIf.completions (e.g. 'yes') must raise
+        in SimConfig.from_dict, not silently behave as default-on."""
+        import pytest
+
+        from kubernetes_simulator_tpu.utils.config import SimConfig
+
+        with pytest.raises(ValueError, match="whatIf.completions"):
+            SimConfig.from_dict({"whatIf": {"completions": "yes"}})
+        # int 0/1 and real bools still coerce.
+        assert SimConfig.from_dict(
+            {"whatIf": {"completions": 1}}
+        ).whatif.completions is True
+        assert SimConfig.from_dict(
+            {"whatIf": {"completions": False}}
+        ).whatif.completions is False
+
+    def test_compile_cache_repeat_enable_reports_configured_dir(
+        self, tmp_path
+    ):
+        """ADVICE r4: a second enable() with a different dir must return
+        the dir JAX actually uses, not the ignored new one."""
+        import pytest
+
+        from kubernetes_simulator_tpu.utils import compile_cache as cc
+
+        first = cc.enable()  # whatever conftest/env already configured
+        if first is None:
+            pytest.skip("compile cache disabled in this environment")
+        assert cc.enable(str(tmp_path / "other_cache")) == first
