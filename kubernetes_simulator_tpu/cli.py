@@ -29,7 +29,8 @@ def cmd_run(args) -> int:
     kw = {}
     if cfg.strategy == "jax":
         kw = {"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves,
-              "preemption": cfg.device_preemption}
+              "preemption": cfg.device_preemption,
+              "retry_buffer": cfg.whatif.retry_buffer}
     engine = factory(ec, ep, cfg.framework, **kw)
     with device_trace(args.profile_dir):
         res = engine.replay()
@@ -166,9 +167,26 @@ def validate_config(cfg) -> list:
         errors.append("whatIf.scenarios: must be >= 0")
     if cfg.whatif.retry_buffer < 0:
         errors.append("whatIf.retryBuffer: must be >= 0")
-    if cfg.whatif.retry_buffer and cfg.device_preemption:
+    if cfg.device_preemption not in (True, False, "tier", "kube"):
         errors.append(
-            "whatIf.retryBuffer is not supported with devicePreemption"
+            f"devicePreemption: must be true/false/'tier'/'kube', got "
+            f"{cfg.device_preemption!r}"
+        )
+    tier_on = cfg.device_preemption in (True, "tier")
+    if cfg.whatif.retry_buffer and tier_on:
+        errors.append(
+            "whatIf.retryBuffer is not supported with tier devicePreemption"
+        )
+    if cfg.device_preemption == "kube" and not cfg.whatif.retry_buffer:
+        errors.append(
+            "devicePreemption: kube requires whatIf.retryBuffer > 0 "
+            "(failed pods reach the PostFilter through the boundary "
+            "retry pass)"
+        )
+    if cfg.device_preemption == "kube" and cfg.whatif.scenarios > 0:
+        errors.append(
+            "devicePreemption: kube runs on the single-replay engine "
+            "(run); the batch what-if engine supports tier preemption"
         )
     if cfg.whatif.retry_buffer and cfg.whatif.completions is False:
         errors.append(

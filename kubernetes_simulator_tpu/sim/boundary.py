@@ -1,0 +1,251 @@
+"""Shared chunk-boundary semantics for the greedy anchor and the device
+replay engine (SURVEY.md §2 L3/L4; VERDICT r4 next #1/#3).
+
+A "boundary" is the host synchronization point between device chunks —
+the same point where chunk-granular completions already apply. Three
+passes run there, in order:
+
+1. **Pending releases** — boundary-placed pods (retried/preempted binds)
+   whose scheduled release boundary has arrived free their contributions.
+2. **Static releases** — arrival-placed pods whose ``arrival + duration``
+   is at or before the boundary's start time, bound in chunks ≤ b−2 (the
+   one-chunk slack shared with the device pipeline).
+3. **Bounded retry / preemption pass** — the [K8S] activeQ analogue:
+   failed non-gang pods retry placement FIFO; under ``kube=True`` a pod
+   that still fails runs the EXACT kube PostFilter
+   (``SchedulerFramework._post_filter_preempt``: fewest victims, lowest
+   max victim priority, only the victims needed for THIS pod's fit,
+   lowest-priority-first eviction order) — victims are unbound with a
+   full count rewind (no phantom counts) and re-enter the queue, exactly
+   as the CPU event engine requeues them.
+
+The class owns the host bookkeeping (a live :class:`SchedState` mirror,
+assignments, counters). ``greedy_replay`` drives it slot-by-slot;
+``JaxReplayEngine`` folds whole device chunks into it and applies the
+returned (release, bind, evict) lists to the device carry as rank-1
+plane deltas through the existing release machinery — the kube
+preemption algorithm itself never enters the compiled program. That is
+the TPU-first shape of this feature: preemption is a rare, branchy,
+data-dependent search (victim prefixes over per-node sorted pod lists)
+that would poison the fused wave scan, but it only ever needs to run for
+the handful of pods that failed placement — so it runs on host at the
+sync points the engine already pays for, with the device program
+unchanged and the decision arithmetic bit-identical to the CPU engine's
+by construction (it IS the CPU engine's PostFilter).
+
+Fidelity is chunk-granular: a pod preempts at the first boundary after
+its failed chunk, not at its failure instant. At ``wave_width=1,
+chunk_waves=1`` the boundary follows every pod and placements match
+``CpuReplayEngine(enable_preemption=True)`` exactly on queue-trivial
+traces (tests/test_kube_preempt.py); at production chunk sizes the
+divergence is a measured, pinned number — the same contract as
+completions (tests/test_divergence_pin.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.framework import SchedulerFramework
+from ..models.encode import PAD, EncodedCluster, EncodedPods
+from ..models.state import bind, init_state, release_delta, unbind
+from .waves import WaveBatch
+
+# (pod, node) pairs collected for device delta application.
+PairList = List[Tuple[int, int]]
+
+
+class BoundaryOps:
+    """Host bookkeeping + boundary passes shared by the greedy anchor and
+    the device engine. All semantics here are THE semantics — the two
+    callers must only disagree in how placements inside a chunk are
+    produced (slot loop vs compiled wave scan), which the existing
+    greedy↔device parity suites pin."""
+
+    def __init__(
+        self,
+        ec: EncodedCluster,
+        ep: EncodedPods,
+        fw: SchedulerFramework,
+        waves: WaveBatch,
+        wave_width: int,
+        chunk_waves: int,
+        retry_buffer: int = 0,
+        kube: bool = False,
+    ):
+        if kube and not retry_buffer:
+            raise ValueError(
+                "preemption='kube' requires retry_buffer > 0 (failed pods "
+                "reach the PostFilter through the boundary retry pass)"
+            )
+        self.ec, self.ep, self.fw = ec, ep, fw
+        self.kube = kube
+        if retry_buffer:
+            # Wave-multiple rounding shared with the device retry pass
+            # (sim.whatif) — the caps must agree or placed counts diverge
+            # once a buffer fills past the raw capacity.
+            retry_buffer = -(-retry_buffer // wave_width) * wave_width
+        self.retry_buffer = retry_buffer
+        P = ep.num_pods
+        self.st = init_state(ec, ep)
+        self.assignments = np.where(
+            ep.bound_node >= 0, ep.bound_node, PAD
+        ).astype(np.int32)
+        self.released = np.zeros(P, bool)
+        self.rel_time = ep.arrival + np.where(
+            np.isfinite(ep.duration), ep.duration, np.inf
+        )
+        # Chunk index each pod was bound in (pre-bound = -2): boundary b
+        # releases only pods bound in chunks <= b-2 (one-chunk slack).
+        self.bind_chunk = np.full(P, 1 << 30, np.int64)
+        self.bind_chunk[ep.bound_node >= 0] = -2
+        self.retry_q: List[int] = []
+        self.pend: List[list] = []  # [relb, pod, node]
+        self.placed_total = 0
+        self.preemptions = 0
+        # [K8S] keeps every pending pod; the bounded analogue sheds load —
+        # loudly (VERDICT r4 weak #2: drops must be a reported number).
+        self.retry_dropped = 0
+        self.tb32: Optional[np.ndarray] = None
+        if retry_buffer:
+            # Boundary start times in f32 (finite prefix), matching the
+            # device's staged f32 table bit-for-bit.
+            firsts = waves.idx[0::chunk_waves, 0]
+            tb_all = np.where(
+                firsts >= 0, ep.arrival[np.clip(firsts, 0, None)], np.inf
+            )
+            nfin = int(np.isfinite(tb_all).sum())
+            self.tb32 = tb_all[:nfin].astype(np.float32)
+
+    # -- chunk-side hooks ---------------------------------------------------
+
+    def offer_failure(self, p: int) -> None:
+        """A non-gang pod that missed placement enters the FIFO buffer
+        (overflow drops the newest — counted)."""
+        if not self.retry_buffer or self.ep.group_id[p] != PAD:
+            return
+        if len(self.retry_q) < self.retry_buffer:
+            self.retry_q.append(int(p))
+        else:
+            self.retry_dropped += 1
+
+    def fold_chunk(self, ci: int, rows: np.ndarray, choices: np.ndarray) -> None:
+        """Fold one device chunk's placements into the host mirror (batch
+        form of the per-slot binds the greedy anchor performs inline; the
+        aggregate f32 sums are the same multiset in the same wave order).
+        Failures enter the retry buffer in wave order."""
+        ch = np.asarray(choices).reshape(rows.shape)
+        v = rows >= 0
+        ids = rows[v]
+        nd = ch[v]
+        placed = nd >= 0
+        pid = ids[placed]
+        pnd = nd[placed]
+        if pid.size:
+            du, dmc, daa, dpw = release_delta(self.ec, self.ep, pid, pnd)
+            self.st.used += du
+            self.st.match_count += dmc
+            self.st.anti_active += daa
+            self.st.pref_wsum += dpw
+            self.st.bound[pid] = pnd
+            self.assignments[pid] = pnd
+            self.bind_chunk[pid] = ci
+            self.placed_total += int(pid.size)
+        for p in ids[~placed]:
+            self.offer_failure(int(p))
+
+    # -- the boundary -------------------------------------------------------
+
+    def boundary(
+        self, b: int, t_chunk: float
+    ) -> Tuple[PairList, PairList, PairList]:
+        """Run boundary ``b`` (start time ``t_chunk``). Returns
+        ``(releases, binds, evictions)`` as (pod, node) pair lists — the
+        device engine turns them into carry-plane deltas; the greedy
+        anchor ignores them (its state IS self.st)."""
+        ec, ep, st = self.ec, self.ep, self.st
+        rel: PairList = []
+        binds: PairList = []
+        evicts: PairList = []
+        # 1. Pending releases of boundary-placed pods (relb encodes the
+        # time comparison already — no finite-t gate).
+        still = []
+        for entry in self.pend:
+            if entry[0] <= b:
+                p = int(entry[1])
+                rel.append((p, int(st.bound[p])))
+                unbind(ec, ep, st, p)
+                self.released[p] = True
+            else:
+                still.append(entry)
+        self.pend[:] = still
+        # 2. Static releases (pods that started at arrival).
+        if np.isfinite(t_chunk):
+            due = np.nonzero(
+                (st.bound >= 0)
+                & ~self.released
+                & np.isfinite(self.rel_time)
+                & (self.rel_time <= t_chunk)
+                & (self.bind_chunk < b - 1)
+            )[0]
+            for p in due:
+                p = int(p)
+                rel.append((p, int(st.bound[p])))
+                unbind(ec, ep, st, p)
+                self.released[p] = True
+        # 3. Bounded retry (+ kube preemption) pass, FIFO order. Victims
+        # re-enter the walked queue and are attempted later in the SAME
+        # pass — mirroring the CPU event engine, which requeues victims
+        # into the activeQ at the preemption instant.
+        if self.retry_buffer and self.retry_q:
+            q = self.retry_q
+            still_q: List[int] = []
+            i = 0
+            while i < len(q):
+                p = q[i]
+                i += 1
+                res = self.fw.schedule_one(st, p, allow_preemption=self.kube)
+                if res.node == PAD:
+                    still_q.append(p)
+                    continue
+                for v in res.victims:
+                    v = int(v)
+                    evicts.append((v, int(st.bound[v])))
+                    unbind(ec, ep, st, v)  # FULL count rewind — no phantoms
+                    self.preemptions += 1
+                    # A victim with a scheduled pending release no longer
+                    # holds what that release would free — cancel it; and
+                    # if re-placed later it starts at THAT boundary, so its
+                    # arrival-based static release must never fire.
+                    self.pend[:] = [e for e in self.pend if e[1] != v]
+                    self.bind_chunk[v] = 1 << 30
+                    if self.assignments[v] >= 0:
+                        self.assignments[v] = PAD
+                        if ep.bound_node[v] == PAD:
+                            self.placed_total -= 1
+                    if (len(q) - i) + len(still_q) < self.retry_buffer:
+                        q.append(v)
+                    else:
+                        self.retry_dropped += 1
+                bind(ec, ep, st, p, res.node)
+                binds.append((p, int(res.node)))
+                self.assignments[p] = res.node
+                if ep.bound_node[p] == PAD:
+                    self.placed_total += 1
+                # Release schedule: f32 boundary search, >= b+1 — the pod
+                # STARTS now, not at arrival.
+                dur = np.float32(ep.duration[p])
+                if np.isfinite(dur) and len(self.pend) < self.retry_buffer:
+                    rb = int(
+                        np.searchsorted(
+                            self.tb32,
+                            np.float32(t_chunk) + dur,
+                            side="left",
+                        )
+                    )
+                    if rb < len(self.tb32):
+                        self.pend.append([max(rb, b + 1), p, int(res.node)])
+            self.retry_q = still_q
+        return rel, binds, evicts

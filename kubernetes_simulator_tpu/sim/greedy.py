@@ -7,9 +7,9 @@ This is the parity anchor for the device scan (SURVEY.md §4.2): for any
 workload, `greedy_replay` and the `jax` strategy must produce identical
 placements.
 
-``preemption=True`` adds the greedy engines' TIER preemption (the device
-semantics — NOT kube's minimal-victims PostFilter, which lives in the CPU
-event engine): when a pod is unschedulable, a node may be chosen where
+``preemption="tier"`` (or ``True``) adds the greedy engines' TIER
+preemption (the fast in-scan approximation — NOT kube's minimal-victims
+PostFilter): when a pod is unschedulable, a node may be chosen where
 evicting ALL lower-priority non-gang pods makes it fit (resource fit +
 taint/node-affinity + the count-based masks at their CURRENT, pre-eviction
 values); candidates rank by (fewest victims, lowest max victim tier,
@@ -18,6 +18,18 @@ their affinity/spread count contributions are NOT rewound ("phantom
 counts") — aggregate state can't attribute counts to individual victims.
 At most one preemption fires per wave; gang pods neither preempt nor get
 evicted.
+
+``preemption="kube"`` (round 5) is the kube-EXACT minimal-victims
+PostFilter, run at chunk boundaries through the retry buffer
+(:mod:`.boundary`): a failed non-gang pod retries at each boundary and,
+still failing, preempts per upstream defaultpreemption — fewest victims,
+lowest max victim priority, victims chosen lowest-priority-first, ONLY
+the victims needed for this pod's fit, with a FULL count rewind (no
+phantom counts). Victims re-enter the retry buffer exactly as the CPU
+event engine requeues them. Requires ``completions_chunk_waves`` (the
+boundary grid) and ``retry_buffer > 0``. In-wave attempts never preempt —
+fidelity is chunk-granular (exact vs CpuReplayEngine at W=1/C=1 on
+queue-trivial traces; measured divergence at production chunk sizes).
 """
 
 from __future__ import annotations
@@ -29,7 +41,7 @@ import numpy as np
 
 from ..framework.framework import FrameworkConfig, SchedulerFramework
 from ..models.encode import PAD, EncodedCluster, EncodedPods
-from ..models.state import bind, init_state, unbind
+from ..models.state import bind, unbind
 from .runtime import ReplayResult
 from .waves import WaveBatch, pack_waves
 
@@ -82,13 +94,26 @@ def _try_tier_preempt(fw, ec, ep, st, p, pod_tier):
     return n, victims
 
 
+def normalize_preemption(preemption) -> Optional[str]:
+    """False/None → None; True → "tier"; "tier"/"kube" pass through."""
+    if preemption in (False, None):
+        return None
+    if preemption is True:
+        return "tier"
+    if preemption in ("tier", "kube"):
+        return preemption
+    raise ValueError(
+        f"preemption must be False/True/'tier'/'kube', got {preemption!r}"
+    )
+
+
 def greedy_replay(
     ec: EncodedCluster,
     ep: EncodedPods,
     config: Optional[FrameworkConfig] = None,
     waves: Optional[WaveBatch] = None,
     wave_width: int = 8,
-    preemption: bool = False,
+    preemption=False,
     completions_chunk_waves: Optional[int] = None,
     retry_buffer: int = 0,
 ) -> ReplayResult:
@@ -110,101 +135,46 @@ def greedy_replay(
     (overflow = the release is dropped and the pod holds its resources to
     the end). Requires ``completions_chunk_waves``. Mirrors
     WhatIfEngine(retry_buffer=...)'s device semantics exactly."""
-    config = config or FrameworkConfig()
-    config.enable_preemption = False  # greedy semantics: no kube PostFilter
+    from .boundary import BoundaryOps
+
+    from dataclasses import replace as dc_replace
+
+    mode = normalize_preemption(preemption)
+    # kube PostFilter runs ONLY through the boundary pass; in-wave
+    # attempts pass allow_preemption=False below. Copy, don't write
+    # through the caller's config object.
+    config = dc_replace(
+        config or FrameworkConfig(), enable_preemption=mode == "kube"
+    )
     if retry_buffer and not completions_chunk_waves:
         raise ValueError("retry_buffer requires completions_chunk_waves")
-    if retry_buffer and preemption:
-        raise ValueError("retry_buffer is not supported with preemption")
-    if retry_buffer:
-        # Same rounding as the device twin (its retry pass reuses the
-        # W-wide wave step) — the two caps must agree or placed counts
-        # diverge once a buffer fills past the raw capacity.
-        retry_buffer = -(-retry_buffer // wave_width) * wave_width
+    if retry_buffer and mode == "tier":
+        raise ValueError("retry_buffer is not supported with tier preemption")
+    if mode == "kube" and not completions_chunk_waves:
+        raise ValueError(
+            "preemption='kube' requires completions_chunk_waves (the "
+            "boundary grid the PostFilter pass runs on)"
+        )
     fw = SchedulerFramework(ec, ep, config)
     if waves is None:
         waves = pack_waves(ep, wave_width)
-    st = init_state(ec, ep)
+    ops = BoundaryOps(
+        ec, ep, fw, waves, wave_width, completions_chunk_waves or 1,
+        retry_buffer=retry_buffer, kube=mode == "kube",
+    )
+    st = ops.st
     _, pod_tier = priority_tiers(ep)
     # Pre-bound pods appear in assignments (matching the device engines)
     # but never count toward placed_total (they were not scheduled here).
-    assignments = np.where(ep.bound_node >= 0, ep.bound_node, PAD).astype(np.int32)
-    placed_total = 0
-    preemptions = 0
-    rel_time = ep.arrival + np.where(np.isfinite(ep.duration), ep.duration, np.inf)
-    released = np.zeros(ep.num_pods, bool)
-    # Chunk index each pod was bound in (pre-bound = -2). Boundary b
-    # releases only pods bound in chunks <= b-2 — the ONE-CHUNK SLACK that
-    # lets the device engines overlap host release computation with the
-    # in-flight chunk (round 3; matched here so the anchor stays exact).
-    bind_chunk = np.full(ep.num_pods, 1 << 30, np.int64)
-    bind_chunk[ep.bound_node >= 0] = -2
-    retry_q: List[int] = []  # FIFO waiting pods (ids)
-    pend: List[list] = []  # [relb, pod, node] retried-placed awaiting release
-    tb32 = None
-    if retry_buffer:
-        # Boundary start times in f32 (finite prefix), matching the
-        # device's staged f32 table bit-for-bit.
-        C = completions_chunk_waves
-        firsts = waves.idx[0::C, 0]
-        tb_all = np.where(
-            firsts >= 0, ep.arrival[np.clip(firsts, 0, None)], np.inf
-        )
-        nfin = int(np.isfinite(tb_all).sum())
-        tb32 = tb_all[:nfin].astype(np.float32)
+    assignments = ops.assignments
+    preemptions = 0  # tier evictions (kube evictions live in ops)
     t0 = time.perf_counter()
     for wi, wave in enumerate(waves.idx):
         if completions_chunk_waves and wi % completions_chunk_waves == 0:
             b = wi // completions_chunk_waves
             first = int(wave[0]) if wave.shape[0] else -1
             t_chunk = float(ep.arrival[first]) if first >= 0 else np.inf
-            # 1. Pending releases of retried-placed pods (relb encodes
-            # the time comparison already — no finite-t gate).
-            still = []
-            for entry in pend:
-                if entry[0] <= b:
-                    unbind(ec, ep, st, int(entry[1]))
-                    released[entry[1]] = True
-                else:
-                    still.append(entry)
-            pend[:] = still
-            # 2. Static releases (pods that started at arrival).
-            if np.isfinite(t_chunk):
-                due = np.nonzero(
-                    (st.bound >= 0)
-                    & ~released
-                    & np.isfinite(rel_time)
-                    & (rel_time <= t_chunk)
-                    & (bind_chunk < b - 1)
-                )[0]
-                for p in due:
-                    unbind(ec, ep, st, int(p))  # assignments keep the node
-                    released[p] = True
-            # 3. Bounded retry pass over the buffer, FIFO order.
-            if retry_buffer and retry_q:
-                still_q = []
-                for p in retry_q:
-                    res = fw.schedule_one(st, p)
-                    if res.node == PAD:
-                        still_q.append(p)
-                        continue
-                    bind(ec, ep, st, p, res.node)
-                    assignments[p] = res.node
-                    placed_total += 1
-                    # Release schedule: f32 boundary search, >= b+1 —
-                    # the pod STARTS now, not at arrival.
-                    dur = np.float32(ep.duration[p])
-                    if np.isfinite(dur) and len(pend) < retry_buffer:
-                        rb = int(
-                            np.searchsorted(
-                                tb32,
-                                np.float32(t_chunk) + dur,
-                                side="left",
-                            )
-                        )
-                        if rb < len(tb32):
-                            pend.append([max(rb, b + 1), p, res.node])
-                retry_q[:] = still_q
+            ops.boundary(b, t_chunk)
         slot_choice: List[int] = []
         slot_pods: List[int] = []
         evicted_in_wave: set = set()
@@ -213,9 +183,9 @@ def greedy_replay(
             if p < 0:
                 continue
             p = int(p)
-            res = fw.schedule_one(st, p)
+            res = fw.schedule_one(st, p, allow_preemption=False)
             node = res.node
-            if node == PAD and preemption and not preempted_this_wave:
+            if node == PAD and mode == "tier" and not preempted_this_wave:
                 hit = _try_tier_preempt(fw, ec, ep, st, p, pod_tier)
                 if hit is not None:
                     node, victims = hit
@@ -230,7 +200,7 @@ def greedy_replay(
                         if assignments[v] >= 0:
                             assignments[v] = PAD
                             if ep.bound_node[v] == PAD:  # scheduled here
-                                placed_total -= 1
+                                ops.placed_total -= 1
                         elif v in slot_pods:
                             evicted_in_wave.add(v)
             if node != PAD:
@@ -251,18 +221,24 @@ def greedy_replay(
                 unbind(ec, ep, st, p)
             elif c != PAD:
                 assignments[p] = c
-                placed_total += 1
+                ops.placed_total += 1
                 if completions_chunk_waves:
-                    bind_chunk[p] = wi // completions_chunk_waves
-            elif (
-                retry_buffer
-                and g == PAD
-                and len(retry_q) < retry_buffer
-            ):
+                    ops.bind_chunk[p] = wi // completions_chunk_waves
+            else:
                 # Failed non-gang pod enters the retry buffer (slot
                 # order within the wave; overflow drops the newest).
-                retry_q.append(p)
+                ops.offer_failure(p)
+    if mode == "kube":
+        # Trailing boundary: pods that failed in the LAST chunk still get
+        # their PostFilter attempt (the CPU engine preempts at the failure
+        # instant; without this a late high-priority pod would never
+        # preempt). t = inf ⇒ no static releases, no pend scheduling.
+        ops.boundary(
+            -(-waves.idx.shape[0] // (completions_chunk_waves or 1)), np.inf
+        )
     wall = time.perf_counter() - t0
+    placed_total = ops.placed_total
+    preemptions += ops.preemptions
     to_schedule = int((ep.bound_node == PAD).sum())
     util = {}
     for rname in ("cpu", "memory"):
@@ -283,4 +259,5 @@ def greedy_replay(
         virtual_makespan=float(ep.arrival.max()) if ep.num_pods else 0.0,
         utilization=util,
         state=st,
+        retry_dropped=ops.retry_dropped,
     )

@@ -392,35 +392,64 @@ class JaxReplayEngine:
         chunk_waves: int = 2048,
         engine: str = "v3",
         dmax_coarse: int = 128,
-        preemption: bool = False,
+        preemption=False,
         completions: Optional[bool] = None,
+        retry_buffer: int = 0,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
-        label perturbations change topology domains). ``preemption``: the
-        greedy engines' tier preemption (sim.greedy docstring), v3 only.
+        label perturbations change topology domains). ``preemption``:
+        ``"tier"``/``True`` = the greedy engines' in-scan tier preemption
+        (sim.greedy docstring), v3 only; ``"kube"`` (round 5) = the EXACT
+        kube minimal-victims PostFilter run at chunk boundaries through the
+        retry buffer (sim.boundary docstring — the device program is
+        unchanged; victims/binds land on the carry as rank-1 plane deltas).
+        ``"kube"`` requires ``retry_buffer > 0``.
         ``completions``: chunk-granular pod completions — before each chunk,
         placed pods whose ``arrival + duration`` is at or before the chunk
         start release their resources and count contributions (host-computed
         delta planes subtracted from the carry). Active when the trace has
-        finite durations. Works WITH ``preemption`` since round 4: releases
-        also drop the per-tier planes (pod tiers are static), folds run
-        eagerly so eviction events precede the next boundary's release
+        finite durations. Works WITH tier ``preemption`` since round 4:
+        releases also drop the per-tier planes (pod tiers are static), folds
+        run eagerly so eviction events precede the next boundary's release
         decisions, and evicted pods never release (their assignment is PAD
         by the time their boundary arrives); completed pods can no longer
         be evicted. Anchored by
-        ``greedy_replay(preemption=True, completions_chunk_waves=...)``."""
+        ``greedy_replay(preemption=True, completions_chunk_waves=...)``.
+        ``retry_buffer`` (round 5, task r4-#3): the [K8S] activeQ analogue
+        on the single-replay engine — failed non-gang pods re-attempt
+        placement at every chunk boundary via the host boundary pass
+        (sim.boundary), bit-identical to
+        ``greedy_replay(retry_buffer=...)``; folds run eagerly (one
+        blocking fetch per chunk — correctness over overlap, as with
+        tier × completions)."""
         from ..ops import tpu3 as V3
+        from .greedy import normalize_preemption
 
-        if preemption and engine != "v3":
-            raise ValueError("device preemption requires engine='v3'")
+        mode = normalize_preemption(preemption)
+        if mode == "tier" and engine != "v3":
+            raise ValueError("device tier preemption requires engine='v3'")
+        if mode == "tier" and retry_buffer:
+            raise ValueError(
+                "retry_buffer is not supported with tier preemption"
+            )
+        if mode == "kube" and not retry_buffer:
+            raise ValueError(
+                "preemption='kube' requires retry_buffer > 0 (failed pods "
+                "reach the PostFilter through the boundary retry pass)"
+            )
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
+        self._config = config
         self.chunk_waves = chunk_waves
         self.engine = engine
         self.dmax_coarse = dmax_coarse
-        self.preemption = preemption
+        # self.preemption stays the TIER flag (the in-scan feature the
+        # compiled program and the what-if collect paths key off).
+        self.preemption = mode == "tier"
+        self.kube = mode == "kube"
+        self.retry_buffer = int(retry_buffer)
         self.completions = completions
         self.dc = T.DevCluster.from_encoded(ec)
         # "auto": measured optimum is W=8 across shapes (W=16 loses to the
@@ -431,7 +460,7 @@ class JaxReplayEngine:
         self.wave_width = wave_width
         if engine == "v3":
             self.static3 = V3.V3Static.build(
-                ec, pods, self.spec, dmax_coarse, preemption=preemption
+                ec, pods, self.spec, dmax_coarse, preemption=self.preemption
             )
             self.shared3 = V3.Shared3.build(ec, self.static3)
             self.chunk_fn = make_chunk_fn3_src(
@@ -559,6 +588,173 @@ class JaxReplayEngine:
             )
         return jax.tree.map(jnp.subtract, state, delta)
 
+    def _apply_boundary_delta(self, state, sub_pairs, add_pairs):
+        """Net host-layout plane delta of one boundary pass — releases and
+        evictions (``sub_pairs``) minus retried/preempting binds
+        (``add_pairs``), each a list of (pod, node) — transformed to the
+        device layout and subtracted from the carry. The generalization of
+        :meth:`_apply_release`; the transform is linear, so one application
+        carries the whole pass."""
+        from ..models.state import release_delta
+        from ..ops import tpu3 as V3
+
+        def _split(pairs):
+            if not pairs:
+                return np.zeros(0, np.int64), np.zeros(0, np.int64)
+            arr = np.asarray(pairs, np.int64)
+            return arr[:, 0], arr[:, 1]
+
+        s_idx, s_nodes = _split(sub_pairs)
+        a_idx, a_nodes = _split(add_pairs)
+        du, dmc, daa, dpw = release_delta(self.ec, self.pods, s_idx, s_nodes)
+        au, amc, aaa, apw = release_delta(self.ec, self.pods, a_idx, a_nodes)
+        net = (du - au, dmc - amc, daa - aaa, dpw - apw)
+        if self.engine == "v3":
+            delta = V3.DevState3.from_host(*net, self.ec, self.static3)
+        else:
+            gdom = self._gdom
+            delta = T.DevState(
+                used=jnp.asarray(net[0]),
+                match_count=jnp.asarray(T.domain_to_node_space(net[1], gdom)),
+                anti_active=jnp.asarray(T.domain_to_node_space(net[2], gdom)),
+                pref_wsum=jnp.asarray(T.domain_to_node_space(net[3], gdom)),
+                match_total=jnp.asarray(net[1].sum(axis=1)),
+            )
+        return jax.tree.map(jnp.subtract, state, delta)
+
+    def _replay_boundary(self, node_events=None) -> ReplayResult:
+        """Replay with the host boundary pass active (``retry_buffer`` > 0
+        and/or ``preemption='kube'``; :mod:`.boundary`). Chunk folds run
+        EAGERLY — the pass at boundary b needs the host mirror current
+        through chunk b−1, so the pipeline pays one blocking fetch per
+        chunk (the same correctness-over-overlap trade the tier ×
+        completions path makes). The device chunk program is the plain
+        one: retry placements and kube preemption decisions are host
+        arithmetic (bit-identical to the CPU path by construction) landing
+        on the carry as rank-1 plane deltas."""
+        from dataclasses import replace as dc_replace
+
+        from ..framework.framework import FrameworkConfig, SchedulerFramework
+        from .boundary import BoundaryOps
+
+        idx = self.waves.idx
+        C = min(self.chunk_waves, max(idx.shape[0], 1))
+        pad_to = ((idx.shape[0] + C - 1) // C) * C
+        if pad_to != idx.shape[0]:
+            idx = np.concatenate(
+                [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)]
+            )
+        cfg = dc_replace(
+            self._config if self._config is not None else FrameworkConfig(),
+            enable_preemption=self.kube,
+        )
+        fw = SchedulerFramework(self.ec, self.pods, cfg)
+        bops = BoundaryOps(
+            self.ec, self.pods, fw,
+            WaveBatch(idx=idx, wave_width=self.wave_width),
+            self.wave_width, C,
+            retry_buffer=self.retry_buffer, kube=self.kube,
+        )
+        state = self._init_dev_state()
+        wave_times = self._wave_start_times(idx)
+        pending_events = sorted(node_events or [], key=lambda e: e.time)
+        saved_alloc = np.asarray(self.dc.allocatable).copy()
+        saved_alloc_ec = self.ec.allocatable.copy()
+        idx_chunks = (
+            [jnp.asarray(idx[c0 : c0 + C]) for c0 in range(0, idx.shape[0], C)]
+            if self.engine == "v3"
+            else None
+        )
+        t0 = time.perf_counter()
+        try:
+            for ci, c0 in enumerate(range(0, idx.shape[0], C)):
+                if pending_events:
+                    chunk_t = wave_times[c0]
+                    due = [e for e in pending_events if e.time <= chunk_t]
+                    if due:
+                        self._apply_node_events(due, saved_alloc)
+                        # The host mirror's plugins read ec.allocatable
+                        # live — keep it in lockstep with the device copy.
+                        for ev in due:
+                            if ev.kind == "node_down":
+                                self.ec.allocatable[ev.node] = 0.0
+                            elif ev.kind == "node_up":
+                                self.ec.allocatable[ev.node] = saved_alloc_ec[ev.node]
+                            elif ev.kind == "capacity_scale":
+                                self.ec.allocatable[ev.node] = (
+                                    saved_alloc_ec[ev.node] * ev.scale
+                                )
+                        pending_events = pending_events[len(due):]
+                rel, binds, evicts = bops.boundary(ci, wave_times[c0])
+                if rel or binds or evicts:
+                    state = self._apply_boundary_delta(
+                        state, rel + evicts, binds
+                    )
+                if self.engine == "v3":
+                    state, choices = self.chunk_fn(
+                        self.dc, state, self._slot_src, self._extra_src,
+                        idx_chunks[ci],
+                    )
+                else:
+                    state, choices = self.chunk_fn(
+                        self.dc, state,
+                        T.gather_slots(self.pods, idx[c0 : c0 + C]),
+                    )
+                # Eager fold: boundary ci+1 needs chunks <= ci in the mirror.
+                # (The choices buffer is fully consumed here — this path
+                # rejects checkpointing, so nothing retains it.)
+                bops.fold_chunk(ci, idx[c0 : c0 + C], np.asarray(choices))
+            if self.kube:
+                # Trailing boundary (greedy anchor twin): last-chunk
+                # failures still get their PostFilter attempt.
+                rel, binds, evicts = bops.boundary(idx.shape[0] // C, np.inf)
+                if rel or binds or evicts:
+                    state = self._apply_boundary_delta(
+                        state, rel + evicts, binds
+                    )
+                    jax.block_until_ready(state)
+        finally:
+            if node_events:
+                self.dc = self.dc._replace(allocatable=jnp.asarray(saved_alloc))
+                self.ec.allocatable[:] = saved_alloc_ec
+        wall = time.perf_counter() - t0
+
+        to_schedule = int((idx >= 0).sum())
+        assignments = bops.assignments
+        placed = bops.placed_total
+        if self.engine == "v3":
+            used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
+        else:
+            used = np.asarray(state.used)
+            mc = T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost)
+            aa = T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost)
+            pw = T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost)
+        util = {}
+        for rname in ("cpu", "memory"):
+            ri = self.ec.vocab._r.get(rname)
+            if ri is not None:
+                alloc = self.ec.allocatable[:, ri]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    u = np.where(alloc > 0, used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
+                util[rname] = float(u.mean())
+        host_state = SchedState(
+            used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
+            bound=assignments.copy(),
+        )
+        return ReplayResult(
+            assignments=assignments,
+            placed=placed,
+            unschedulable=to_schedule - placed,
+            preemptions=bops.preemptions,
+            attempts=to_schedule,
+            wall_clock_s=wall,
+            placements_per_sec=placed / wall if wall > 0 else 0.0,
+            virtual_makespan=float(self.pods.arrival.max()) if self.pods.num_pods else 0.0,
+            utilization=util,
+            state=host_state,
+            retry_dropped=bops.retry_dropped,
+        )
+
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
         """Arrival time of each wave's first valid pod (for timed events)."""
         first = idx[:, 0]
@@ -601,6 +797,19 @@ class JaxReplayEngine:
                 "checkpoint/resume is not supported with device preemption "
                 "(tier planes are not checkpointed)"
             )
+        if self.retry_buffer or self.kube:
+            if checkpoint_path or resume:
+                raise ValueError(
+                    "checkpoint/resume is not supported with the boundary "
+                    "retry/preemption pass (the retry buffer and host "
+                    "mirror are not checkpointed)"
+                )
+            if self.completions is False:
+                raise ValueError(
+                    "completions=False is not supported with retry_buffer/"
+                    "kube preemption (the boundary pass owns releases)"
+                )
+            return self._replay_boundary(node_events=node_events)
         if (
             node_events
             and self.engine == "v3"

@@ -59,6 +59,10 @@ class ReplayResult:
     virtual_makespan: float
     utilization: Dict[str, float]
     state: SchedState
+    # Pods dropped on retry-buffer overflow (device retry/kube-preemption
+    # paths; [K8S] keeps everything — a nonzero value means placements
+    # were lost to buffer capacity, not infeasibility).
+    retry_dropped: int = 0
 
     def summary(self) -> dict:
         return {
@@ -70,6 +74,7 @@ class ReplayResult:
             "placements_per_sec": round(self.placements_per_sec, 1),
             "virtual_makespan": self.virtual_makespan,
             "utilization": {k: round(v, 4) for k, v in self.utilization.items()},
+            "retry_dropped": self.retry_dropped,
         }
 
 

@@ -463,6 +463,18 @@ class WhatIfEngine:
         ``greedy_replay(retry_buffer=...)``. Requires the device-release
         completions path without DynTables; 0 = off (the r01–r03
         semantics)."""
+        from .greedy import normalize_preemption
+
+        pmode = normalize_preemption(preemption)
+        if pmode == "kube":
+            raise ValueError(
+                "kube preemption runs on the single-replay engine "
+                "(JaxReplayEngine / `run` with strategy: jax) — the batch "
+                "what-if engine supports tier preemption; see the "
+                "sim.boundary docstring for why the PostFilter pass is "
+                "per-replay host work"
+            )
+        preemption = pmode == "tier"
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)

@@ -118,8 +118,11 @@ class SimConfig:
     output: Optional[str] = None
     wave_width: int = 8
     chunk_waves: int = 1024
-    # Device tier preemption (jax strategy / what-if; sim.greedy docstring).
-    device_preemption: bool = False
+    # Device preemption (jax strategy / what-if): False, True/"tier" (the
+    # in-scan tier approximation), or "kube" (exact minimal-victims
+    # PostFilter at chunk boundaries; single-replay engine only — see
+    # sim.greedy / sim.boundary docstrings).
+    device_preemption: object = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "SimConfig":
@@ -192,7 +195,9 @@ class SimConfig:
         ww = d.get("waveWidth", 8)
         cfg.wave_width = ww if ww == "auto" else int(ww)
         cfg.chunk_waves = int(d.get("chunkWaves", 1024))
-        cfg.device_preemption = bool(d.get("devicePreemption", False))
+        # bool (legacy: true = tier) or the string "tier"/"kube".
+        dp = d.get("devicePreemption", False)
+        cfg.device_preemption = dp if isinstance(dp, str) else bool(dp)
         return cfg
 
     @classmethod
