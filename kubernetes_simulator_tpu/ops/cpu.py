@@ -324,9 +324,16 @@ def spread_weight(ec: EncodedCluster, g: int) -> np.float32:
     Upstream counts distinct domains among the pod's *filtered* nodes per
     scheduling cycle, and special-cases kubernetes.io/hostname as
     ``len(filteredNodes) − 2``. Scores deviate from upstream whenever
-    filtering excludes whole domains (the weight is then slightly larger
-    than upstream's). The static form keeps the weight a trace-time
-    constant — a per-pod dynamic count would force a per-pod [N]-wide
+    filtering excludes whole domains. MEASURED (round 5, vs an
+    upstream-faithful dynamic-weight oracle,
+    tests/test_spread_weight_deviation.py): a pod with ONE spread
+    constraint diverges 0.00% in placements even with half its domains
+    filtered out (NormalizeScore is scale-invariant up to rounding); a
+    pod spreading over MULTIPLE topologies at once (zone + hostname, half
+    the zones filtered) flips 5.4% of decisions (cascade-inclusive
+    assignment divergence 14.1%, placed counts equal). The static form
+    keeps the weight a trace-time constant — a per-pod dynamic count
+    would force a per-pod [N]-wide
     domain census into the device hot loop. Cross-backend parity is exact:
     all three backends consume this same value (f64 log cast once to
     f32)."""

@@ -395,6 +395,7 @@ class JaxReplayEngine:
         preemption=False,
         completions: Optional[bool] = None,
         retry_buffer: int = 0,
+        granularity_guard: bool = True,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
@@ -451,6 +452,7 @@ class JaxReplayEngine:
         self.kube = mode == "kube"
         self.retry_buffer = int(retry_buffer)
         self.completions = completions
+        self.granularity_guard = granularity_guard
         self.dc = T.DevCluster.from_encoded(ec)
         # "auto": measured optimum is W=8 across shapes (W=16 loses to the
         # W² in-wave coupling even on coarse-only traces) — kept as a
@@ -622,7 +624,10 @@ class JaxReplayEngine:
             )
         return jax.tree.map(jnp.subtract, state, delta)
 
-    def _replay_boundary(self, node_events=None) -> ReplayResult:
+    def _replay_boundary(
+        self, node_events=None, chunk_req: Optional[int] = None,
+        retry_req: Optional[int] = None,
+    ) -> ReplayResult:
         """Replay with the host boundary pass active (``retry_buffer`` > 0
         and/or ``preemption='kube'``; :mod:`.boundary`). Chunk folds run
         EAGERLY — the pass at boundary b needs the host mirror current
@@ -638,7 +643,11 @@ class JaxReplayEngine:
         from .boundary import BoundaryOps
 
         idx = self.waves.idx
-        C = min(self.chunk_waves, max(idx.shape[0], 1))
+        # (chunk_req, retry_req) arrive guard-adjusted from replay() —
+        # the single guard call site.
+        chunk_req = self.chunk_waves if chunk_req is None else chunk_req
+        retry_req = self.retry_buffer if retry_req is None else retry_req
+        C = min(chunk_req, max(idx.shape[0], 1))
         pad_to = ((idx.shape[0] + C - 1) // C) * C
         if pad_to != idx.shape[0]:
             idx = np.concatenate(
@@ -653,7 +662,7 @@ class JaxReplayEngine:
             self.ec, self.pods, fw,
             WaveBatch(idx=idx, wave_width=self.wave_width),
             self.wave_width, C,
-            retry_buffer=self.retry_buffer, kube=self.kube,
+            retry_buffer=retry_req, kube=self.kube,
         )
         state = self._init_dev_state()
         wave_times = self._wave_start_times(idx)
@@ -809,7 +818,25 @@ class JaxReplayEngine:
                     "completions=False is not supported with retry_buffer/"
                     "kube preemption (the boundary pass owns releases)"
                 )
-            return self._replay_boundary(node_events=node_events)
+        # Granularity-envelope guard (round 5, VERDICT r4 #2; see
+        # sim.granularity) — ONE call site for every replay path; no-op
+        # for duration-free traces, shapes inside the measured-safe
+        # regime, and explicit completions=False (which the boundary
+        # modes reject above).
+        chunk_req, retry_req = self.chunk_waves, self.retry_buffer
+        if self.completions is not False:
+            from .granularity import guard as _gran_guard
+
+            chunk_req, retry_req = _gran_guard(
+                self.pods, self.waves.idx, chunk_req, retry_req,
+                enabled=self.granularity_guard,
+                engine_name="jax replay engine",
+            )
+        if self.retry_buffer or self.kube:
+            return self._replay_boundary(
+                node_events=node_events, chunk_req=chunk_req,
+                retry_req=retry_req,
+            )
         if (
             node_events
             and self.engine == "v3"
@@ -839,7 +866,7 @@ class JaxReplayEngine:
             )
 
         idx = self.waves.idx
-        C = min(self.chunk_waves, max(idx.shape[0], 1))
+        C = min(chunk_req, max(idx.shape[0], 1))
         pad_to = ((idx.shape[0] + C - 1) // C) * C
         if pad_to != idx.shape[0]:
             idx = np.concatenate(

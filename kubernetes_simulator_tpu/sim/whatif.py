@@ -430,6 +430,7 @@ class WhatIfEngine:
         preemption: bool = False,
         completions: Optional[bool] = None,
         retry_buffer: int = 0,
+        granularity_guard: bool = True,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -682,6 +683,19 @@ class WhatIfEngine:
         # exists in practice, is singleton). Everything else keeps the
         # host pending-fold path.
         self._completions_dev = bool(self.completions_on and dev_ok)
+
+        if self.completions_on:
+            # Granularity-envelope guard (round 5, VERDICT r4 #2): a trace
+            # whose durations are ≪ the chunk arrival span silently loses
+            # most placements under chunk-granular releases — warn and
+            # shrink the chunks toward the duration scale (see
+            # sim.granularity). Opt out with granularity_guard=False.
+            from .granularity import guard as _gran_guard
+
+            self.chunk_waves, retry_buffer = _gran_guard(
+                pods, self.waves.idx, self.chunk_waves, retry_buffer,
+                enabled=granularity_guard, engine_name="what-if engine",
+            )
 
         self.retry_buffer = int(retry_buffer)
         if self.retry_buffer:

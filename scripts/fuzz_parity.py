@@ -67,11 +67,16 @@ def run_fuzz(trials: int, master: int):
       try:
           a = greedy_replay(ec, ep, cfg, wave_width=wave_width, preemption=preempt,
                             completions_chunk_waves=C if dm else None)
+          # granularity_guard=False throughout: the harness pins parity at
+          # the EXPLICIT (C, RB) — the guard would rewrite them inside the
+          # engines but not in the greedy anchor (its C/RB are arguments).
           d = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width, chunk_waves=C,
-                              dmax_coarse=dmax, preemption=preempt).replay()
+                              dmax_coarse=dmax, preemption=preempt,
+                              granularity_guard=False).replay()
           if not preempt:
               v2 = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
-                                   chunk_waves=C, engine="v2").replay()
+                                   chunk_waves=C, engine="v2",
+                                   granularity_guard=False).replay()
               assert (v2.assignments == a.assignments).all(), f"v2 mismatch trial={trial}"
 
       except ValueError as e:
@@ -87,6 +92,36 @@ def run_fuzz(trials: int, master: int):
                 f"kw={kw} preempt={preempt} dmax={dmax} W={wave_width} C={C} dm={dm} "
                 f"mism={mism} placed {a.placed} vs {d.placed} "
                 f"evict {a.preemptions} vs {d.preemptions}")
+      # Round 5: single-replay boundary pass — retry_buffer on
+      # JaxReplayEngine and kube-exact minimal-victims preemption
+      # (sim.boundary), vs the greedy anchor. Sampled: each sub-trial
+      # compiles nothing new (the boundary mode reuses the plain chunk
+      # program), so this is cheap.
+      if dm and rng.random() < 0.5:
+          RB = int(rng.choice([16, 64]))
+          kube = bool(rng.random() < 0.6)
+          pk = "kube" if kube else False
+          cases += 1
+          ak = greedy_replay(ec, ep, cfg, wave_width=wave_width,
+                             preemption=pk, completions_chunk_waves=C,
+                             retry_buffer=RB)
+          dk = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
+                               chunk_waves=C, preemption=pk,
+                               retry_buffer=RB,
+                               granularity_guard=False).replay()
+          okk = (
+              (ak.assignments == dk.assignments).all()
+              and ak.placed == dk.placed
+              and ak.preemptions == dk.preemptions
+              and ak.retry_dropped == dk.retry_dropped
+          )
+          if not okk:
+              fails += 1
+              mismk = int((ak.assignments != dk.assignments).sum())
+              print(f"KUBE-FAIL trial={trial} seed={seed} kube={kube} "
+                    f"RB={RB} C={C} W={wave_width} mism={mismk} "
+                    f"placed {ak.placed} vs {dk.placed} "
+                    f"evict {ak.preemptions} vs {dk.preemptions}")
       # Boundary retry: the what-if device path vs the anchor (round-4
       # widened envelope — affinity/spread count planes included; only
       # preemption and DynTables stay out). Sampled at 40% — each retry
@@ -98,7 +133,7 @@ def run_fuzz(trials: int, master: int):
           try:
               wi = WhatIfEngine(ec, ep, [Scenario()], cfg,
                                 wave_width=wave_width, chunk_waves=C,
-                                retry_buffer=RB)
+                                retry_buffer=RB, granularity_guard=False)
           except ValueError as e:
               # Only the retry-envelope rejection may be skipped; any
               # other construction error must fail the fuzz loudly.

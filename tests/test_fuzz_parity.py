@@ -1,6 +1,16 @@
 """Continuous randomized parity evidence (VERDICT round-1 item 9): a
 reduced-width seeded slice of scripts/fuzz_parity.py runs in CI under the
-``fuzz`` marker. The full-width harness stays ad hoc (48+ trials)."""
+``fuzz`` marker.
+
+Round 5 (VERDICT r4 next #7): the full ad-hoc campaigns are now DURABLE —
+``pytest -m fuzz_full`` replays the four pinned-seed campaigns
+(masters 7/123/321/777, ~40 trials each ⇒ ~200+ comparison cases
+covering completions, tier preemption × completions, the what-if retry
+buffer, and the round-5 single-replay retry / kube-preemption boundary
+pass). Budget ~10 min on a warm compile cache. Run it before releases
+and whenever sim/greedy, sim/boundary, sim/jax_runtime, sim/whatif or
+ops/tpu3 change semantics; the 15-trial ``fuzz`` slice stays in the
+default marker set for cheap regression signal."""
 
 import os
 import sys
@@ -19,3 +29,15 @@ def test_seeded_fuzz_slice():
     cases, fails = run_fuzz(trials=15, master=123)
     assert fails == 0
     assert cases >= 10  # most trials must actually produce comparisons
+
+
+@pytest.mark.fuzz_full
+@pytest.mark.parametrize("master", [7, 123, 321, 777])
+def test_fuzz_campaign(master):
+    """One pinned campaign of the round-4/5 evidence set (4 campaigns ×
+    ~40 trials ≈ the 157-case ad-hoc total, re-runnable on demand)."""
+    from fuzz_parity import run_fuzz
+
+    cases, fails = run_fuzz(trials=40, master=master)
+    assert fails == 0
+    assert cases >= 30

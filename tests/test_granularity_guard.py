@@ -1,0 +1,107 @@
+"""Granularity-envelope guard (round 5, VERDICT r4 next #2): the
+measured 89%-loss cliff — durations ≪ chunk arrival span — must not be
+reachable silently. The guard warns with the measured reference and
+auto-shrinks chunk_waves toward the duration scale; post-guard the cliff
+shape recovers to the CPU event engine's counts (measured here: 86% loss
+at C=2048 → 0.0% gap at the guarded C)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.granularity import SAFE_RATIO, assess
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.waves import pack_waves
+from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+
+def _cliff_case():
+    """Tight cluster, arrivals spanning ~400 s, 4 s durations: at
+    C=2048 the whole trace is one chunk and nothing ever releases."""
+    cluster = make_cluster(10, seed=0)
+    pods, _ = make_workload(2000, seed=0, arrival_rate=5.0, duration_mean=4.0)
+    return encode(cluster, pods)
+
+
+def test_cliff_recovers_under_guard():
+    ec, ep = _cliff_case()
+    cfg = FrameworkConfig()
+    cpu = CpuReplayEngine(ec, ep, cfg).replay()
+    # Guard OFF reproduces the documented cliff (>50% placement loss).
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        off = WhatIfEngine(
+            ec, ep, [Scenario()], cfg, chunk_waves=2048,
+            granularity_guard=False,
+        ).run()
+    assert int(off.placed[0]) < 0.5 * cpu.placed
+    # Guard ON: warns, shrinks chunks, recovers to within 2% of the CPU
+    # event engine (measured 0.0%).
+    with pytest.warns(UserWarning, match="measured-safe"):
+        eng = WhatIfEngine(ec, ep, [Scenario()], cfg, chunk_waves=2048)
+    assert eng.chunk_waves < 2048
+    on = eng.run()
+    gap = abs(int(on.placed[0]) - cpu.placed) / cpu.placed
+    assert gap <= 0.02, (int(on.placed[0]), cpu.placed)
+
+
+def test_cliff_recovers_single_replay_engine():
+    ec, ep = _cliff_case()
+    cfg = FrameworkConfig()
+    cpu = CpuReplayEngine(ec, ep, cfg).replay()
+    with pytest.warns(UserWarning, match="measured-safe"):
+        res = JaxReplayEngine(ec, ep, cfg, chunk_waves=2048).replay()
+    gap = abs(res.placed - cpu.placed) / cpu.placed
+    assert gap <= 0.02
+    # Boundary mode (retry) takes the same guard, growing the buffer to
+    # the new chunk burst.
+    with pytest.warns(UserWarning, match="measured-safe"):
+        rb = JaxReplayEngine(
+            ec, ep, cfg, chunk_waves=2048, retry_buffer=8
+        ).replay()
+    assert abs(rb.placed - cpu.placed) / cpu.placed <= 0.02
+
+
+def test_safe_shapes_untouched():
+    """The headline regimes must pass through unchanged (measured:
+    north-star C=4096 ratio 0.93, bench C=512 ratio 1.26, config-4
+    C=2048 ratio 1.87 — all >= SAFE_RATIO)."""
+    cluster = make_cluster(20, seed=1)
+    pods, _ = make_workload(400, seed=1, arrival_rate=12.0, duration_mean=60.0)
+    ec, ep = encode(cluster, pods)
+    w = pack_waves(ep, 8)
+    a = assess(ep, w.idx, 4)
+    assert a.ratio >= SAFE_RATIO
+    assert a.chunk_waves == 4
+    # No warning on construction.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        WhatIfEngine(ec, ep, [Scenario()], FrameworkConfig(), chunk_waves=4)
+
+
+def test_beyond_cliff_at_floor_still_warns():
+    """A trace outside the envelope run at chunk_waves <= the shrink
+    floor has nothing to auto-shrink — it must STILL warn (the silent
+    beyond-cliff run is the bug class this module exists for)."""
+    ec, ep = _cliff_case()
+    with pytest.warns(UserWarning, match="shrink floor"):
+        WhatIfEngine(
+            ec, ep, [Scenario()], FrameworkConfig(), chunk_waves=8
+        )
+
+
+def test_durationless_trace_exempt():
+    cluster = make_cluster(10, seed=2)
+    pods, _ = make_workload(200, seed=2)
+    ec, ep = encode(cluster, pods)
+    w = pack_waves(ep, 8)
+    a = assess(ep, w.idx, 2048)
+    assert a.ratio == np.inf and a.chunk_waves == 2048
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        WhatIfEngine(ec, ep, [Scenario()], FrameworkConfig(), chunk_waves=2048)

@@ -1,0 +1,115 @@
+"""The ×8 projection's missing evidence link (VERDICT r4 next #5 /
+missing #1): the compiled mesh-sharded chunk program must contain NO
+cross-scenario collective — a hidden all-reduce inside the chunk scan
+would serialize the scenario mesh and the single-chip → v5e-8 projection
+would die. SURVEY §5 asserts "collectives appear only at metric-gather
+time"; this lowers the actual program on the virtual 8-device CPU mesh
+(conftest forces XLA_FLAGS=--xla_force_host_platform_device_count=8) and
+string-matches the optimized, SPMD-partitioned HLO. No TPU needed: the
+partitioner that would insert collectives runs at compile time."""
+
+import numpy as np
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import PAD, encode
+from kubernetes_simulator_tpu.ops import tpu as T
+from kubernetes_simulator_tpu.ops import tpu3 as V3
+from kubernetes_simulator_tpu.parallel.mesh import make_mesh, replicate_tree, shard_scenario_tree
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+# Optimized-HLO op names for every XLA cross-device primitive (start/done
+# variants share these prefixes).
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+    "reduce-scatter",
+    "partition-id",
+    "send",  # point-to-point would be just as serializing
+    "recv",
+)
+
+
+def test_detector_catches_real_collective():
+    """Positive control: on this same mesh, a genuine cross-shard
+    reduction MUST show up as an all-reduce in the compiled text — else
+    the no-collectives assertions below would be vacuous (they were,
+    until the mesh size guard: a 1-device mesh compiles everything
+    collective-free)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubernetes_simulator_tpu.parallel.mesh import SCENARIO_AXIS
+
+    mesh = make_mesh()
+    assert mesh.devices.size == 8, "virtual 8-device mesh missing"
+    f = jax.jit(
+        lambda x: jnp.sum(x, axis=0),
+        in_shardings=(NamedSharding(mesh, P(SCENARIO_AXIS)),),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    txt = f.lower(jax.ShapeDtypeStruct((8, 16), jnp.float32)).compile().as_text()
+    assert "all-reduce" in txt
+
+
+def _compiled_chunk_hlo(with_durations: bool) -> str:
+    cluster = make_cluster(12, seed=21, taint_fraction=0.2)
+    pods, _ = make_workload(
+        48, seed=21, with_affinity=True, with_spread=True,
+        with_tolerations=True,
+        duration_mean=30.0 if with_durations else None,
+    )
+    ec, ep = encode(cluster, pods)
+    scen = uniform_scenarios(ec, 8, seed=21, p_capacity=0.5, p_taint=0.3)
+    mesh = make_mesh()
+    assert mesh.devices.size == 8, "virtual 8-device mesh missing"
+    eng = WhatIfEngine(
+        ec, ep, scen, FrameworkConfig(), mesh=mesh, chunk_waves=4
+    )
+    # Reproduce run()'s first-chunk argument assembly (the mesh branch:
+    # host-gathered slots replicated, dc/states scenario-sharded).
+    idx = eng.waves.idx
+    C = min(eng.chunk_waves, max(idx.shape[0], 1))
+    rows = idx[:C]
+    if rows.shape[0] < C:
+        rows = np.concatenate(
+            [rows, np.full((C - rows.shape[0], rows.shape[1]), PAD, np.int32)]
+        )
+    dc = shard_scenario_tree(eng.mesh, eng.sset.dc)
+    states = shard_scenario_tree(eng.mesh, eng._init_states())
+    slots = replicate_tree(eng.mesh, T.gather_slots(ep, rows))
+    args = [dc, states, slots]
+    if eng.engine == "v3":
+        args.append(replicate_tree(eng.mesh, V3.gather_extra(eng.static3, rows)))
+    return eng._chunk_fn.lower(*args).compile().as_text()
+
+
+def _assert_no_collectives(txt: str) -> None:
+    assert "ENTRY" in txt  # sanity: this is real HLO, not an empty string
+    lines = txt.splitlines()
+    hits = [
+        ln.strip()
+        for ln in lines
+        for op in COLLECTIVE_OPS
+        if f" {op}" in ln or ln.lstrip().startswith(op)
+    ]
+    assert not hits, (
+        "mesh chunk program contains cross-device collectives — the "
+        f"scenario axis is no longer embarrassingly parallel:\n"
+        + "\n".join(hits[:10])
+    )
+
+
+def test_mesh_chunk_program_has_no_collectives():
+    _assert_no_collectives(_compiled_chunk_hlo(with_durations=False))
+
+
+def test_mesh_chunk_program_no_collectives_with_completions():
+    """The completions-on shape (the north-star semantics): releases are
+    host-fold deltas under mesh, so the chunk program must still be
+    collective-free."""
+    _assert_no_collectives(_compiled_chunk_hlo(with_durations=True))
