@@ -305,6 +305,21 @@ def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepS
     return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
+def bind_chunk_of(pods: EncodedPods, idx: np.ndarray, C: int) -> np.ndarray:
+    """[P] chunk index each pod's wave belongs to (pre-bound = −2,
+    unscheduled = huge) — the bind-chunk side of the one-chunk-slack
+    release rule, shared by the single-replay engine and the batch
+    what-if eager folds (the rule must stay identical for anchor
+    parity)."""
+    W = idx.shape[1]
+    flat = idx.reshape(-1)
+    v = flat >= 0
+    out = np.full(pods.num_pods, 1 << 30, np.int64)
+    out[flat[v]] = np.nonzero(v)[0] // (C * W)
+    out[pods.bound_node >= 0] = -2
+    return out
+
+
 def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray,
                     ev_node: np.ndarray, ev_tier: np.ndarray,
                     pod_tier: np.ndarray, nongang: np.ndarray,
@@ -315,17 +330,34 @@ def preemption_walk(assignments: np.ndarray, idx: np.ndarray, finals: np.ndarray
     already PAD in the device output). ``released``: completed pods keep
     their assignment but can no longer be evicted (their resources are
     gone — the device tier planes already dropped them). Shared by the
-    replay engine and the what-if collect path."""
-    for w in range(idx.shape[0]):
-        e = int(ev_node[w])
-        if e >= 0:
-            vict = (assignments == e) & (pod_tier < int(ev_tier[w])) & nongang
-            if released is not None:
-                vict &= ~released
-            assignments[vict] = PAD
-        ids = idx[w]
-        ok = ids >= 0
-        assignments[ids[ok]] = finals[w][ok]
+    replay engine and the what-if collect/completions paths.
+
+    Vectorized (round 5): eviction events are rare, so the walk is bulk
+    segment folds between event waves plus one [P] mask per event — the
+    S-stacked eager folds of the batch preemption × completions path
+    would otherwise pay a Python iteration per (scenario, wave)."""
+
+    def fold(lo: int, hi: int) -> None:
+        r = idx[lo:hi].reshape(-1)
+        ch = finals[lo:hi].reshape(-1)
+        ok = r >= 0
+        assignments[r[ok]] = ch[ok]
+
+    ev_waves = np.nonzero(np.asarray(ev_node) >= 0)[0]
+    start = 0
+    for w in ev_waves:
+        w = int(w)
+        fold(start, w)  # waves before the event commit first
+        vict = (
+            (assignments == int(ev_node[w]))
+            & (pod_tier < int(ev_tier[w]))
+            & nongang
+        )
+        if released is not None:
+            vict &= ~released
+        assignments[vict] = PAD
+        start = w
+    fold(start, idx.shape[0])
 
 
 def rebuild_fork_state(pods: EncodedPods, idx: np.ndarray, C: int, outs,
@@ -912,12 +944,7 @@ class JaxReplayEngine:
             # bind-chunk check instead of a fold lag; the pipeline eats
             # one blocking fetch per chunk — correctness over overlap for
             # this opt-in combination.
-            W_ = idx.shape[1]
-            flat = idx.reshape(-1)
-            v = flat >= 0
-            chunk_of_arr = np.full(self.pods.num_pods, 1 << 30, np.int64)
-            chunk_of_arr[flat[v]] = np.nonzero(v)[0] // (C * W_)
-            chunk_of_arr[self.pods.bound_node >= 0] = -2
+            chunk_of_arr = bind_chunk_of(self.pods, idx, C)
         if completions_on:
             host_assign = np.where(
                 self.pods.bound_node >= 0, self.pods.bound_node, PAD

@@ -443,12 +443,22 @@ class WhatIfEngine:
         folding runs one chunk behind the device pipeline (boundary b
         sees chunks ≤ b−2 — the one-chunk slack, shared with the greedy
         anchor), so the host-side deltas overlap the in-flight chunk
-        instead of stalling it. Requires the v3 engine and no preemption;
+        instead of stalling it. Requires the v3 engine;
         when a batch with finite durations cannot honor them the engine
         WARNS and reverts to arrivals-only semantics — pass an explicit
         ``completions=True`` to get a ``ValueError`` instead, or read
         ``WhatIfResult.completions_on``. A trace with no finite durations
         runs arrivals-only silently (the semantics are identical).
+        Round 5 (VERDICT r4 #4): tier preemption × completions is a
+        SUPPORTED batch configuration on the no-mesh path — folds run
+        EAGERLY per chunk (evictions must precede the next boundary's
+        release decisions; the slack becomes an explicit bind-chunk
+        gate), released non-gang pods also drop the per-scenario tier
+        planes via compact device-side scatters, and evicted pods never
+        release. Under a mesh the batch stays arrivals-only (loudly):
+        the eager per-chunk fetch would serialize the scenario axis.
+        Anchored by ``greedy_replay(preemption='tier',
+        completions_chunk_waves=...)`` per scenario.
 
         ``retry_buffer`` (round 4): device-path unschedulable RETRY — the
         [K8S] activeQ flush-on-event analogue. Non-gang pods that miss
@@ -628,6 +638,7 @@ class WhatIfEngine:
             dev_ok = bool(
                 self.mesh is None
                 and not collect_assignments
+                and not preemption
                 and fork_checkpoint is None
                 and s3.single_g[s3.mc_h_ids].all()
                 and s3.single_g[s3.anti_h_ids].all()
@@ -639,8 +650,14 @@ class WhatIfEngine:
                 "the v2 fallback engine (label perturbations outside the "
                 "DynTables envelope)"
             )
-        if preemption:
-            blockers.append("device tier preemption")
+        # Tier preemption × completions is SUPPORTED since round 5 on the
+        # no-mesh batch path (eager eviction-aware host folds, the
+        # single-replay round-4 mechanism S-stacked; VERDICT r4 next #4).
+        # Under a mesh the eager per-chunk fetch + scatter-applied tier
+        # releases would serialize the scenario axis — still arrivals-only
+        # there, loudly.
+        if preemption and mesh is not None:
+            blockers.append("device tier preemption under a mesh")
         if self._dyn is not None and not dev_ok:
             # _dyn is only set with fork_checkpoint None and engine v3,
             # so the failing dev_ok condition is one of these three.
@@ -683,6 +700,42 @@ class WhatIfEngine:
         # exists in practice, is singleton). Everything else keeps the
         # host pending-fold path.
         self._completions_dev = bool(self.completions_on and dev_ok)
+        if (
+            self.completions_on
+            and not self._completions_dev
+            and self.engine == "v3"
+        ):
+            # The device-release fast path is gated — say WHY (VERDICT r4
+            # missing #6: the non-singleton host-scale regroup gate was
+            # silent; the host pending-fold path honors the same
+            # semantics at a measured cost — see COVERAGE.md).
+            s3 = self.static3
+            why = []
+            if self.mesh is not None:
+                why.append("mesh")
+            if collect_assignments:
+                why.append("collect_assignments")
+            if preemption:
+                why.append("preemption (eager eviction-aware folds)")
+            if fork_checkpoint is not None:
+                why.append("fork checkpoint")
+            if not (
+                s3.single_g[s3.mc_h_ids].all()
+                and s3.single_g[s3.anti_h_ids].all()
+                and s3.single_g[s3.pref_h_ids].all()
+            ):
+                why.append(
+                    "non-singleton host-scale count planes (the release "
+                    "delta would need an [N, N]-class regroup)"
+                )
+            from ..utils.metrics import log
+
+            log.info(
+                "what-if completions run on the HOST pending-fold path "
+                "(%s) — semantics identical, per-chunk choice fetches "
+                "instead of device-side releases",
+                "; ".join(why) or "unhandled gate condition",
+            )
 
         if self.completions_on:
             # Granularity-envelope guard (round 5, VERDICT r4 #2): a trace
@@ -1350,12 +1403,16 @@ class WhatIfEngine:
             match_total=rep(mc.sum(axis=1).astype(np.float32)),
         )
 
-    def _apply_releases(self, states, host_assign, released, t_chunk):
+    def _apply_releases(self, states, host_assign, released, t_chunk,
+                        chunk_gate=None):
         """Subtract completed pods' contributions per scenario (the
         JaxReplayEngine chunk-boundary mechanism, scenario-stacked; one
         batched scatter pass across all scenarios — at Borg scale every
         pod releases once, so per-scenario Python would dominate).
-        Mutates ``released`` in place."""
+        Mutates ``released`` in place. ``chunk_gate``: [P] bool — the
+        explicit one-chunk-slack rule for the EAGER preemption ×
+        completions folds (the lagged non-preemption folds encode the
+        slack in host_assign itself)."""
         from ..ops import tpu3 as V3
 
         ec, ep, st3 = self.ec, self.pods, self.static3
@@ -1366,6 +1423,8 @@ class WhatIfEngine:
             & np.isfinite(rel)[None, :]
             & (rel[None, :] <= t_chunk)
         )
+        if chunk_gate is not None:
+            due_mask &= chunk_gate[None, :]
         if not due_mask.any():
             return states
         s_idx, p_idx = np.nonzero(due_mask)
@@ -1460,7 +1519,65 @@ class WhatIfEngine:
         )
         if self.mesh is not None:
             delta = shard_scenario_tree(self.mesh, delta)
-        return jax.tree.map(jnp.subtract, states, delta)
+        states = jax.tree.map(jnp.subtract, states, delta)
+        if self.preemption and states.used_tier.shape[1]:  # [S, Tt, R, N]
+            # Tier planes drop completed NON-GANG pods too (pod tiers are
+            # static, so releases are attributable; gangs never enter the
+            # tier planes — the single-replay round-4 rule, S-stacked).
+            # Compact (s, tier, node, req) scatter on device: the dense
+            # [S, Tt, R, N] host delta would be 8x the base-plane traffic.
+            ng = ep.group_id[p_idx] == PAD
+            if ng.any():
+                si = s_idx[ng].astype(np.int32)
+                ti = st3.pod_tier[p_idx[ng]].astype(np.int32)
+                nd = nodes[ng].astype(np.int32)
+                rq = ep.requests[p_idx[ng]].astype(np.float32)
+                K = len(si)
+                pad = 1 << max(K - 1, 0).bit_length()  # pow2 bucket
+                if pad > K:
+                    z = np.zeros(pad - K, np.int32)
+                    si, ti, nd = (
+                        np.concatenate([si, z]),
+                        np.concatenate([ti, z]),
+                        np.concatenate([nd, z]),
+                    )
+                    rq = np.concatenate(
+                        [rq, np.zeros((pad - K, rq.shape[1]), np.float32)]
+                    )
+                states = states._replace(
+                    used_tier=self._tier_rel_fn()(
+                        states.used_tier, si, ti, nd, rq
+                    ),
+                    npods_tier=self._npods_rel_fn()(
+                        states.npods_tier, si, ti, nd,
+                        (np.arange(pad) < K).astype(np.float32),
+                    ),
+                )
+        return states
+
+    def _tier_rel_fn(self):
+        """Cached jit: used_tier[S, Tt, R, N] -= scatter of [K] release
+        rows (zero-padded rows subtract 0 — index 0 is safe)."""
+        if getattr(self, "_tier_rel_jit", None) is None:
+            def f(ut, si, ti, nd, rq):
+                R = ut.shape[2]
+                Kp = si.shape[0]
+                s = jnp.repeat(si, R)
+                t = jnp.repeat(ti, R)
+                r = jnp.tile(jnp.arange(R, dtype=jnp.int32), Kp)
+                n = jnp.repeat(nd, R)
+                return ut.at[s, t, r, n].add(-rq.reshape(-1))
+
+            self._tier_rel_jit = jax.jit(f, donate_argnums=(0,))
+        return self._tier_rel_jit
+
+    def _npods_rel_fn(self):
+        if getattr(self, "_npods_rel_jit", None) is None:
+            def f(nt, si, ti, nd, w):
+                return nt.at[si, ti, nd].add(-w)
+
+            self._npods_rel_jit = jax.jit(f, donate_argnums=(0,))
+        return self._npods_rel_jit
 
     def _fold(self, host_assign, rows, choices) -> None:
         """Apply a chunk's choices to the per-scenario assignment table.
@@ -1749,6 +1866,17 @@ class WhatIfEngine:
             if srcs is not None
             else None
         )
+        pre_comp = comp_on and self.preemption
+        if pre_comp:
+            # Eager eviction-aware folds (the single-replay round-4 rule,
+            # S-stacked): eviction events must land in the host
+            # bookkeeping BEFORE the next boundary's release decisions,
+            # so the one-chunk slack becomes an explicit bind-chunk gate
+            # instead of a fold lag.
+            from .jax_runtime import bind_chunk_of
+
+            chunk_of = bind_chunk_of(self.pods, idx, C)
+            nongang = self.pods.group_id == PAD
         outs = []
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
@@ -1756,7 +1884,10 @@ class WhatIfEngine:
                 t_chunk = wave_t[c0]
                 if np.isfinite(t_chunk):
                     states = self._apply_releases(
-                        states, host_assign, released, t_chunk
+                        states, host_assign, released, t_chunk,
+                        chunk_gate=(
+                            chunk_of < ci - 1 if pre_comp else None
+                        ),
                     )
             if dev_rel:
                 # Static releases first (the bucketed fn; ordering is by
@@ -1815,6 +1946,23 @@ class WhatIfEngine:
                     states, out = self._chunk_fn(*args)
                 else:
                     states, out = self._chunk_fn(dc, states, slots)
+            if pre_comp:
+                # Eager eviction-aware fold: choices + eviction events of
+                # THIS chunk land in host_assign before the next boundary.
+                from .jax_runtime import preemption_walk
+
+                rows = idx[c0 : c0 + C]
+                # ONE batched D2H for all three arrays — per-array
+                # fetches through the tunnel add seconds (same note as
+                # the result-assembly fetches below).
+                ch, evn, evt = jax.device_get((out[0], out[1], out[2]))
+                for s in range(self.S):
+                    preemption_walk(
+                        host_assign[s], rows, ch[s].reshape(rows.shape),
+                        evn[s], evt[s], self.static3.pod_tier, nongang,
+                        released=released[s],
+                    )
+                continue  # host_assign is the result carrier — outs unused
             outs.append(out)
             if comp_on:
                 # Fold the PREVIOUS chunk's choices AFTER dispatching this
@@ -1830,7 +1978,16 @@ class WhatIfEngine:
         wall = time.perf_counter() - t0
 
         to_schedule = int((idx >= 0).sum())
-        if self.collect_assignments and self.preemption:
+        if comp_on and self.preemption:
+            # The eager eviction-aware folds ARE the walk (see the chunk
+            # loop); host_assign is the result carrier. Counting device
+            # finals would overcount later-evicted pods.
+            assignments = host_assign if self.collect_assignments else None
+            scheduled = self.pods.bound_node == PAD
+            placed = (
+                (host_assign[:, scheduled] >= 0).sum(axis=1).astype(np.int32)
+            )
+        elif self.collect_assignments and self.preemption:
             choices = np.concatenate([self._fetch(o[0]) for o in outs], axis=1)
             ev_node = np.concatenate([self._fetch(o[1]) for o in outs], axis=1)
             ev_tier = np.concatenate([self._fetch(o[2]) for o in outs], axis=1)

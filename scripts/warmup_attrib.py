@@ -1,0 +1,126 @@
+"""Cold-process warmup attribution for the north-star shape (round 5,
+VERDICT r4 next #10): break the first-run overhead (judge-measured
+72.9 s cold vs 53.8 s steady in round 4) into phases — imports, trace
+generation, engine construction (static tables + chunk-fn build), device
+staging, per-call compile-cache deserialization (first invocation of
+each jitted program) — by timing every phase and wrapping the chunk /
+release callables with blocking per-call timers on the FIRST run.
+
+The blocking timers serialize the pipeline, so the instrumented first
+run is NOT the warmup number itself; it attributes where the first-run
+extra goes. A second (steady) run follows for the reference wall.
+
+    python scripts/warmup_attrib.py          # full north-star shape
+    NS_TASKS=100000 python scripts/warmup_attrib.py   # smaller probe
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.perf_counter()
+
+from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
+
+_cc()
+
+import jax  # noqa: E402
+
+jax.devices()  # force backend init into the "imports" phase
+
+T_IMPORT = time.perf_counter()
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig  # noqa: E402
+from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded  # noqa: E402
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios  # noqa: E402
+
+
+def main():
+    nodes = int(os.environ.get("NS_NODES", 10_000))
+    tasks = int(os.environ.get("NS_TASKS", 1_000_000))
+    S = int(os.environ.get("NS_S", 128))
+    chunk = int(os.environ.get("NS_CHUNK", 4096))
+
+    t = time.perf_counter()
+    ec, ep, _ = make_borg_encoded(BorgSpec(nodes=nodes, tasks=tasks, seed=0))
+    t_trace = time.perf_counter() - t
+    scenarios = uniform_scenarios(ec, S, seed=0)
+
+    t = time.perf_counter()
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), wave_width=8,
+        chunk_waves=chunk, completions=None,
+    )
+    t_ctor = time.perf_counter() - t
+
+    # Wrap the chunk fn and the release-fn factory with blocking timers.
+    calls = []
+    orig_chunk = eng._chunk_fn
+
+    def timed_chunk(*a):
+        t0 = time.perf_counter()
+        out = orig_chunk(*a)
+        jax.block_until_ready(out)
+        calls.append(time.perf_counter() - t0)
+        return out
+
+    eng._chunk_fn = timed_chunk
+    rel_calls = []
+    orig_rel_factory = eng._release_fn
+
+    def timed_rel_factory(K):
+        fn = orig_rel_factory(K)
+
+        def timed(*a):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            jax.block_until_ready(out)
+            rel_calls.append((K, time.perf_counter() - t0))
+            return out
+
+        return timed
+
+    eng._release_fn = timed_rel_factory
+
+    t = time.perf_counter()
+    eng.run()
+    t_first = time.perf_counter() - t
+    eng._chunk_fn = orig_chunk
+    eng._release_fn = orig_rel_factory
+
+    t = time.perf_counter()
+    eng.run()
+    t_steady = time.perf_counter() - t
+
+    import numpy as np
+
+    calls_arr = np.asarray(calls)
+    med = float(np.median(calls_arr)) if calls_arr.size else 0.0
+    first_extra = float(calls_arr[0] - med) if calls_arr.size else 0.0
+    # Release fns compile per K-bucket: first call per bucket carries the
+    # deserialization; steady calls are the median per bucket.
+    from collections import defaultdict
+
+    by_k = defaultdict(list)
+    for k, w in rel_calls:
+        by_k[k].append(w)
+    rel_first_extra = sum(
+        ws[0] - (sorted(ws)[len(ws) // 2] if len(ws) > 1 else 0.0)
+        for ws in by_k.values()
+    )
+    stage = getattr(eng, "_dev_rel_stage", None)
+    print(f"imports+backend:        {T_IMPORT - T0:8.2f}s")
+    print(f"trace gen:              {t_trace:8.2f}s")
+    print(f"engine ctor:            {t_ctor:8.2f}s")
+    print(f"first run (serialized): {t_first:8.2f}s over {len(calls)} chunk calls")
+    print(f"  chunk call #1 extra vs median ({med:.3f}s): {first_extra:8.2f}s")
+    print(f"  release-fn first-call extra ({len(by_k)} K-buckets): {rel_first_extra:8.2f}s")
+    print(f"  staging cached: {stage is not None}")
+    print(f"steady run:             {t_steady:8.2f}s")
+    print(f"TOTAL process-to-steady: {time.perf_counter() - T0:8.2f}s")
+
+
+if __name__ == "__main__":
+    main()
