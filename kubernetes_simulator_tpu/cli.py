@@ -183,10 +183,11 @@ def validate_config(cfg) -> list:
             "(failed pods reach the PostFilter through the boundary "
             "retry pass)"
         )
-    if cfg.device_preemption == "kube" and cfg.whatif.scenarios > 0:
+    if cfg.device_preemption == "kube" and cfg.whatif.mesh:
         errors.append(
-            "devicePreemption: kube runs on the single-replay engine "
-            "(run); the batch what-if engine supports tier preemption"
+            "devicePreemption: kube requires a no-mesh what-if batch "
+            "(the eager per-chunk folds would serialize the scenario "
+            "axis); tier preemption runs under a mesh"
         )
     if cfg.whatif.retry_buffer and cfg.whatif.completions is False:
         errors.append(

@@ -305,6 +305,15 @@ def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepS
     return jax.jit(chunk_fn, donate_argnums=(1,))
 
 
+def wave_start_times(pods: EncodedPods, idx: np.ndarray) -> np.ndarray:
+    """Arrival time of each wave's first valid pod (inf for padding) —
+    the boundary clock shared by both engines, BoundaryOps and the
+    granularity guard."""
+    first = idx[:, 0]
+    safe = np.clip(first, 0, None)
+    return np.where(first >= 0, pods.arrival[safe], np.inf)
+
+
 def bind_chunk_of(pods: EncodedPods, idx: np.ndarray, C: int) -> np.ndarray:
     """[P] chunk index each pod's wave belongs to (pre-bound = −2,
     unscheduled = huge) — the bind-chunk side of the one-chunk-slack
@@ -798,10 +807,7 @@ class JaxReplayEngine:
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
         """Arrival time of each wave's first valid pod (for timed events)."""
-        first = idx[:, 0]
-        safe = np.clip(first, 0, None)
-        t = self.pods.arrival[safe]
-        return np.where(first >= 0, t, np.inf)
+        return wave_start_times(self.pods, idx)
 
     def _apply_node_events(self, events, saved_alloc: np.ndarray) -> None:
         """Mutate the device cluster's allocatable rows (failure injection;

@@ -58,8 +58,10 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
         "engine": res.engine,
         **base,
     }
+    pre = getattr(res, "preemptions", None)
+    drop = getattr(res, "retry_dropped", None)
     for s in range(res.placed.shape[0]):
-        yield {
+        row = {
             "kind": "whatif-scenario",
             "scenario": s,
             "placed": int(res.placed[s]),
@@ -69,6 +71,12 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
             ),
             **base,
         }
+        if pre is not None:
+            # kube batches: drops mean placements lost to buffer
+            # capacity, not infeasibility.
+            row["preemptions"] = int(pre[s])
+            row["retry_dropped"] = int(drop[s])
+        yield row
 
 
 def baseline_table(rows: Iterable[dict]) -> str:

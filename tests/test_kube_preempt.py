@@ -335,3 +335,146 @@ def test_guards():
         ).replay(checkpoint_path="/tmp/x.npz", checkpoint_every=1)
     with pytest.raises(ValueError):
         JaxReplayEngine(ec, ep, FIT_ONLY(), preemption="bogus")
+
+
+def test_batch_whatif_kube_matches_single_replay():
+    """Round 5 stretch: WhatIfEngine(preemption="kube") — per-scenario
+    host mirrors run the exact PostFilter; the unperturbed scenario must
+    equal the single-replay kube engine bit-for-bit, tally == collect."""
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    cluster = make_cluster(6, seed=2, taint_fraction=0.2)
+    pods, _ = make_workload(
+        260, seed=2, with_spread=True, with_tolerations=True,
+        duration_mean=60.0, arrival_rate=8.0,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    single = JaxReplayEngine(
+        ec, ep, cfg, chunk_waves=4, preemption="kube", retry_buffer=64
+    ).replay()
+    assert single.preemptions > 0  # non-vacuous
+    res = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario()], cfg, chunk_waves=4,
+        preemption="kube", retry_buffer=64, collect_assignments=True,
+    ).run()
+    np.testing.assert_array_equal(res.assignments[0], single.assignments)
+    np.testing.assert_array_equal(res.assignments[1], single.assignments)
+    assert int(res.placed[0]) == single.placed
+    tally = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario()], cfg, chunk_waves=4,
+        preemption="kube", retry_buffer=64,
+    ).run()
+    np.testing.assert_array_equal(tally.placed, res.placed)
+
+
+def test_batch_whatif_kube_perturbed_matches_from_scratch():
+    """A perturbed scenario must equal a from-scratch single-replay kube
+    run on the equivalently perturbed cluster (the host mirror sees the
+    scenario's own allocatable/taints)."""
+    from kubernetes_simulator_tpu.models.core import Taint
+    from kubernetes_simulator_tpu.sim.whatif import (
+        Perturbation,
+        Scenario,
+        WhatIfEngine,
+    )
+
+    cluster = make_cluster(6, seed=2, taint_fraction=0.2)
+    pods, _ = make_workload(
+        260, seed=2, with_spread=True, with_tolerations=True,
+        duration_mean=60.0, arrival_rate=8.0,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    scen = [
+        Scenario(),
+        Scenario([Perturbation("scale_capacity", nodes=np.arange(2),
+                               resource="cpu", factor=0.5)]),
+        Scenario([Perturbation("add_taint", nodes=np.arange(2), key="kk",
+                               value="vv", effect="NoSchedule")]),
+    ]
+    res = WhatIfEngine(
+        ec, ep, scen, cfg, chunk_waves=4, preemption="kube",
+        retry_buffer=64, collect_assignments=True,
+    ).run()
+
+    ch = make_cluster(6, seed=2, taint_fraction=0.2)
+    for i in range(2):
+        ch.nodes[i].allocatable = {
+            k: (v * 0.5 if k == "cpu" else v)
+            for k, v in ch.nodes[i].allocatable.items()
+        }
+    ec2, ep2 = encode(ch, pods)
+    ref = JaxReplayEngine(
+        ec2, ep2, cfg, chunk_waves=4, preemption="kube", retry_buffer=64
+    ).replay()
+    np.testing.assert_array_equal(res.assignments[1], ref.assignments)
+
+    ct = make_cluster(6, seed=2, taint_fraction=0.2)
+    for i in range(2):
+        ct.nodes[i].taints.append(Taint("kk", "vv", "NoSchedule"))
+    ec3, ep3 = encode(ct, pods)
+    ref3 = JaxReplayEngine(
+        ec3, ep3, cfg, chunk_waves=4, preemption="kube", retry_buffer=64
+    ).replay()
+    np.testing.assert_array_equal(res.assignments[2], ref3.assignments)
+
+
+def test_batch_whatif_kube_guards():
+    from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+    from kubernetes_simulator_tpu.sim.whatif import (
+        Perturbation,
+        Scenario,
+        WhatIfEngine,
+    )
+
+    cluster = make_cluster(12, seed=0, taint_fraction=0.2)
+    pods, _ = make_workload(40, seed=0, with_tolerations=True)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    with pytest.raises(ValueError, match="retry_buffer > 0"):
+        WhatIfEngine(ec, ep, [Scenario()], cfg, preemption="kube")
+    with pytest.raises(ValueError, match="no-mesh"):
+        WhatIfEngine(
+            ec, ep, [Scenario()] * 8, cfg, preemption="kube",
+            retry_buffer=8, mesh=make_mesh(),
+        )
+    with pytest.raises(ValueError, match="label"):
+        WhatIfEngine(
+            ec, ep,
+            [Scenario([Perturbation(
+                "set_label", nodes=np.array([0]),
+                key="topology.kubernetes.io/zone", value="zz",
+            )])],
+            cfg, preemption="kube", retry_buffer=8,
+        )
+
+
+def test_batch_whatif_kube_reports_drops_and_rejects_completions_off():
+    """Review r5: per-scenario eviction/drop counters surface on
+    WhatIfResult (drops = placements lost to buffer CAPACITY), and an
+    explicit completions=False is rejected like the single-replay twin."""
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    nodes = [Node("n0", {"cpu": 1})]
+    pods = [Pod("seed", requests={"cpu": 1}, arrival_time=0.0)]
+    pods += [
+        Pod(f"f{i}", requests={"cpu": 1}, arrival_time=1.0 + i)
+        for i in range(20)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    res = WhatIfEngine(
+        ec, ep, [Scenario()], FIT_ONLY(), wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=4,
+    ).run()
+    anchor = greedy_replay(
+        ec, ep, FIT_ONLY(), wave_width=1, preemption="kube",
+        completions_chunk_waves=1, retry_buffer=4,
+    )
+    assert int(res.retry_dropped[0]) == anchor.retry_dropped > 0
+    assert int(res.preemptions[0]) == anchor.preemptions
+    with pytest.raises(ValueError, match="completions"):
+        WhatIfEngine(
+            ec, ep, [Scenario()], FIT_ONLY(), preemption="kube",
+            retry_buffer=4, completions=False,
+        )
