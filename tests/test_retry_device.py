@@ -286,3 +286,59 @@ def test_single_replay_retry_borg_scale():
     np.testing.assert_array_equal(anchor.assignments, eng.assignments)
     no_retry = JaxReplayEngine(ec, ep, cfg, chunk_waves=64).replay()
     assert eng.placed >= no_retry.placed
+
+
+def test_single_replay_retry_sees_node_events():
+    """Boundary mode mirrors node events into the HOST cluster view (the
+    retry pass must not place onto a downed node): n0 goes down before
+    the blocked pod's retry; the retry lands on n1 instead, and the
+    cluster's allocatable is restored after the run."""
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+
+    cluster = Cluster(nodes=[Node("n0", {"cpu": 2}), Node("n1", {"cpu": 1})])
+    pods = [
+        # filler holds BOTH nodes so b must wait in the buffer.
+        Pod("f0", requests={"cpu": 2}, arrival_time=0.0, duration=3.0),
+        Pod("f1", requests={"cpu": 1}, arrival_time=0.0, duration=3.0),
+        Pod("b", requests={"cpu": 1}, arrival_time=1.0),
+        Pod("t1", requests={}, arrival_time=6.0),
+        Pod("t2", requests={}, arrival_time=7.0),
+    ]
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    saved = ec.allocatable.copy()
+    res = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, retry_buffer=4
+    ).replay(node_events=[NodeEvent(time=5.0, kind="node_down", node=0)])
+    # b retried after the fillers released; n0 was down by then -> n1.
+    assert res.assignments[2] == 1
+    np.testing.assert_array_equal(ec.allocatable, saved)  # restored
+    # Without the event, LeastAllocated prefers the emptier n0.
+    res2 = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, retry_buffer=4
+    ).replay()
+    assert res2.assignments[2] == 0
+
+
+def test_host_and_device_retry_paths_agree():
+    """The single-replay HOST retry pass (sim.boundary) and the what-if
+    DEVICE retry pass (the in-program boundary step) both anchor to
+    greedy — pin their agreement with each other directly."""
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+
+    cluster = make_cluster(3, seed=11)
+    pods, _ = make_workload(
+        120, seed=11, arrival_rate=60.0, duration_mean=1.5,
+        with_spread=True, with_tolerations=True,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    host = JaxReplayEngine(
+        ec, ep, cfg, wave_width=4, chunk_waves=4, retry_buffer=8
+    ).replay()
+    dev = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, wave_width=4, chunk_waves=4,
+        retry_buffer=8,
+    ).run()
+    assert int(dev.placed[0]) == host.placed
