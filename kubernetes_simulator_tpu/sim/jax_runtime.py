@@ -11,10 +11,13 @@ CPU plugin path stays the default; `jax` is opt-in).
 Semantics = :mod:`.greedy` exactly (the parity anchor): arrival-order
 greedy waves with chunk-granular completions ON BY DEFAULT (pods with
 finite duration release resources and count contributions at chunk
-boundaries, one-chunk slack — see ``JaxReplayEngine.replay``). Tier
-preemption is opt-in (``preemption=True``). Exact-timestamp event
-ordering, queue re-ordering/backoff, and kube minimal-victims preemption
-remain CPU-event-engine-only; batched what-if over scenarios builds on
+boundaries, one-chunk slack — see ``JaxReplayEngine.replay``).
+Preemption is opt-in: ``preemption="kube"`` runs the EXACT kube
+minimal-victims PostFilter in the chunk-boundary pass (round 5,
+:mod:`.boundary`); ``"tier"``/``True`` keeps the in-scan tier
+approximation. Exact-timestamp event ordering and queue
+re-ordering/backoff remain CPU-event-engine-only; batched what-if over
+scenarios builds on
 this module via ``vmap``/``shard_map`` (:mod:`.whatif`, :mod:`..parallel`).
 """
 
