@@ -119,6 +119,63 @@ class BoundaryOps:
             nfin = int(np.isfinite(tb_all).sum())
             self.tb32 = tb_all[:nfin].astype(np.float32)
 
+    # -- checkpoint / resume (round 5) --------------------------------------
+
+    def to_blob(self) -> dict:
+        """The mirror's resume state as small named arrays (the count
+        planes ride the main checkpoint — only the per-pod bookkeeping
+        and the queues live here). ``mode`` records the writer's
+        (kube, retry_buffer) so a resume on a differently-configured
+        engine is rejected instead of silently diverging."""
+        return {
+            "mode": np.asarray([int(self.kube), self.retry_buffer], np.int64),
+            "bound": self.st.bound.copy(),
+            "assignments": self.assignments.copy(),
+            "released": self.released.copy(),
+            "bind_chunk": self.bind_chunk.copy(),
+            "retry_q": np.asarray(self.retry_q, np.int64),
+            "pend": (
+                np.asarray(self.pend, np.int64).reshape(-1, 3)
+                if self.pend
+                else np.zeros((0, 3), np.int64)
+            ),
+            "counters": np.asarray(
+                [self.placed_total, self.preemptions, self.retry_dropped],
+                np.int64,
+            ),
+        }
+
+    def restore(self, blob: dict, used, mc, aa, pw) -> None:
+        """Rebuild the mirror from a checkpoint: the count planes come
+        from the main checkpoint arrays (domain space — the mirror's own
+        layout), the rest from :meth:`to_blob`."""
+        mode = blob.get("mode")
+        if mode is not None and (
+            bool(mode[0]) != self.kube or int(mode[1]) != self.retry_buffer
+        ):
+            want = ("kube" if mode[0] else "retry-only", int(mode[1]))
+            raise ValueError(
+                f"checkpoint was written by a {want[0]} boundary replay "
+                f"with retry_buffer={want[1]}; resume with the same "
+                f"configuration (this engine: "
+                f"{'kube' if self.kube else 'retry-only'}, "
+                f"retry_buffer={self.retry_buffer})"
+            )
+        self.st.used[:] = used
+        self.st.match_count[:] = mc
+        self.st.anti_active[:] = aa
+        self.st.pref_wsum[:] = pw
+        self.st.bound[:] = blob["bound"]
+        self.assignments[:] = blob["assignments"]
+        self.released[:] = blob["released"].astype(bool)
+        self.bind_chunk[:] = blob["bind_chunk"]
+        self.retry_q = [int(p) for p in blob["retry_q"]]
+        self.pend = [list(map(int, row)) for row in blob["pend"]]
+        c = blob["counters"]
+        self.placed_total = int(c[0])
+        self.preemptions = int(c[1])
+        self.retry_dropped = int(c[2])
+
     # -- chunk-side hooks ---------------------------------------------------
 
     def offer_failure(self, p: int) -> None:

@@ -33,12 +33,21 @@ class ReplayCheckpoint:
     # None on checkpoints written before the field existed — treated as
     # "reconstruct from outs" by the loaders that need it.
     released: Optional[np.ndarray] = None
+    # Boundary-mode host-mirror state (round 5; retry/kube replays):
+    # a dict of small arrays from sim.boundary.BoundaryOps.to_blob().
+    # Present ⟺ the checkpoint came from a boundary-mode replay — such
+    # checkpoints resume only on a matching boundary-mode engine (the
+    # what-if fork path rejects them; outs are empty by design, the
+    # mirror's assignments carry the placements).
+    boundary: Optional[dict] = None
 
     def save(self, path: str) -> None:
         tmp = path + ".tmp"
         extra = {}
         if self.released is not None:
             extra["released"] = self.released.astype(bool)
+        if self.boundary is not None:
+            extra.update({f"bd_{k}": v for k, v in self.boundary.items()})
         np.savez_compressed(
             tmp,
             chunk_cursor=np.int64(self.chunk_cursor),
@@ -56,6 +65,9 @@ class ReplayCheckpoint:
     def load(cls, path: str) -> "ReplayCheckpoint":
         with np.load(path) as z:
             n = int(z["num_outs"])
+            bd = {
+                k[len("bd_"):]: z[k] for k in z.files if k.startswith("bd_")
+            }
             return cls(
                 chunk_cursor=int(z["chunk_cursor"]),
                 used=z["used"],
@@ -64,6 +76,7 @@ class ReplayCheckpoint:
                 pref_wsum=z["pref_wsum"],
                 outs=[z[f"out_{i}"] for i in range(n)],
                 released=z["released"] if "released" in z.files else None,
+                boundary=bd or None,
             )
 
 

@@ -549,7 +549,7 @@ class JaxReplayEngine:
         )
 
     def _save_checkpoint(self, state, cursor: int, all_choices, path: str,
-                         released=None) -> None:
+                         released=None, boundary=None) -> None:
         from .checkpoint import ReplayCheckpoint, state_to_checkpoint
 
         if self.engine == "v3":
@@ -557,13 +557,14 @@ class JaxReplayEngine:
             ReplayCheckpoint(
                 used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
                 chunk_cursor=cursor, outs=[np.asarray(o) for o in all_choices],
-                released=released,
+                released=released, boundary=boundary,
             ).save(path)
         else:
             ck = state_to_checkpoint(
                 state, self._gdom, self._Dhost, cursor, all_choices
             )
             ck.released = released
+            ck.boundary = boundary
             ck.save(path)
 
     def _preemption_walk(self, idx: np.ndarray, finals: np.ndarray,
@@ -668,9 +669,24 @@ class JaxReplayEngine:
             )
         return jax.tree.map(jnp.subtract, state, delta)
 
+    def _state_from_checkpoint(self, ck):
+        """Device carry from a ReplayCheckpoint (shared by the plain and
+        boundary resume paths)."""
+        from ..ops import tpu3 as V3
+        from .checkpoint import checkpoint_to_state
+
+        if self.engine == "v3":
+            return V3.DevState3.from_host(
+                ck.used, ck.match_count, ck.anti_active, ck.pref_wsum,
+                self.ec, self.static3,
+            )
+        return checkpoint_to_state(ck, self._gdom)
+
     def _replay_boundary(
         self, node_events=None, chunk_req: Optional[int] = None,
         retry_req: Optional[int] = None,
+        checkpoint_path: Optional[str] = None, checkpoint_every: int = 0,
+        resume: bool = False,
     ) -> ReplayResult:
         """Replay with the host boundary pass active (``retry_buffer`` > 0
         and/or ``preemption='kube'``; :mod:`.boundary`). Chunk folds run
@@ -709,6 +725,22 @@ class JaxReplayEngine:
             retry_buffer=retry_req, kube=self.kube,
         )
         state = self._init_dev_state()
+        start_chunk = 0
+        if resume and checkpoint_path:
+            from .checkpoint import ReplayCheckpoint
+
+            ck = ReplayCheckpoint.load(checkpoint_path)
+            if ck.boundary is None:
+                raise ValueError(
+                    "checkpoint was not written by a boundary-mode "
+                    "(retry/kube) replay — resume it on a plain engine"
+                )
+            state = self._state_from_checkpoint(ck)
+            bops.restore(
+                ck.boundary, ck.used, ck.match_count, ck.anti_active,
+                ck.pref_wsum,
+            )
+            start_chunk = ck.chunk_cursor
         wave_times = self._wave_start_times(idx)
         pending_events = sorted(node_events or [], key=lambda e: e.time)
         saved_alloc = np.asarray(self.dc.allocatable).copy()
@@ -721,6 +753,8 @@ class JaxReplayEngine:
         t0 = time.perf_counter()
         try:
             for ci, c0 in enumerate(range(0, idx.shape[0], C)):
+                if ci < start_chunk:
+                    continue
                 if pending_events:
                     chunk_t = wave_times[c0]
                     due = [e for e in pending_events if e.time <= chunk_t]
@@ -754,9 +788,18 @@ class JaxReplayEngine:
                         T.gather_slots(self.pods, idx[c0 : c0 + C]),
                     )
                 # Eager fold: boundary ci+1 needs chunks <= ci in the mirror.
-                # (The choices buffer is fully consumed here — this path
-                # rejects checkpointing, so nothing retains it.)
+                # (The choices buffer is fully consumed here — the mirror
+                # carries the placements, so checkpoints save NO outs.)
                 bops.fold_chunk(ci, idx[c0 : c0 + C], np.asarray(choices))
+                if (
+                    checkpoint_path
+                    and checkpoint_every
+                    and (ci + 1) % checkpoint_every == 0
+                ):
+                    self._save_checkpoint(
+                        state, ci + 1, [], checkpoint_path,
+                        released=bops.released, boundary=bops.to_blob(),
+                    )
             if self.kube:
                 # Trailing boundary (greedy anchor twin): last-chunk
                 # failures still get their PostFilter attempt.
@@ -848,12 +891,6 @@ class JaxReplayEngine:
                 "(tier planes are not checkpointed)"
             )
         if self.retry_buffer or self.kube:
-            if checkpoint_path or resume:
-                raise ValueError(
-                    "checkpoint/resume is not supported with the boundary "
-                    "retry/preemption pass (the retry buffer and host "
-                    "mirror are not checkpointed)"
-                )
             if self.completions is False:
                 raise ValueError(
                     "completions=False is not supported with retry_buffer/"
@@ -876,7 +913,8 @@ class JaxReplayEngine:
         if self.retry_buffer or self.kube:
             return self._replay_boundary(
                 node_events=node_events, chunk_req=chunk_req,
-                retry_req=retry_req,
+                retry_req=retry_req, checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, resume=resume,
             )
         if (
             node_events
@@ -920,13 +958,14 @@ class JaxReplayEngine:
         start_chunk = 0
         if resume and checkpoint_path:
             ck = ReplayCheckpoint.load(checkpoint_path)
-            if self.engine == "v3":
-                state = V3.DevState3.from_host(
-                    ck.used, ck.match_count, ck.anti_active, ck.pref_wsum,
-                    self.ec, self.static3,
+            if ck.boundary is not None:
+                raise ValueError(
+                    "checkpoint was written by a boundary-mode (retry/"
+                    "kube) replay — its placements live in the host "
+                    "mirror, not the saved outs; resume it with the "
+                    "same retry_buffer/preemption configuration"
                 )
-            else:
-                state = checkpoint_to_state(ck, self._gdom)
+            state = self._state_from_checkpoint(ck)
             all_choices = [jnp.asarray(o) for o in ck.outs]
             start_chunk = ck.chunk_cursor
         pending_events = sorted(node_events or [], key=lambda e: e.time)

@@ -1407,6 +1407,13 @@ class WhatIfEngine:
             from .checkpoint import ReplayCheckpoint
 
             ck = ReplayCheckpoint.load(self.fork_checkpoint)
+            if ck.boundary is not None:
+                raise ValueError(
+                    "cannot fork from a boundary-mode (retry/kube) "
+                    "checkpoint: its placements live in the host mirror, "
+                    "not the saved outs; resume it on a matching "
+                    "JaxReplayEngine instead"
+                )
             self._fork_ck = ck
             if ck.outs:
                 fork = np.concatenate(ck.outs, axis=0)  # [waves(+pad), W]

@@ -329,12 +329,86 @@ def test_guards():
         greedy_replay(ec, ep, FIT_ONLY(), preemption="kube", retry_buffer=8)
     with pytest.raises(ValueError, match="tier"):
         JaxReplayEngine(ec, ep, FIT_ONLY(), preemption="tier", retry_buffer=8)
-    with pytest.raises(ValueError, match="checkpoint"):
-        JaxReplayEngine(
-            ec, ep, FIT_ONLY(), preemption="kube", retry_buffer=8
-        ).replay(checkpoint_path="/tmp/x.npz", checkpoint_every=1)
     with pytest.raises(ValueError):
         JaxReplayEngine(ec, ep, FIT_ONLY(), preemption="bogus")
+
+
+def pack_len(ep):
+    """Number of waves at the default W=8 (chunk-count bound helper)."""
+    from kubernetes_simulator_tpu.sim.waves import pack_waves
+
+    return pack_waves(ep, 8).idx.shape[0]
+
+
+def test_boundary_mode_checkpoint_resume_identity(tmp_path):
+    """Round 5: checkpoint/resume works in boundary mode — the host
+    mirror (queues, pend list, counters) rides the checkpoint; a resumed
+    kube replay must equal the uninterrupted one exactly."""
+    cluster = make_cluster(6, seed=2, taint_fraction=0.2)
+    pods, _ = make_workload(
+        260, seed=2, with_spread=True, with_tolerations=True,
+        duration_mean=60.0, arrival_rate=8.0,
+    )
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    full = JaxReplayEngine(
+        ec, ep, cfg, chunk_waves=4, preemption="kube", retry_buffer=64
+    ).replay()
+    assert full.preemptions > 0  # non-vacuous
+    ckpt = str(tmp_path / "bm.npz")
+    JaxReplayEngine(
+        ec, ep, cfg, chunk_waves=4, preemption="kube", retry_buffer=64
+    ).replay(checkpoint_path=ckpt, checkpoint_every=2)
+    from kubernetes_simulator_tpu.sim.checkpoint import ReplayCheckpoint
+
+    ck = ReplayCheckpoint.load(ckpt)
+    num_chunks = -(-pack_len(ep) // 4)
+    # The resume must RE-EXECUTE chunks, not just restore-and-report.
+    assert ck.boundary is not None and 0 < ck.chunk_cursor < num_chunks
+    resumed = JaxReplayEngine(
+        ec, ep, cfg, chunk_waves=4, preemption="kube", retry_buffer=64
+    ).replay(checkpoint_path=ckpt, resume=True)
+    np.testing.assert_array_equal(full.assignments, resumed.assignments)
+    assert resumed.placed == full.placed
+    assert resumed.preemptions == full.preemptions
+    assert resumed.retry_dropped == full.retry_dropped
+    # Config mismatch on resume is rejected, not silently divergent.
+    with pytest.raises(ValueError, match="retry_buffer=64"):
+        JaxReplayEngine(
+            ec, ep, cfg, chunk_waves=4, retry_buffer=64
+        ).replay(checkpoint_path=ckpt, resume=True)
+    with pytest.raises(ValueError, match="same"):
+        JaxReplayEngine(
+            ec, ep, cfg, chunk_waves=4, preemption="kube", retry_buffer=128
+        ).replay(checkpoint_path=ckpt, resume=True)
+
+
+def test_boundary_checkpoint_guards(tmp_path):
+    """Plain checkpoints don't resume on boundary engines and vice
+    versa; what-if forks reject boundary checkpoints."""
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    cluster = make_cluster(4, seed=1)
+    pods, _ = make_workload(60, seed=1, duration_mean=20.0)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    plain_ck = str(tmp_path / "plain.npz")
+    JaxReplayEngine(ec, ep, cfg, chunk_waves=2).replay(
+        checkpoint_path=plain_ck, checkpoint_every=1
+    )
+    with pytest.raises(ValueError, match="boundary"):
+        JaxReplayEngine(
+            ec, ep, cfg, chunk_waves=2, retry_buffer=8
+        ).replay(checkpoint_path=plain_ck, resume=True)
+    bd_ck = str(tmp_path / "bd.npz")
+    JaxReplayEngine(ec, ep, cfg, chunk_waves=2, retry_buffer=8).replay(
+        checkpoint_path=bd_ck, checkpoint_every=1
+    )
+    with pytest.raises(ValueError, match="boundary-mode"):
+        # The fork checkpoint loads lazily at run() (_init_states).
+        WhatIfEngine(
+            ec, ep, [Scenario()], cfg, fork_checkpoint=bd_ck
+        ).run()
 
 
 def test_batch_whatif_kube_matches_single_replay():
