@@ -67,6 +67,31 @@ from .tpu import (
     select_node,
 )
 
+# Compat shim: some jax versions ship optimization_barrier without a vmap
+# batching rule, and the what-if engine vmaps wave_step (which uses the
+# barrier to pin the feasibility/plane-update schedule). The barrier is
+# identity-per-operand, so under vmap we DROP it entirely (pass the
+# batched operands through unbound) rather than re-binding the
+# primitive: the SPMD partitioner has no sharding rule for it, and a
+# barrier surviving into the mesh-sharded what-if program makes GSPMD
+# replicate its operands — all-gathers on the scenario axis
+# (test_mesh_hlo pins their absence). Values are unaffected either way
+# (the barrier is a scheduling hint, not an op); the non-vmapped
+# single-replay path keeps the real barrier.
+try:  # pragma: no cover - version-dependent
+    from jax._src.lax.control_flow import optimization_barrier_p as _ob_p
+    from jax.interpreters import batching as _batching
+
+    if _ob_p not in _batching.primitive_batchers:
+
+        def _ob_batch(args, dims, **params):
+            del params
+            return list(args), list(dims)
+
+        _batching.primitive_batchers[_ob_p] = _ob_batch
+except Exception:
+    pass
+
 # ---------------------------------------------------------------------------
 # Static (per-trace) structure
 # ---------------------------------------------------------------------------

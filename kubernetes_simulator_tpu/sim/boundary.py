@@ -53,8 +53,14 @@ from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import bind, init_state, release_delta, unbind
 from .waves import WaveBatch
 
-# (pod, node) pairs collected for device delta application.
-PairList = List[Tuple[int, int]]
+# (pods, nodes) int arrays collected for device delta application.
+PairArrays = Tuple[np.ndarray, np.ndarray]
+
+_NEVER = 1 << 30  # bind_chunk sentinel: never statically released
+
+
+def _empty_pairs() -> PairArrays:
+    return np.zeros(0, np.int64), np.zeros(0, np.int64)
 
 
 class BoundaryOps:
@@ -74,6 +80,7 @@ class BoundaryOps:
         chunk_waves: int,
         retry_buffer: int = 0,
         kube: bool = False,
+        lazy: bool = False,
     ):
         if kube and not retry_buffer:
             raise ValueError(
@@ -82,6 +89,18 @@ class BoundaryOps:
             )
         self.ec, self.ep, self.fw = ec, ep, fw
         self.kube = kube
+        # Lazy mode (device engines only): plane folds are appended to an
+        # op log instead of applied; the log flushes — in eager order —
+        # only when the retry pass actually needs to READ the planes
+        # (``schedule_one``). The greedy anchor reads planes every slot and
+        # must stay eager. Bookkeeping (bound/assignments/bind_chunk/
+        # queues/counters) is ALWAYS eager, so checkpoint blobs are
+        # bit-identical across modes.
+        self.lazy = lazy
+        self.wave_width = wave_width
+        self.chunk_waves = chunk_waves
+        self._plane_log: List[tuple] = []  # (key, sign, pods, nodes)
+        self.plane_folds = 0  # applied plane deltas (test/bench probe)
         if retry_buffer:
             # Wave-multiple rounding shared with the device retry pass
             # (sim.whatif) — the caps must agree or placed counts diverge
@@ -99,7 +118,7 @@ class BoundaryOps:
         )
         # Chunk index each pod was bound in (pre-bound = -2): boundary b
         # releases only pods bound in chunks <= b-2 (one-chunk slack).
-        self.bind_chunk = np.full(P, 1 << 30, np.int64)
+        self.bind_chunk = np.full(P, _NEVER, np.int64)
         self.bind_chunk[ep.bound_node >= 0] = -2
         self.retry_q: List[int] = []
         self.pend: List[list] = []  # [relb, pod, node]
@@ -108,16 +127,43 @@ class BoundaryOps:
         # [K8S] keeps every pending pod; the bounded analogue sheds load —
         # loudly (VERDICT r4 weak #2: drops must be a reported number).
         self.retry_dropped = 0
+        # Boundary start times: f64 for the static release schedule, f32
+        # finite prefix for the retry pend schedule (matching the device's
+        # staged f32 table bit-for-bit).
+        firsts = waves.idx[0::chunk_waves, 0]
+        tb_all = np.where(
+            firsts >= 0, ep.arrival[np.clip(firsts, 0, None)], np.inf
+        )
+        nfin = int(np.isfinite(tb_all).sum())
         self.tb32: Optional[np.ndarray] = None
         if retry_buffer:
-            # Boundary start times in f32 (finite prefix), matching the
-            # device's staged f32 table bit-for-bit.
-            firsts = waves.idx[0::chunk_waves, 0]
-            tb_all = np.where(
-                firsts >= 0, ep.arrival[np.clip(firsts, 0, None)], np.inf
-            )
-            nfin = int(np.isfinite(tb_all).sum())
             self.tb32 = tb_all[:nfin].astype(np.float32)
+        # Static release schedule: each pod's earliest eligible boundary is
+        # known up front (rel_time <= tb[b]  <=>  b >= searchsorted(tb,
+        # rel_time, 'left'), floored by the one-chunk slack bind_chunk+2).
+        # Bucketing candidates per boundary replaces the per-boundary
+        # full-[P] mask scan; boundary() re-checks the dynamic parts
+        # (still bound, not released, not retry-placed).
+        chunk_of = np.full(P, _NEVER, np.int64)
+        flat = waves.idx.reshape(-1)
+        fv = flat >= 0
+        if fv.any():
+            chunk_of[flat[fv]] = np.nonzero(fv)[0] // (
+                chunk_waves * waves.idx.shape[1]
+            )
+        chunk_of[ep.bound_node >= 0] = -2
+        elig = np.searchsorted(tb_all[:nfin], self.rel_time, side="left")
+        b_rel = np.maximum(elig, chunk_of + 2)
+        ok = b_rel < nfin  # inf rel_time / absent pods fall out naturally
+        cand = np.nonzero(ok)[0].astype(np.int64)
+        order = np.argsort(b_rel[cand], kind="stable")  # pod-asc within b
+        cand = cand[order]
+        counts = np.bincount(b_rel[cand], minlength=max(nfin, 1))
+        self._rel_bucket_off = np.concatenate(
+            ([0], np.cumsum(counts))
+        ).astype(np.int64)
+        self._rel_bucket_pods = cand
+        self._n_rel_buckets = nfin
 
     # -- checkpoint / resume (round 5) --------------------------------------
 
@@ -125,10 +171,20 @@ class BoundaryOps:
         """The mirror's resume state as small named arrays (the count
         planes ride the main checkpoint — only the per-pod bookkeeping
         and the queues live here). ``mode`` records the writer's
-        (kube, retry_buffer) so a resume on a differently-configured
-        engine is rejected instead of silently diverging."""
+        (kube, retry_buffer, chunk_waves, wave_width) so a resume on a
+        differently-configured engine — including a different chunk grid,
+        which silently shifts every boundary time — is rejected instead
+        of diverging."""
         return {
-            "mode": np.asarray([int(self.kube), self.retry_buffer], np.int64),
+            "mode": np.asarray(
+                [
+                    int(self.kube),
+                    self.retry_buffer,
+                    self.chunk_waves,
+                    self.wave_width,
+                ],
+                np.int64,
+            ),
             "bound": self.st.bound.copy(),
             "assignments": self.assignments.copy(),
             "released": self.released.copy(),
@@ -161,6 +217,25 @@ class BoundaryOps:
                 f"{'kube' if self.kube else 'retry-only'}, "
                 f"retry_buffer={self.retry_buffer})"
             )
+        if mode is not None and len(mode) >= 4:
+            # Chunk-grid guard: boundary indices (bind_chunk, retry_q pend
+            # relb) are meaningless on a different grid. Blobs from before
+            # this field have len(mode) == 2 and skip the check.
+            if (
+                int(mode[2]) != self.chunk_waves
+                or int(mode[3]) != self.wave_width
+            ):
+                raise ValueError(
+                    f"checkpoint was written on a chunk grid of "
+                    f"chunk_waves={int(mode[2])}, wave_width="
+                    f"{int(mode[3])}; this engine uses chunk_waves="
+                    f"{self.chunk_waves}, wave_width={self.wave_width}. "
+                    f"Boundary bookkeeping (bind chunks, pending release "
+                    f"boundaries) does not transfer across grids — resume "
+                    f"with the original wave_width/completions_chunk_waves "
+                    f"or restart the replay from scratch."
+                )
+        self._plane_log.clear()  # planes below are authoritative
         self.st.used[:] = used
         self.st.match_count[:] = mc
         self.st.anti_active[:] = aa
@@ -175,6 +250,47 @@ class BoundaryOps:
         self.placed_total = int(c[0])
         self.preemptions = int(c[1])
         self.retry_dropped = int(c[2])
+
+    # -- plane folds (eager or logged) --------------------------------------
+
+    def _apply_planes(self, sign: float, pods: np.ndarray, nodes: np.ndarray):
+        du, dmc, daa, dpw = release_delta(self.ec, self.ep, pods, nodes)
+        st = self.st
+        if sign > 0:
+            st.used += du
+            st.match_count += dmc
+            st.anti_active += daa
+            st.pref_wsum += dpw
+        else:
+            st.used -= du
+            st.match_count -= dmc
+            st.anti_active -= daa
+            st.pref_wsum -= dpw
+        self.plane_folds += 1
+
+    def _plane_op(self, key: tuple, sign: float, pods, nodes) -> None:
+        pods = np.asarray(pods, np.int64)
+        nodes = np.asarray(nodes, np.int64)
+        if not pods.size:
+            return
+        if self.lazy:
+            self._plane_log.append((key, sign, pods, nodes))
+        else:
+            self._apply_planes(sign, pods, nodes)
+
+    def flush_planes(self) -> None:
+        """Apply every logged plane delta in eager order: boundary ``b``'s
+        releases (key ``(b, 0)``) before chunk ``b``'s binds (key
+        ``(b, 1)``). The per-delta sums are associative-exact (bucketed
+        k8s magnitudes — the same invariant fold_chunk already leans on),
+        so the mirror planes land bit-identical to the eager path."""
+        if not self._plane_log:
+            return
+        for _key, sign, pods, nodes in sorted(
+            self._plane_log, key=lambda e: e[0]
+        ):
+            self._apply_planes(sign, pods, nodes)
+        self._plane_log.clear()
 
     # -- chunk-side hooks ---------------------------------------------------
 
@@ -201,11 +317,7 @@ class BoundaryOps:
         pid = ids[placed]
         pnd = nd[placed]
         if pid.size:
-            du, dmc, daa, dpw = release_delta(self.ec, self.ep, pid, pnd)
-            self.st.used += du
-            self.st.match_count += dmc
-            self.st.anti_active += daa
-            self.st.pref_wsum += dpw
+            self._plane_op((ci, 1), 1.0, pid, pnd)
             self.st.bound[pid] = pnd
             self.assignments[pid] = pnd
             self.bind_chunk[pid] = ci
@@ -217,46 +329,60 @@ class BoundaryOps:
 
     def boundary(
         self, b: int, t_chunk: float
-    ) -> Tuple[PairList, PairList, PairList]:
+    ) -> Tuple[PairArrays, PairArrays, PairArrays]:
         """Run boundary ``b`` (start time ``t_chunk``). Returns
-        ``(releases, binds, evictions)`` as (pod, node) pair lists — the
-        device engine turns them into carry-plane deltas; the greedy
-        anchor ignores them (its state IS self.st)."""
+        ``(releases, binds, evictions)`` as (pods, nodes) int array pairs
+        — the device engine turns them into carry-plane deltas; the
+        greedy anchor ignores them (its state IS self.st)."""
         ec, ep, st = self.ec, self.ep, self.st
-        rel: PairList = []
-        binds: PairList = []
-        evicts: PairList = []
+        binds_l: List[Tuple[int, int]] = []
+        evicts_l: List[Tuple[int, int]] = []
         # 1. Pending releases of boundary-placed pods (relb encodes the
         # time comparison already — no finite-t gate).
+        rel_pods: List[int] = []
         still = []
         for entry in self.pend:
             if entry[0] <= b:
-                p = int(entry[1])
-                rel.append((p, int(st.bound[p])))
-                unbind(ec, ep, st, p)
-                self.released[p] = True
+                rel_pods.append(int(entry[1]))
             else:
                 still.append(entry)
         self.pend[:] = still
-        # 2. Static releases (pods that started at arrival).
-        if np.isfinite(t_chunk):
-            due = np.nonzero(
-                (st.bound >= 0)
-                & ~self.released
-                & np.isfinite(self.rel_time)
-                & (self.rel_time <= t_chunk)
-                & (self.bind_chunk < b - 1)
-            )[0]
-            for p in due:
-                p = int(p)
-                rel.append((p, int(st.bound[p])))
-                unbind(ec, ep, st, p)
-                self.released[p] = True
+        # 2. Static releases (pods that started at arrival): candidates
+        # come from the per-boundary bucket; the dynamic residue — still
+        # bound, not already released, not retry-placed (those release
+        # through pend only) — is re-checked here. One batched rewind
+        # replaces the per-pod unbind loop; the sums are associative-exact
+        # (see flush_planes), so the planes match the sequential path.
+        if b < self._n_rel_buckets and np.isfinite(t_chunk):
+            cand = self._rel_bucket_pods[
+                self._rel_bucket_off[b] : self._rel_bucket_off[b + 1]
+            ]
+            if cand.size:
+                m = (
+                    (st.bound[cand] >= 0)
+                    & ~self.released[cand]
+                    & (self.bind_chunk[cand] < b - 1)
+                )
+                if m.any():
+                    rel_pods.extend(cand[m].tolist())
+        if rel_pods:
+            rel_p = np.asarray(rel_pods, np.int64)
+            rel_n = st.bound[rel_p].astype(np.int64)
+            self._plane_op((b, 0), -1.0, rel_p, rel_n)
+            st.bound[rel_p] = PAD
+            self.released[rel_p] = True
+            rel = (rel_p, rel_n)
+        else:
+            rel = _empty_pairs()
         # 3. Bounded retry (+ kube preemption) pass, FIFO order. Victims
         # re-enter the walked queue and are attempted later in the SAME
         # pass — mirroring the CPU event engine, which requeues victims
         # into the activeQ at the preemption instant.
         if self.retry_buffer and self.retry_q:
+            # The pass reads the count planes through schedule_one — any
+            # logged deltas must land first (rare path; quiet runs never
+            # get here and never pay a fold).
+            self.flush_planes()
             q = self.retry_q
             still_q: List[int] = []
             i = 0
@@ -269,7 +395,7 @@ class BoundaryOps:
                     continue
                 for v in res.victims:
                     v = int(v)
-                    evicts.append((v, int(st.bound[v])))
+                    evicts_l.append((v, int(st.bound[v])))
                     unbind(ec, ep, st, v)  # FULL count rewind — no phantoms
                     self.preemptions += 1
                     # A victim with a scheduled pending release no longer
@@ -287,7 +413,7 @@ class BoundaryOps:
                     else:
                         self.retry_dropped += 1
                 bind(ec, ep, st, p, res.node)
-                binds.append((p, int(res.node)))
+                binds_l.append((p, int(res.node)))
                 self.assignments[p] = res.node
                 if ep.bound_node[p] == PAD:
                     self.placed_total += 1
@@ -305,4 +431,11 @@ class BoundaryOps:
                     if rb < len(self.tb32):
                         self.pend.append([max(rb, b + 1), p, int(res.node)])
             self.retry_q = still_q
-        return rel, binds, evicts
+
+        def _pairs(lst: List[Tuple[int, int]]) -> PairArrays:
+            if not lst:
+                return _empty_pairs()
+            a = np.asarray(lst, np.int64)
+            return a[:, 0], a[:, 1]
+
+        return rel, _pairs(binds_l), _pairs(evicts_l)

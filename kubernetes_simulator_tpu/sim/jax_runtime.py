@@ -440,6 +440,7 @@ class JaxReplayEngine:
         completions: Optional[bool] = None,
         retry_buffer: int = 0,
         granularity_guard: bool = True,
+        lazy_boundary: bool = True,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
@@ -465,9 +466,12 @@ class JaxReplayEngine:
         on the single-replay engine — failed non-gang pods re-attempt
         placement at every chunk boundary via the host boundary pass
         (sim.boundary), bit-identical to
-        ``greedy_replay(retry_buffer=...)``; folds run eagerly (one
-        blocking fetch per chunk — correctness over overlap, as with
-        tier × completions)."""
+        ``greedy_replay(retry_buffer=...)``.
+        ``lazy_boundary`` (round 6): quiet chunks — no failures, empty
+        retry queue — skip the mirror plane fold entirely and overlap the
+        choices fetch with the next chunk's dispatch; only a scalar
+        failure count blocks per chunk. Bit-identical to the eager path
+        (set False to force the old per-chunk blocking folds)."""
         from ..ops import tpu3 as V3
         from .greedy import normalize_preemption
 
@@ -495,6 +499,7 @@ class JaxReplayEngine:
         self.preemption = mode == "tier"
         self.kube = mode == "kube"
         self.retry_buffer = int(retry_buffer)
+        self.lazy_boundary = bool(lazy_boundary)
         self.completions = completions
         self.granularity_guard = granularity_guard
         self.dc = T.DevCluster.from_encoded(ec)
@@ -638,21 +643,15 @@ class JaxReplayEngine:
     def _apply_boundary_delta(self, state, sub_pairs, add_pairs):
         """Net host-layout plane delta of one boundary pass — releases and
         evictions (``sub_pairs``) minus retried/preempting binds
-        (``add_pairs``), each a list of (pod, node) — transformed to the
-        device layout and subtracted from the carry. The generalization of
-        :meth:`_apply_release`; the transform is linear, so one application
-        carries the whole pass."""
+        (``add_pairs``), each a (pods, nodes) int-array pair — transformed
+        to the device layout and subtracted from the carry. The
+        generalization of :meth:`_apply_release`; the transform is linear,
+        so one application carries the whole pass."""
         from ..models.state import release_delta
         from ..ops import tpu3 as V3
 
-        def _split(pairs):
-            if not pairs:
-                return np.zeros(0, np.int64), np.zeros(0, np.int64)
-            arr = np.asarray(pairs, np.int64)
-            return arr[:, 0], arr[:, 1]
-
-        s_idx, s_nodes = _split(sub_pairs)
-        a_idx, a_nodes = _split(add_pairs)
+        s_idx, s_nodes = sub_pairs
+        a_idx, a_nodes = add_pairs
         du, dmc, daa, dpw = release_delta(self.ec, self.pods, s_idx, s_nodes)
         au, amc, aaa, apw = release_delta(self.ec, self.pods, a_idx, a_nodes)
         net = (du - au, dmc - amc, daa - aaa, dpw - apw)
@@ -689,14 +688,24 @@ class JaxReplayEngine:
         resume: bool = False,
     ) -> ReplayResult:
         """Replay with the host boundary pass active (``retry_buffer`` > 0
-        and/or ``preemption='kube'``; :mod:`.boundary`). Chunk folds run
-        EAGERLY — the pass at boundary b needs the host mirror current
-        through chunk b−1, so the pipeline pays one blocking fetch per
-        chunk (the same correctness-over-overlap trade the tier ×
-        completions path makes). The device chunk program is the plain
-        one: retry placements and kube preemption decisions are host
-        arithmetic (bit-identical to the CPU path by construction) landing
-        on the carry as rank-1 plane deltas."""
+        and/or ``preemption='kube'``; :mod:`.boundary`).
+
+        Lazy sync (round 6, default): the boundary pass at b only needs
+        the mirror current through chunk b−1 when it will actually READ
+        it — i.e. when the retry queue is non-empty. Per chunk the loop
+        fetches ONE device scalar (the non-gang failure count); quiet
+        chunks (zero failures, empty queue) skip the blocking choices
+        fetch entirely — the fold is deferred past the next chunk's
+        dispatch (bookkeeping lags one chunk; the plane delta is only
+        appended to the mirror's op log and applied if a later boundary
+        flushes). The static-release decision at boundary b never needs
+        chunk b−1 (one-chunk slack: ``bind_chunk < b-1``), so deferral is
+        exact. Eager mode (``lazy_boundary=False``) folds every chunk with
+        a blocking fetch — bit-identical results, kept as the reference
+        path. The device chunk program is the plain one either way: retry
+        placements and kube preemption decisions are host arithmetic
+        (bit-identical to the CPU path by construction) landing on the
+        carry as rank-1 plane deltas."""
         from dataclasses import replace as dc_replace
 
         from ..framework.framework import FrameworkConfig, SchedulerFramework
@@ -718,12 +727,14 @@ class JaxReplayEngine:
             enable_preemption=self.kube,
         )
         fw = SchedulerFramework(self.ec, self.pods, cfg)
+        lazy = self.lazy_boundary
         bops = BoundaryOps(
             self.ec, self.pods, fw,
             WaveBatch(idx=idx, wave_width=self.wave_width),
             self.wave_width, C,
-            retry_buffer=retry_req, kube=self.kube,
+            retry_buffer=retry_req, kube=self.kube, lazy=lazy,
         )
+        self._last_bops = bops  # probe for the quiet-path tests/bench
         state = self._init_dev_state()
         start_chunk = 0
         if resume and checkpoint_path:
@@ -750,11 +761,42 @@ class JaxReplayEngine:
             if self.engine == "v3"
             else None
         )
+        # Scalar boundary summary: count of failed NON-GANG slots (the only
+        # failures that enter the retry buffer — gang failures never do).
+        if not hasattr(self, "_bfail_fn"):
+            self._bfail_fn = jax.jit(
+                lambda ch, ix, ng: (
+                    (ix >= 0)
+                    & (ch.reshape(ix.shape) < 0)
+                    & ng[jnp.clip(ix, 0)]
+                ).sum(dtype=jnp.int32)
+            )
+        ng_dev = jnp.asarray(self.pods.group_id == PAD)
+        # Deferred fold of the previous chunk: (ci, rows, choices_dev,
+        # nfail_dev). Resolved eagerly when the boundary will read the
+        # mirror planes; otherwise folded AFTER the next dispatch so the
+        # D2H copy overlaps device compute.
+        pending = None
+
+        def _fold_pending():
+            nonlocal pending
+            if pending is not None:
+                ci_p, rows_p, ch_d, _nf = pending
+                bops.fold_chunk(ci_p, rows_p, np.asarray(ch_d))
+                pending = None
+
         t0 = time.perf_counter()
         try:
             for ci, c0 in enumerate(range(0, idx.shape[0], C)):
                 if ci < start_chunk:
                     continue
+                if pending is not None and (
+                    int(pending[3]) > 0 or bops.retry_q
+                ):
+                    # The boundary below will run the retry pass (new
+                    # failures or a carried-over queue): it needs chunk
+                    # ci-1 folded and the mirror planes flushed.
+                    _fold_pending()
                 if pending_events:
                     chunk_t = wave_times[c0]
                     due = [e for e in pending_events if e.time <= chunk_t]
@@ -773,9 +815,14 @@ class JaxReplayEngine:
                                 )
                         pending_events = pending_events[len(due):]
                 rel, binds, evicts = bops.boundary(ci, wave_times[c0])
-                if rel or binds or evicts:
+                if rel[0].size or binds[0].size or evicts[0].size:
                     state = self._apply_boundary_delta(
-                        state, rel + evicts, binds
+                        state,
+                        (
+                            np.concatenate([rel[0], evicts[0]]),
+                            np.concatenate([rel[1], evicts[1]]),
+                        ),
+                        binds,
                     )
                 if self.engine == "v3":
                     state, choices = self.chunk_fn(
@@ -787,26 +834,50 @@ class JaxReplayEngine:
                         self.dc, state,
                         T.gather_slots(self.pods, idx[c0 : c0 + C]),
                     )
-                # Eager fold: boundary ci+1 needs chunks <= ci in the mirror.
-                # (The choices buffer is fully consumed here — the mirror
-                # carries the placements, so checkpoints save NO outs.)
-                bops.fold_chunk(ci, idx[c0 : c0 + C], np.asarray(choices))
+                if lazy:
+                    ix_dev = (
+                        idx_chunks[ci]
+                        if idx_chunks is not None
+                        else jnp.asarray(idx[c0 : c0 + C])
+                    )
+                    nf_d = self._bfail_fn(choices, ix_dev, ng_dev)
+                    if hasattr(choices, "copy_to_host_async"):
+                        choices.copy_to_host_async()
+                    # Quiet previous chunk: fold it now — its D2H copy was
+                    # launched an iteration ago and chunk ci is already in
+                    # flight, so this host work overlaps device compute.
+                    _fold_pending()
+                    pending = (ci, idx[c0 : c0 + C], choices, nf_d)
+                else:
+                    # Eager fold: one blocking fetch per chunk. (The
+                    # choices buffer is fully consumed here — the mirror
+                    # carries the placements, so checkpoints save NO outs.)
+                    bops.fold_chunk(ci, idx[c0 : c0 + C], np.asarray(choices))
                 if (
                     checkpoint_path
                     and checkpoint_every
                     and (ci + 1) % checkpoint_every == 0
                 ):
+                    # Blob parity with the eager path: the mirror's
+                    # bookkeeping must be current through chunk ci.
+                    _fold_pending()
                     self._save_checkpoint(
                         state, ci + 1, [], checkpoint_path,
                         released=bops.released, boundary=bops.to_blob(),
                     )
+            _fold_pending()
             if self.kube:
                 # Trailing boundary (greedy anchor twin): last-chunk
                 # failures still get their PostFilter attempt.
                 rel, binds, evicts = bops.boundary(idx.shape[0] // C, np.inf)
-                if rel or binds or evicts:
+                if rel[0].size or binds[0].size or evicts[0].size:
                     state = self._apply_boundary_delta(
-                        state, rel + evicts, binds
+                        state,
+                        (
+                            np.concatenate([rel[0], evicts[0]]),
+                            np.concatenate([rel[1], evicts[1]]),
+                        ),
+                        binds,
                     )
                     jax.block_until_ready(state)
         finally:

@@ -10,6 +10,15 @@ config change still compiles once, but only once per machine.
 Opt-out with ``KSIM_COMPILE_CACHE=0``; override the directory with
 ``KSIM_COMPILE_CACHE_DIR``. Entries below 1 s of compile time are not
 persisted (the cache is for the chunk programs, not every tiny jit).
+
+CPU backend (round 6): the cache is OFF by default. jax 0.4.x's
+thunk-runtime CPU executables do not survive the persistent-cache
+round-trip — warm-cache replays of the chunk programs returned
+nondeterministic placements (the preemption program most visibly),
+out-of-bounds node ids and occasional segfaults, while every cold
+compile of the same program was correct. Until the upstream
+serialization is sound, correctness wins over warm-start time on CPU;
+``KSIM_COMPILE_CACHE=1`` forces it back on for local experiments.
 """
 
 from __future__ import annotations
@@ -28,8 +37,33 @@ def enable(cache_dir: str | None = None) -> str | None:
     returns the originally-configured path (JAX keeps using it), never
     the ignored new one."""
     global _configured_dir
-    if os.environ.get("KSIM_COMPILE_CACHE", "1") in ("", "0"):
+    raw = os.environ.get("KSIM_COMPILE_CACHE")
+    if raw in ("", "0"):
         return None
+    if raw != "1":
+        # Default: refuse on the CPU backend (see module docstring — the
+        # deserialized thunk-runtime executables are unsound). "1" set
+        # explicitly overrides for local experiments. The platform check
+        # must NOT initialize the backend (enable() runs before
+        # jax.distributed.initialize in the DCN workers), so it reads
+        # config/env and probes for a TPU plugin instead of asking the
+        # runtime.
+        try:
+            import importlib.util
+
+            import jax
+
+            plats = (
+                os.environ.get("JAX_PLATFORMS")
+                or getattr(jax.config, "jax_platforms", None)
+                or ""
+            )
+            first = plats.split(",")[0].strip().lower()
+            if first in ("", "cpu"):
+                if first == "cpu" or importlib.util.find_spec("libtpu") is None:
+                    return None
+        except Exception:  # noqa: BLE001 — never fatal
+            return None
     path = Path(
         cache_dir
         or os.environ.get("KSIM_COMPILE_CACHE_DIR", _DEFAULT_DIR)

@@ -26,15 +26,22 @@ from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
 from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
 
 
-def run_fuzz(trials: int, master: int):
-  """(cases, fails) over ``trials`` randomized parity cases."""
+def run_fuzz(trials: int, master: int, quick: bool = False):
+  """(cases, fails) over ``trials`` randomized parity cases.
+
+  ``quick=True`` (round 6, the default-gate ``fuzz_quick`` slice) keeps
+  the knob distribution but caps trace shapes at 40 nodes / 200 pods and
+  skips the what-if sub-trial (it compiles its own program per trial) so
+  a handful of trials fit a <=30s budget with the compile cache off.
+  The quick lists are prefixes of the full ones, so quick mode explores
+  the small-shape corner of the same seeded space."""
   rng = np.random.default_rng(master)
   fails = 0
   cases = 0
   for trial in range(trials):
       seed = int(rng.integers(10_000))
-      n_nodes = int(rng.choice([15, 40, 90, 160]))
-      n_pods = int(rng.choice([80, 200, 400]))
+      n_nodes = int(rng.choice([15, 40] if quick else [15, 40, 90, 160]))
+      n_pods = int(rng.choice([80, 200] if quick else [80, 200, 400]))
       kw = dict(
           with_affinity=bool(rng.random() < 0.7),
           with_spread=bool(rng.random() < 0.7),
@@ -126,7 +133,7 @@ def run_fuzz(trials: int, master: int):
       # widened envelope — affinity/spread count planes included; only
       # preemption and DynTables stay out). Sampled at 40% — each retry
       # sub-trial compiles its own what-if program.
-      if dm and not preempt and rng.random() < 0.4:
+      if dm and not preempt and rng.random() < 0.4 and not quick:
           from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
 
           RB = int(rng.choice([8, 32]))

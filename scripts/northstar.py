@@ -10,7 +10,11 @@ Since round 4 the protocol reports BOTH semantics:
 
 Env knobs: NS_NODES, NS_TASKS, NS_S, NS_WAVE, NS_CHUNK, NS_WARMUP,
 NS_MODE=both|completions|arrivals, NS_RETRY (retry-buffer width for the
-completions run; 0 = off).
+completions run; 0 = off), NS_PREEMPT=1 (tier preemption on the batch
+run — the preemption × completions scaling probe), and
+NS_SINGLE=plain,retry,kube (comma list: single-replay boundary-mode
+walls — the round-6 lazy-sync cost table; skips the batch run unless
+NS_MODE is also set explicitly).
 """
 
 import os
@@ -30,13 +34,18 @@ from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
 from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
 
 
-def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0):
+def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
+             preempt=False):
     kw = dict(retry_buffer=retry) if retry else {}
+    if preempt:
+        kw["preemption"] = True
     eng = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), wave_width=wave,
         chunk_waves=chunk, completions=completions, **kw,
     )
     tag = "completions" if completions else "arrivals-only"
+    if preempt:
+        tag = "preempt-x-" + tag
     if retry:
         tag += f"+retry{retry}"
     print(f"[{tag}] engine: {eng.engine}", flush=True)
@@ -63,26 +72,89 @@ def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0):
     return wall
 
 
+def run_single(ec, ep, tasks, wave, chunk, mode, retry):
+    """One single-replay wall in a boundary mode: 'plain' (no host
+    boundary pass), 'retry' (retry_buffer=NS_RETRY or 512) or 'kube'
+    (the faithful PostFilter pass; implies the retry buffer). The
+    round-6 acceptance gate: retry and kube each within ~1.15x of
+    plain — quiet chunks skip the mirror fold, so the boundary modes
+    only pay one device scalar per chunk."""
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+
+    rb = retry or 512
+    kw = {}
+    if mode == "retry":
+        kw = dict(retry_buffer=rb)
+    elif mode == "kube":
+        kw = dict(preemption="kube", retry_buffer=rb)
+    eng = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), wave_width=wave, chunk_waves=chunk, **kw
+    )
+    tag = f"single-{mode}"
+    if os.environ.get("NS_WARMUP", "1") not in ("", "0"):
+        t0 = time.perf_counter()
+        eng.replay()
+        print(
+            f"[{tag}] warmup (incl. compile): {time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
+    t0 = time.perf_counter()
+    res = eng.replay()
+    wall = time.perf_counter() - t0
+    folds = (
+        getattr(eng, "_last_bops", None).plane_folds
+        if getattr(eng, "_last_bops", None) is not None
+        else -1
+    )
+    print(
+        f"[{tag}] N={ec.num_nodes} P={tasks} W={wave} C={chunk}: "
+        f"wall={wall:.1f}s placed={res.placed} plane_folds={folds}",
+        flush=True,
+    )
+    return wall
+
+
 def main():
     nodes = int(os.environ.get("NS_NODES", 10_000))
     tasks = int(os.environ.get("NS_TASKS", 1_000_000))
     S = int(os.environ.get("NS_S", 128))
     wave = int(os.environ.get("NS_WAVE", 8))
     chunk = int(os.environ.get("NS_CHUNK", 4096))
-    mode = os.environ.get("NS_MODE", "both")
+    mode = os.environ.get("NS_MODE")
     retry = int(os.environ.get("NS_RETRY", 0))
+    preempt = os.environ.get("NS_PREEMPT", "") == "1"
+    single = [
+        m for m in os.environ.get("NS_SINGLE", "").split(",") if m
+    ]
     if os.environ.get("NS_COMPLETIONS") == "1":  # r03 compat spelling
         mode = "completions"
     elif os.environ.get("NS_COMPLETIONS") == "0":
         mode = "arrivals"
+    if mode is None:
+        mode = "skip" if single else "both"
 
     t0 = time.perf_counter()
     ec, ep, _ = make_borg_encoded(BorgSpec(nodes=nodes, tasks=tasks, seed=0))
     print(f"trace gen: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    walls = {}
+    for m in single:
+        walls[m] = run_single(ec, ep, tasks, wave, chunk, m, retry)
+    if "plain" in walls:
+        for m in ("retry", "kube"):
+            if m in walls and walls["plain"] > 0:
+                print(
+                    f"[single-{m}] overhead vs plain: "
+                    f"{walls[m] / walls['plain']:.2f}x",
+                    flush=True,
+                )
+    if mode == "skip":
+        return
     scenarios = uniform_scenarios(ec, S, seed=0)
 
     if mode in ("both", "completions"):
-        run_mode(ec, ep, scenarios, S, tasks, wave, chunk, True, retry)
+        run_mode(ec, ep, scenarios, S, tasks, wave, chunk, True, retry,
+                 preempt)
     if mode in ("both", "arrivals"):
         run_mode(ec, ep, scenarios, S, tasks, wave, chunk, False)
 

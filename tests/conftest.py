@@ -19,11 +19,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compilation cache for the suite: the tests compile the
-# same chunk programs every run, and compile time dominates the wall
-# (round-4 task: default suite under its 5-minute claim). Keyed by HLO,
-# so code changes miss cleanly; KSIM_COMPILE_CACHE=0 opts out.
+# Persistent XLA compilation cache for the suite — a no-op on the CPU
+# backend since round 6: warm-cache chunk executables deserialized
+# nondeterministically wrong (see utils/compile_cache.py docstring), and
+# every test here runs on CPU. enable() stays so a TPU-backed run of the
+# suite still gets the warm start; KSIM_COMPILE_CACHE=1 forces it on CPU.
 from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
 
-_cc()
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+if _cc() is not None:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

@@ -104,6 +104,21 @@ def _run_dcn(nproc: int, timeout: int = 180) -> None:
                 out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 pytest.fail("DCN worker timed out")
+            if "Multiprocess computations aren't implemented" in (
+                out + err
+            ):
+                # Capability gap in the installed jaxlib, not a repo
+                # regression: this CPU runtime has no cross-process
+                # execution support at all, so no DCN test can run here.
+                # (Kill the siblings first — they'd block in the
+                # coordinator otherwise.)
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                        q.wait()
+                pytest.skip(
+                    "jaxlib CPU backend lacks multiprocess execution"
+                )
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
             lines = [
                 l for l in out.splitlines() if l.startswith("DCN_RESULT ")

@@ -24,8 +24,27 @@ sys.path.insert(
 )
 
 
+@pytest.mark.fuzz_quick
+def test_seeded_fuzz_quick():
+    """Round 6 (PR-2 S5): a seeded randomized parity pass that runs in
+    the DEFAULT pytest gate (the marker is NOT in the addopts deselect
+    list). Small-shape corner of the same knob space as ``fuzz``; sized
+    to stay <=30s with the compile cache off."""
+    from fuzz_parity import run_fuzz
+
+    cases, fails = run_fuzz(trials=3, master=2026, quick=True)
+    assert fails == 0
+    assert cases >= 3
+
+
 @pytest.mark.fuzz
+@pytest.mark.slow
 def test_seeded_fuzz_slice():
+    """15-trial slice. Also ``slow`` since round 6: with the persistent
+    compile cache off (CPU unsoundness — utils/compile_cache.py) every
+    trial pays cold compiles and the slice runs minutes, well past the
+    >25s slow bar; ``test_seeded_fuzz_quick`` keeps the default gate's
+    randomized signal."""
     from fuzz_parity import run_fuzz
 
     cases, fails = run_fuzz(trials=15, master=123)
@@ -34,6 +53,7 @@ def test_seeded_fuzz_slice():
 
 
 @pytest.mark.fuzz_full
+@pytest.mark.slow
 @pytest.mark.parametrize("master", [7, 123, 321, 777])
 def test_fuzz_campaign(master):
     """One pinned campaign of the round-4/5 evidence set (4 campaigns ×

@@ -1,0 +1,69 @@
+"""Quiet-chunk fast path of the lazy boundary sync (round 6): on a
+failure-free, release-free trace the boundary modes must never fold the
+host mirror planes — the whole point of the lazy pass is that the
+faithful modes are near-free when nothing happens — while staying
+bit-equal to the eager path and, at wave_width=1 / chunk_waves=1, to
+``CpuReplayEngine``."""
+
+import numpy as np
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
+
+
+def _quiet_trace(n_pods=48, n_nodes=6):
+    """Ample capacity, no durations: every pod places first try (no
+    retry-buffer entries) and nothing ever completes (no releases)."""
+    nodes = [Node(f"n{i}", {"cpu": 64, "memory": 256}) for i in range(n_nodes)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1, "memory": 2},
+            arrival_time=float(i))
+        for i in range(n_pods)
+    ]
+    return encode(Cluster(nodes=nodes), pods)
+
+
+def test_quiet_chunks_skip_the_mirror_fold():
+    ec, ep = _quiet_trace()
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    eng = JaxReplayEngine(
+        ec, ep, cfg, wave_width=4, chunk_waves=2, retry_buffer=8
+    )
+    res = eng.replay()
+    bops = eng._last_bops
+    # Zero failures + zero releases => the plane log is never flushed and
+    # no per-chunk fold ever touches the mirror planes.
+    assert bops.plane_folds == 0
+    assert not bops.retry_q
+    assert res.placed == len(ep.arrival)
+
+
+def test_lazy_matches_eager_bit_for_bit():
+    ec, ep = _quiet_trace()
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    lazy = JaxReplayEngine(
+        ec, ep, cfg, wave_width=4, chunk_waves=2, retry_buffer=8
+    ).replay()
+    eager_eng = JaxReplayEngine(
+        ec, ep, cfg, wave_width=4, chunk_waves=2, retry_buffer=8,
+        lazy_boundary=False,
+    )
+    eager = eager_eng.replay()
+    # The eager reference path DOES fold every chunk.
+    assert eager_eng._last_bops.plane_folds > 0
+    np.testing.assert_array_equal(lazy.assignments, eager.assignments)
+    assert lazy.placed == eager.placed
+
+
+def test_quiet_path_matches_cpu_engine_at_fine_chunking():
+    ec, ep = _quiet_trace(n_pods=24, n_nodes=4)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    dev = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, retry_buffer=4
+    ).replay()
+    cpu = CpuReplayEngine(ec, ep, cfg).replay()
+    np.testing.assert_array_equal(dev.assignments, cpu.assignments)
+    assert dev.placed == cpu.placed

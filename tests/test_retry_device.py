@@ -104,6 +104,10 @@ def test_retry_buffer_overflow_drops_newest():
     )
     res = eng.run()
     assert int(res.placed[0]) == anchor.placed == 4
+    # Round 6: the device retry path reports its FIFO-capacity drops on
+    # the result, matching the host anchor's count (c overflowed).
+    assert res.retry_dropped is not None
+    assert int(res.retry_dropped[0]) == anchor.retry_dropped == 1
 
 
 def test_retry_placed_pod_releases_later():
