@@ -83,6 +83,28 @@ class Simulator:
         )
         return eng.run()
 
+    def chaos_timeline(
+        self,
+        seed: int = 0,
+        mtbf: float = 200.0,
+        mttr: float = 20.0,
+        node_fraction: float = 0.2,
+        horizon: Optional[float] = None,
+        max_events: Optional[int] = None,
+    ):
+        """Seeded MTBF/MTTR failure/recovery timeline for this cluster —
+        pass it as ``run(node_events=...)`` or per-scenario via
+        ``sim.whatif.Scenario(events=...)``. Horizon defaults to the
+        workload makespan."""
+        from .sim.synthetic import make_chaos_timeline
+
+        if horizon is None:
+            horizon = float(self.ep.arrival.max())
+        return make_chaos_timeline(
+            self.ec.num_nodes, seed=seed, horizon=horizon, mtbf=mtbf,
+            mttr=mttr, node_fraction=node_fraction, max_events=max_events,
+        )
+
     @staticmethod
     def strategies() -> List[str]:
         # Force-register the builtins, then report.

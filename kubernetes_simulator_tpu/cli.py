@@ -19,6 +19,27 @@ from .utils.metrics import JsonlWriter, log, replay_row, whatif_rows
 from .utils.profiling import device_trace
 
 
+def _chaos_timeline(cfg, ec, ep, seed):
+    """Materialize one seeded chaos campaign from the ``chaos:`` section
+    (horizon defaults to the workload makespan — later events could never
+    fire anyway)."""
+    from .sim.synthetic import make_chaos_timeline
+
+    ch = cfg.chaos
+    horizon = (
+        ch.horizon if ch.horizon is not None else float(ep.arrival.max())
+    )
+    return make_chaos_timeline(
+        ec.num_nodes,
+        seed=seed,
+        horizon=horizon,
+        mtbf=ch.mtbf,
+        mttr=ch.mttr,
+        node_fraction=ch.node_fraction,
+        max_events=ch.max_events,
+    )
+
+
 def cmd_run(args) -> int:
     cfg = SimConfig.load(args.config)
     if args.strategy:
@@ -32,8 +53,12 @@ def cmd_run(args) -> int:
               "preemption": cfg.device_preemption,
               "retry_buffer": cfg.whatif.retry_buffer}
     engine = factory(ec, ep, cfg.framework, **kw)
+    events = None
+    if cfg.chaos is not None and cfg.chaos.enabled:
+        events = _chaos_timeline(cfg, ec, ep, cfg.chaos.seed)
+        log.info("chaos: injecting %d node events", len(events))
     with device_trace(args.profile_dir):
-        res = engine.replay()
+        res = engine.replay(node_events=events) if events else engine.replay()
     out = JsonlWriter(cfg.output)
     out.write(replay_row(f"replay-{cfg.strategy}", res, {"config": args.config}))
     out.close()
@@ -64,6 +89,20 @@ def cmd_whatif(args) -> int:
         p_capacity=cfg.whatif.capacity_p,
         p_taint=cfg.whatif.taint_p,
     )
+    if cfg.chaos is not None and cfg.chaos.enabled:
+        # Failure-sweep campaign: scenario 0 stays the clean reference;
+        # every other scenario gets its own seeded timeline so the batch
+        # answers "which failure timeline hurts most" in one SPMD run.
+        n_ev = 0
+        for s in range(1, len(scen)):
+            scen[s].events = _chaos_timeline(
+                cfg, ec, ep, cfg.chaos.seed + s
+            )
+            n_ev += len(scen[s].events)
+        log.info(
+            "chaos: %d timed events across %d scenario timelines",
+            n_ev, len(scen) - 1,
+        )
     mesh = make_mesh() if cfg.whatif.mesh else None
     eng = WhatIfEngine(
         ec,
@@ -195,6 +234,30 @@ def validate_config(cfg) -> list:
             "whatIf.completions: false (the retry pass runs at completion "
             "boundaries)"
         )
+    ch = cfg.chaos
+    if ch is not None and ch.enabled:
+        if ch.mtbf <= 0:
+            errors.append("chaos.mtbf: must be > 0")
+        if ch.mttr < 0:
+            errors.append("chaos.mttr: must be >= 0")
+        if not 0.0 < ch.node_fraction <= 1.0:
+            errors.append("chaos.nodeFraction: must be in (0, 1]")
+        if ch.horizon is not None and ch.horizon <= 0:
+            errors.append("chaos.horizon: must be > 0 (or omitted)")
+        if ch.max_events is not None and ch.max_events < 0:
+            errors.append("chaos.maxEvents: must be >= 0")
+        if cfg.strategy == "jax" and not cfg.whatif.retry_buffer:
+            errors.append(
+                "chaos with strategy: jax requires whatIf.retryBuffer > 0 "
+                "— without the boundary retry pass node_down only blocks "
+                "future placements (no NoExecute eviction of bound pods)"
+            )
+        if cfg.whatif.scenarios > 0 and cfg.device_preemption != "kube":
+            errors.append(
+                "chaos what-if sweeps require devicePreemption: kube "
+                "(per-scenario timelines apply through the kube-mode "
+                "host mirrors at chunk boundaries)"
+            )
     if cfg.chunk_waves <= 0:
         errors.append("chunkWaves: must be > 0")
     if cfg.wave_width != "auto" and cfg.wave_width <= 0:

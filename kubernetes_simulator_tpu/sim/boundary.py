@@ -44,7 +44,7 @@ completions (tests/test_divergence_pin.py).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -127,6 +127,15 @@ class BoundaryOps:
         # [K8S] keeps every pending pod; the bounded analogue sheds load —
         # loudly (VERDICT r4 weak #2: drops must be a reported number).
         self.retry_dropped = 0
+        # Chaos disruption: node_down NoExecute evictions (evict_node),
+        # DISTINCT from scheduler-initiated `preemptions`. `_evict_time`
+        # maps each still-displaced pod to its eviction boundary time — a
+        # retry-pass re-bind pops it (rescheduled, latency accumulated);
+        # whatever remains at trace end is stranded.
+        self.evictions = 0
+        self.evict_rescheduled = 0
+        self._evict_lat_sum = 0.0
+        self._evict_time: Dict[int, float] = {}
         # Boundary start times: f64 for the static release schedule, f32
         # finite prefix for the retry pend schedule (matching the device's
         # staged f32 table bit-for-bit).
@@ -199,6 +208,16 @@ class BoundaryOps:
                 [self.placed_total, self.preemptions, self.retry_dropped],
                 np.int64,
             ),
+            "chaos": np.asarray(
+                [self.evictions, self.evict_rescheduled], np.int64
+            ),
+            "evict_lat": np.asarray([self._evict_lat_sum], np.float64),
+            "evict_times": (
+                np.asarray(
+                    [[p, t] for p, t in sorted(self._evict_time.items())],
+                    np.float64,
+                ).reshape(-1, 2)
+            ),
         }
 
     def restore(self, blob: dict, used, mc, aa, pw) -> None:
@@ -250,6 +269,16 @@ class BoundaryOps:
         self.placed_total = int(c[0])
         self.preemptions = int(c[1])
         self.retry_dropped = int(c[2])
+        # Chaos keys absent = a pre-chaos blob (zero disruption so far).
+        ch = blob.get("chaos")
+        self.evictions = int(ch[0]) if ch is not None else 0
+        self.evict_rescheduled = int(ch[1]) if ch is not None else 0
+        el = blob.get("evict_lat")
+        self._evict_lat_sum = float(el[0]) if el is not None else 0.0
+        et = blob.get("evict_times")
+        self._evict_time = (
+            {int(p): float(t) for p, t in et} if et is not None else {}
+        )
 
     # -- plane folds (eager or logged) --------------------------------------
 
@@ -324,6 +353,65 @@ class BoundaryOps:
             self.placed_total += int(pid.size)
         for p in ids[~placed]:
             self.offer_failure(int(p))
+
+    # -- chaos eviction (node_down NoExecute) -------------------------------
+
+    @property
+    def evict_stranded(self) -> int:
+        """Evicted pods not re-placed (yet) — final value read at trace end."""
+        return len(self._evict_time)
+
+    @property
+    def evict_latency_mean(self) -> float:
+        """Mean virtual time from eviction to re-bind (boundary-granular)."""
+        return (
+            self._evict_lat_sum / self.evict_rescheduled
+            if self.evict_rescheduled
+            else 0.0
+        )
+
+    def evict_node(self, node: int, b: int, t_chunk: float) -> PairArrays:
+        """NoExecute eviction of every pod the mirror holds bound on
+        ``node`` at boundary ``b`` — the device twin of the CPU event
+        engine's ``node_down`` handling. Victims are unbound with a FULL
+        count rewind, their scheduled releases are cancelled, and non-gang
+        victims re-enter the retry buffer exactly like preemption victims
+        (overflow counted in ``retry_dropped``). Gang victims cannot
+        re-assemble through the boundary retry pass (Permit is in-wave on
+        the device), so they stay displaced and surface as stranded.
+        Returns the (pods, nodes) pair for the device carry delta; the
+        caller must have the mirror current through chunk ``b-1``
+        (``fold_chunk``/``_fold_pending``) before calling."""
+        ec, ep, st = self.ec, self.ep, self.st
+        victims = np.nonzero(st.bound == node)[0]
+        if not victims.size:
+            return _empty_pairs()
+        # unbind reads/writes the live count planes — logged deltas must
+        # land first (chaos is rare; quiet runs never pay this flush).
+        self.flush_planes()
+        for v in victims:
+            v = int(v)
+            unbind(ec, ep, st, v)
+            self.evictions += 1
+            self._evict_time[v] = float(t_chunk)
+            # Same bookkeeping as a preemption victim: a displaced pod's
+            # pending release no longer frees anything, and a later
+            # re-placement starts at THAT boundary — the arrival-based
+            # static release must never fire.
+            self.pend[:] = [e for e in self.pend if e[1] != v]
+            self.bind_chunk[v] = _NEVER
+            if self.assignments[v] >= 0:
+                self.assignments[v] = PAD
+                if ep.bound_node[v] == PAD:
+                    self.placed_total -= 1
+            if self.retry_buffer and ep.group_id[v] == PAD:
+                if len(self.retry_q) < self.retry_buffer:
+                    self.retry_q.append(v)
+                else:
+                    self.retry_dropped += 1
+        return victims.astype(np.int64), np.full(
+            victims.size, int(node), np.int64
+        )
 
     # -- the boundary -------------------------------------------------------
 
@@ -417,6 +505,14 @@ class BoundaryOps:
                 self.assignments[p] = res.node
                 if ep.bound_node[p] == PAD:
                     self.placed_total += 1
+                if p in self._evict_time:
+                    # A chaos-evicted pod re-bound: boundary-granular
+                    # reschedule latency (the trailing boundary's inf
+                    # start time contributes 0 — the re-bind still counts).
+                    t_ev = self._evict_time.pop(p)
+                    self.evict_rescheduled += 1
+                    if np.isfinite(t_chunk):
+                        self._evict_lat_sum += float(t_chunk) - t_ev
                 # Release schedule: f32 boundary search, >= b+1 — the pod
                 # STARTS now, not at arrival.
                 dur = np.float32(ep.duration[p])

@@ -38,7 +38,7 @@ from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import SchedState, init_state
 from ..ops import tpu as T
 from ..plugins.builtin import DEFAULT_WEIGHTS
-from .runtime import ReplayResult
+from .runtime import ReplayResult, events_hash, validate_node_events
 from .waves import WaveBatch, pack_waves
 
 DEFAULT_PLUGINS = (
@@ -736,6 +736,11 @@ class JaxReplayEngine:
         )
         self._last_bops = bops  # probe for the quiet-path tests/bench
         state = self._init_dev_state()
+        pending_events = sorted(node_events or [], key=lambda e: e.time)
+        ev_hash = events_hash(pending_events)
+        ev_applied = 0  # checkpoint event cursor
+        saved_alloc = np.asarray(self.dc.allocatable).copy()
+        saved_alloc_ec = self.ec.allocatable.copy()
         start_chunk = 0
         if resume and checkpoint_path:
             from .checkpoint import ReplayCheckpoint
@@ -746,16 +751,41 @@ class JaxReplayEngine:
                     "checkpoint was not written by a boundary-mode "
                     "(retry/kube) replay — resume it on a plain engine"
                 )
+            ck_hash = ck.boundary.get("ev_hash")
+            if ck_hash is not None and not np.array_equal(
+                np.asarray(ck_hash, np.uint8), ev_hash
+            ):
+                raise ValueError(
+                    "checkpoint was written under a different node_events "
+                    "timeline — resuming would re-apply or skip events "
+                    "(evictions are not idempotent); pass the original "
+                    "event list or restart the replay from scratch"
+                )
             state = self._state_from_checkpoint(ck)
             bops.restore(
                 ck.boundary, ck.used, ck.match_count, ck.anti_active,
                 ck.pref_wsum,
             )
             start_chunk = ck.chunk_cursor
+            cur = ck.boundary.get("ev_cursor")
+            if cur is not None and int(np.asarray(cur).reshape(-1)[0]):
+                # Catch-up: past events re-shape allocatable (the device
+                # cluster starts unperturbed) WITHOUT re-evicting — the
+                # restored mirror already reflects their evictions.
+                ev_applied = int(np.asarray(cur).reshape(-1)[0])
+                done = pending_events[:ev_applied]
+                self._apply_node_events(done, saved_alloc)
+                for ev in done:
+                    if ev.kind == "node_down":
+                        self.ec.allocatable[ev.node] = 0.0
+                    elif ev.kind == "node_up":
+                        self.ec.allocatable[ev.node] = saved_alloc_ec[ev.node]
+                    elif ev.kind == "capacity_scale":
+                        self.ec.allocatable[ev.node] = (
+                            saved_alloc_ec[ev.node] * ev.scale
+                        )
+                pending_events = pending_events[ev_applied:]
         wave_times = self._wave_start_times(idx)
-        pending_events = sorted(node_events or [], key=lambda e: e.time)
-        saved_alloc = np.asarray(self.dc.allocatable).copy()
-        saved_alloc_ec = self.ec.allocatable.copy()
         idx_chunks = (
             [jnp.asarray(idx[c0 : c0 + C]) for c0 in range(0, idx.shape[0], C)]
             if self.engine == "v3"
@@ -797,16 +827,34 @@ class JaxReplayEngine:
                     # failures or a carried-over queue): it needs chunk
                     # ci-1 folded and the mirror planes flushed.
                     _fold_pending()
+                chaos_p: List[np.ndarray] = []
+                chaos_n: List[np.ndarray] = []
                 if pending_events:
                     chunk_t = wave_times[c0]
                     due = [e for e in pending_events if e.time <= chunk_t]
                     if due:
+                        if any(e.kind == "node_down" for e in due):
+                            # NoExecute eviction reads the mirror's bound
+                            # state — it must be current through chunk
+                            # ci-1 (quiet lazy chunks may not be yet).
+                            _fold_pending()
                         self._apply_node_events(due, saved_alloc)
                         # The host mirror's plugins read ec.allocatable
                         # live — keep it in lockstep with the device copy.
                         for ev in due:
                             if ev.kind == "node_down":
                                 self.ec.allocatable[ev.node] = 0.0
+                                # NoExecute: evict the node's bound pods
+                                # through the mirror (they re-enter the
+                                # retry buffer and are re-attempted in
+                                # THIS boundary's retry pass, like the
+                                # CPU engine's requeue-at-event-instant).
+                                cp, cn = bops.evict_node(
+                                    ev.node, ci, float(chunk_t)
+                                )
+                                if cp.size:
+                                    chaos_p.append(cp)
+                                    chaos_n.append(cn)
                             elif ev.kind == "node_up":
                                 self.ec.allocatable[ev.node] = saved_alloc_ec[ev.node]
                             elif ev.kind == "capacity_scale":
@@ -814,13 +862,16 @@ class JaxReplayEngine:
                                     saved_alloc_ec[ev.node] * ev.scale
                                 )
                         pending_events = pending_events[len(due):]
+                        ev_applied += len(due)
                 rel, binds, evicts = bops.boundary(ci, wave_times[c0])
-                if rel[0].size or binds[0].size or evicts[0].size:
+                if (
+                    rel[0].size or binds[0].size or evicts[0].size or chaos_p
+                ):
                     state = self._apply_boundary_delta(
                         state,
                         (
-                            np.concatenate([rel[0], evicts[0]]),
-                            np.concatenate([rel[1], evicts[1]]),
+                            np.concatenate([rel[0], evicts[0], *chaos_p]),
+                            np.concatenate([rel[1], evicts[1], *chaos_n]),
                         ),
                         binds,
                     )
@@ -861,9 +912,16 @@ class JaxReplayEngine:
                     # Blob parity with the eager path: the mirror's
                     # bookkeeping must be current through chunk ci.
                     _fold_pending()
+                    blob = bops.to_blob()
+                    # Applied-event cursor + timeline hash: a resume must
+                    # neither re-apply past events (evictions are not
+                    # idempotent) nor skip future ones, and must reject a
+                    # different event list outright.
+                    blob["ev_cursor"] = np.asarray([ev_applied], np.int64)
+                    blob["ev_hash"] = ev_hash
                     self._save_checkpoint(
                         state, ci + 1, [], checkpoint_path,
-                        released=bops.released, boundary=bops.to_blob(),
+                        released=bops.released, boundary=blob,
                     )
             _fold_pending()
             if self.kube:
@@ -920,6 +978,10 @@ class JaxReplayEngine:
             utilization=util,
             state=host_state,
             retry_dropped=bops.retry_dropped,
+            evictions=bops.evictions,
+            evict_rescheduled=bops.evict_rescheduled,
+            evict_stranded=bops.evict_stranded,
+            evict_latency_mean=bops.evict_latency_mean,
         )
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
@@ -927,9 +989,13 @@ class JaxReplayEngine:
         return wave_start_times(self.pods, idx)
 
     def _apply_node_events(self, events, saved_alloc: np.ndarray) -> None:
-        """Mutate the device cluster's allocatable rows (failure injection;
-        device-path semantics: capacity changes affect FUTURE placements —
-        no eviction of already-placed pods, unlike the CPU event engine)."""
+        """Mutate the device cluster's allocatable rows (failure
+        injection). Capacity changes affect future placements; on the
+        boundary path (``retry_buffer``/``kube``) the caller ALSO evicts
+        ``node_down`` victims through the host mirror with NoExecute
+        semantics (``BoundaryOps.evict_node``), matching the CPU event
+        engine. The plain path keeps the capacity-only semantics — no
+        mirror exists to requeue victims through."""
         alloc = np.asarray(self.dc.allocatable).copy()
         for ev in events:
             if ev.kind == "node_down":
@@ -953,9 +1019,13 @@ class JaxReplayEngine:
         ``node_events`` (list of sim.runtime.NodeEvent) are applied at chunk
         boundaries: an event fires before the first chunk whose start wave's
         arrival time is past the event time (granularity = chunk_waves; use
-        smaller chunks for finer timing)."""
+        smaller chunks for finer timing). With ``retry_buffer``/``kube``
+        active, ``node_down`` additionally evicts bound pods (NoExecute)
+        through the boundary mirror; without a retry buffer only future
+        placements are affected."""
         from .checkpoint import ReplayCheckpoint, checkpoint_to_state, state_to_checkpoint
 
+        validate_node_events(node_events, self.ec.num_nodes)
         if self.preemption and (checkpoint_path or resume):
             raise ValueError(
                 "checkpoint/resume is not supported with device preemption "

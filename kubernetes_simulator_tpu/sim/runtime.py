@@ -47,6 +47,82 @@ class NodeEvent:
     scale: float = 1.0
 
 
+_EVENT_KINDS = ("node_down", "node_up", "capacity_scale")
+
+
+def validate_node_events(
+    events: Optional[List[NodeEvent]], num_nodes: int
+) -> List[NodeEvent]:
+    """Up-front validation shared by every engine (CPU, device replay,
+    what-if timelines): a malformed timeline raises an actionable
+    ``ValueError`` instead of silently misbehaving mid-replay. Checks:
+    known kind, node index in range, finite non-negative non-decreasing
+    times, ``node_up`` only after a ``node_down`` on the same node, and a
+    non-negative ``capacity_scale`` factor. Returns the (unmodified)
+    list for chaining."""
+    events = events or []
+    down: set = set()
+    prev_t = -np.inf
+    for i, ev in enumerate(events):
+        where = f"node_events[{i}]"
+        if ev.kind not in _EVENT_KINDS:
+            raise ValueError(
+                f"{where}: unknown kind {ev.kind!r} (expected one of "
+                f"{', '.join(_EVENT_KINDS)})"
+            )
+        if not (0 <= int(ev.node) < num_nodes):
+            raise ValueError(
+                f"{where}: node {ev.node} out of range for a cluster of "
+                f"{num_nodes} nodes"
+            )
+        t = float(ev.time)
+        if not np.isfinite(t) or t < 0:
+            raise ValueError(
+                f"{where}: time {ev.time!r} must be a finite value >= 0"
+            )
+        if t < prev_t:
+            raise ValueError(
+                f"{where}: time {t} is before the previous event's "
+                f"{prev_t} — timelines must be sorted by time (the "
+                f"checkpoint event cursor and the boundary-granular "
+                f"device application both assume it)"
+            )
+        prev_t = t
+        if ev.kind == "node_down":
+            down.add(int(ev.node))
+        elif ev.kind == "node_up":
+            if int(ev.node) not in down:
+                raise ValueError(
+                    f"{where}: node_up for node {ev.node} without a prior "
+                    f"node_down — recovery of a node that never failed "
+                    f"usually means a mis-built timeline"
+                )
+            down.discard(int(ev.node))
+        elif ev.kind == "capacity_scale" and (
+            not np.isfinite(float(ev.scale)) or float(ev.scale) < 0
+        ):
+            raise ValueError(
+                f"{where}: capacity_scale factor {ev.scale!r} must be a "
+                f"finite value >= 0"
+            )
+    return events
+
+
+def events_hash(events: Optional[List[NodeEvent]]) -> np.ndarray:
+    """Stable 32-byte digest of a timeline (uint8[32]) — stored in
+    boundary-mode checkpoint blobs so a resume under a DIFFERENT event
+    list is rejected instead of silently re-applying or skipping
+    events."""
+    import hashlib
+
+    items = tuple(
+        (float(e.time), str(e.kind), int(e.node), float(e.scale))
+        for e in (events or [])
+    )
+    digest = hashlib.sha256(repr(items).encode()).digest()
+    return np.frombuffer(digest, dtype=np.uint8).copy()
+
+
 @dataclass
 class ReplayResult:
     assignments: np.ndarray  # [P] i32 node per pod (PAD = never placed)
@@ -63,6 +139,16 @@ class ReplayResult:
     # paths; [K8S] keeps everything — a nonzero value means placements
     # were lost to buffer capacity, not infeasibility).
     retry_dropped: int = 0
+    # Chaos disruption counters — node_down NoExecute evictions, kept
+    # DISTINCT from scheduler-initiated `preemptions` so failure injection
+    # is never conflated with PostFilter victim selection. `rescheduled`
+    # counts evicted pods that later re-bound; `stranded` = evicted and
+    # never re-placed by trace end; latency is mean virtual time from
+    # eviction to re-bind (boundary-granular on the device path).
+    evictions: int = 0
+    evict_rescheduled: int = 0
+    evict_stranded: int = 0
+    evict_latency_mean: float = 0.0
 
     def summary(self) -> dict:
         return {
@@ -75,6 +161,10 @@ class ReplayResult:
             "virtual_makespan": self.virtual_makespan,
             "utilization": {k: round(v, 4) for k, v in self.utilization.items()},
             "retry_dropped": self.retry_dropped,
+            "evictions": self.evictions,
+            "evict_rescheduled": self.evict_rescheduled,
+            "evict_stranded": self.evict_stranded,
+            "evict_latency_mean": round(self.evict_latency_mean, 4),
         }
 
 
@@ -105,6 +195,7 @@ class CpuReplayEngine:
 
     def replay(self, node_events: Optional[List[NodeEvent]] = None) -> ReplayResult:
         ec, pods = self.ec, self.pods
+        validate_node_events(node_events, ec.num_nodes)
         st = init_state(ec, pods)
         q = SchedulingQueue()
         events: List[Tuple[float, int, int, int]] = []  # (time, kind, seq, payload)
@@ -142,6 +233,11 @@ class CpuReplayEngine:
         failed_groups_ver: Dict[int, int] = {}  # group → progress_ver at failure
 
         placed = preemptions = attempts = 0
+        # Chaos disruption accounting: eviction time per still-displaced
+        # pod (a re-bind pops it; what remains at trace end is stranded).
+        evictions = evict_rescheduled = 0
+        evict_lat_sum = 0.0
+        evict_time: Dict[int, float] = {}
         # Last successful placement per pod: a COMPLETED pod keeps its node
         # (it ran; it is not unschedulable), unlike st.bound which goes PAD
         # at EV_FINISH. Evictions clear it until re-placed.
@@ -216,6 +312,8 @@ class CpuReplayEngine:
                             # NoExecute semantics: evict and requeue ([K8S]).
                             for m in np.nonzero(st.bound == ev.node)[0]:
                                 evict(int(m))
+                                evictions += 1
+                                evict_time[int(m)] = now
                         elif ev.kind == "node_up":
                             ec.allocatable[ev.node] = saved_alloc[ev.node]
                         elif ev.kind == "capacity_scale":
@@ -270,6 +368,9 @@ class CpuReplayEngine:
                             made_bind = True
                             progress_ver += 1
                             assignments[m] = st.bound[m]
+                            if m in evict_time:
+                                evict_rescheduled += 1
+                                evict_lat_sum += now - evict_time.pop(m)
                             if np.isfinite(pods.duration[m]):
                                 finish_seq[m] = push_event(
                                     now + float(pods.duration[m]), EV_FINISH, m
@@ -282,6 +383,9 @@ class CpuReplayEngine:
                     made_bind = True
                     progress_ver += 1
                     assignments[p] = res.node
+                    if p in evict_time:
+                        evict_rescheduled += 1
+                        evict_lat_sum += now - evict_time.pop(p)
                     if np.isfinite(pods.duration[p]):
                         finish_seq[p] = push_event(
                             now + float(pods.duration[p]), EV_FINISH, p
@@ -323,6 +427,12 @@ class CpuReplayEngine:
             virtual_makespan=now,
             utilization=util,
             state=st,
+            evictions=evictions,
+            evict_rescheduled=evict_rescheduled,
+            evict_stranded=len(evict_time),
+            evict_latency_mean=(
+                evict_lat_sum / evict_rescheduled if evict_rescheduled else 0.0
+            ),
         )
 
 

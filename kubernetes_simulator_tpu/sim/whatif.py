@@ -59,6 +59,13 @@ class Perturbation:
 @dataclass
 class Scenario:
     perturbations: List[Perturbation] = field(default_factory=list)
+    # Timed failure/recovery timeline (chaos campaigns, round 7): a list
+    # of sim.runtime.NodeEvent applied to THIS scenario at chunk
+    # boundaries through its host mirror — node_down evicts bound pods
+    # (NoExecute) into the retry buffer, node_up/capacity_scale re-shape
+    # allocatable mid-replay. Requires kube mode (the mirrors); static
+    # t=0 perturbations above need no mirror and work everywhere.
+    events: List = field(default_factory=list)
 
 
 class ScenarioSet:
@@ -444,6 +451,14 @@ class WhatIfResult:
     # in-scan FIFO counts overflow exactly like the host analogue).
     preemptions: Optional[np.ndarray] = None  # [S] i32
     retry_dropped: Optional[np.ndarray] = None  # [S] i32
+    # Per-scenario chaos disruption (kube batches, round 7): node_down
+    # NoExecute evictions through the host mirrors, DISTINCT from
+    # scheduler-initiated `preemptions`. `evict_latency_mean` is the mean
+    # virtual eviction→re-bind time (boundary-granular).
+    evictions: Optional[np.ndarray] = None  # [S] i32
+    evict_rescheduled: Optional[np.ndarray] = None  # [S] i32
+    evict_stranded: Optional[np.ndarray] = None  # [S] i32
+    evict_latency_mean: Optional[np.ndarray] = None  # [S] f64
 
 
 class WhatIfEngine:
@@ -541,6 +556,31 @@ class WhatIfEngine:
                     "same rule as the single-replay engine"
                 )
         preemption = pmode == "tier"
+        # Per-scenario timed failure/recovery timelines (chaos campaigns,
+        # round 7): applied through the per-scenario host mirrors at
+        # chunk boundaries — which only exist in kube mode.
+        # Validation enforces time-sortedness, so the lists are kept as
+        # given (an unsorted timeline must ERROR, not be silently fixed).
+        self._timelines = [
+            list(getattr(sc, "events", None) or []) for sc in scenarios
+        ]
+        if any(self._timelines):
+            if not self.kube:
+                raise ValueError(
+                    "per-scenario timed event timelines (Scenario.events) "
+                    "require preemption='kube' with retry_buffer > 0: "
+                    "events apply through the per-scenario host mirrors "
+                    "at chunk boundaries, and node_down evictions requeue "
+                    "victims through the boundary retry pass. Use static "
+                    "t=0 Perturbations for mirror-free batches."
+                )
+            from .runtime import validate_node_events
+
+            for si, tl in enumerate(self._timelines):
+                try:
+                    validate_node_events(tl, ec.num_nodes)
+                except ValueError as e:
+                    raise ValueError(f"scenario {si}: {e}") from None
         self.ec = ec
         self.pods = pods
         self._config = config
@@ -2058,6 +2098,17 @@ class WhatIfEngine:
                     for s in range(self.S):
                         kbops[s].fold_chunk(ci_p, rows_p, ch[s])
                     kpending = None
+
+            # Per-scenario timed timelines (chaos campaigns, round 7).
+            # The mirrors' EncodedCluster twins hold VIEWS of
+            # host_stacks["alloc"][s], so mutating the stack rows keeps
+            # host and (re-uploaded) device allocatable in lockstep.
+            hs = self.sset.host_stacks
+            ktimelines = self._timelines
+            kev_cursor = [0] * self.S
+            khas_events = any(ktimelines)
+            if khas_events:
+                ksaved_alloc = hs["alloc"].copy()  # [S, N, R] at t=0
         if pre_comp:
             # Eager eviction-aware folds (the single-replay round-4 rule,
             # S-stacked): eviction events must land in the host
@@ -2180,23 +2231,78 @@ class WhatIfEngine:
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if kbops is not None:
+                t_now = kube_wave_t[c0]
+                due_any = khas_events and any(
+                    kev_cursor[s] < len(ktimelines[s])
+                    and ktimelines[s][kev_cursor[s]].time <= t_now
+                    for s in range(self.S)
+                )
                 if kpending is not None and (
                     np.asarray(kpending[3]).any()
                     or any(b.retry_q for b in kbops)
+                    or due_any
                 ):
-                    # Some scenario's retry pass will read its mirror:
-                    # resolve the deferred fold (all scenarios — failures
-                    # cluster, and the boundary pass needs every mirror's
-                    # bookkeeping current anyway).
+                    # Some scenario's retry pass will read its mirror —
+                    # or a due node_down must evict against bookkeeping
+                    # current through chunk ci-1: resolve the deferred
+                    # fold (all scenarios — failures cluster, and the
+                    # boundary pass needs every mirror current anyway).
                     _kfold_pending()
+                chaos = None
+                if due_any:
+                    chaos = []  # per-scenario eviction PairArrays (or None)
+                    dirty_alloc = False
+                    for s in range(self.S):
+                        tl, cur = ktimelines[s], kev_cursor[s]
+                        cps, cns = [], []
+                        while cur < len(tl) and tl[cur].time <= t_now:
+                            ev = tl[cur]
+                            cur += 1
+                            dirty_alloc = True
+                            if ev.kind == "node_down":
+                                hs["alloc"][s, ev.node] = 0.0
+                                cp, cn = kbops[s].evict_node(
+                                    ev.node, ci, float(t_now)
+                                )
+                                if cp.size:
+                                    cps.append(cp)
+                                    cns.append(cn)
+                            elif ev.kind == "node_up":
+                                hs["alloc"][s, ev.node] = ksaved_alloc[
+                                    s, ev.node
+                                ]
+                            elif ev.kind == "capacity_scale":
+                                hs["alloc"][s, ev.node] = (
+                                    ksaved_alloc[s, ev.node] * ev.scale
+                                )
+                        kev_cursor[s] = cur
+                        chaos.append(
+                            (np.concatenate(cps), np.concatenate(cns))
+                            if cps
+                            else None
+                        )
+                    if dirty_alloc:
+                        # One [S, N, R] upload per event-bearing boundary
+                        # — events are sparse in virtual time, so this
+                        # stays off the steady-state chunk path.
+                        dc = dc._replace(
+                            allocatable=jnp.asarray(hs["alloc"])
+                        )
                 subs = []
                 adds = []
                 any_bdelta = False
-                for b in kbops:
+                for s, b in enumerate(kbops):
                     rel, binds, evicts = b.boundary(ci, kube_wave_t[c0])
+                    cev = chaos[s] if chaos is not None else None
                     sub = (
-                        np.concatenate([rel[0], evicts[0]]),
-                        np.concatenate([rel[1], evicts[1]]),
+                        np.concatenate(
+                            [rel[0], evicts[0]]
+                            + ([cev[0]] if cev is not None else [])
+                        ),
+                        np.concatenate(
+                            [rel[1], evicts[1]]
+                            + ([cev[1]] if cev is not None else [])
+                        ),
                     )
                     if sub[0].size or binds[0].size:
                         any_bdelta = True
@@ -2344,11 +2450,17 @@ class WhatIfEngine:
                 states = self._apply_stacked_boundary_delta(
                     states, subs, adds
                 )
+            if khas_events:
+                # The stack rows were mutated in lockstep with the
+                # mirrors — restore the t=0 view so the engine (and its
+                # ScenarioSet) stays reusable.
+                hs["alloc"][...] = ksaved_alloc
         jax.block_until_ready(states)
         wall = time.perf_counter() - t0
 
         to_schedule = int((idx >= 0).sum())
         kube_preempt = kube_dropped = None
+        kube_evict = kube_resched = kube_stranded = kube_lat = None
         if kbops is not None:
             host_k = np.stack([b.assignments for b in kbops])
             assignments = host_k if self.collect_assignments else None
@@ -2361,6 +2473,16 @@ class WhatIfEngine:
             )
             kube_dropped = np.asarray(
                 [b.retry_dropped for b in kbops], np.int32
+            )
+            kube_evict = np.asarray([b.evictions for b in kbops], np.int32)
+            kube_resched = np.asarray(
+                [b.evict_rescheduled for b in kbops], np.int32
+            )
+            kube_stranded = np.asarray(
+                [b.evict_stranded for b in kbops], np.int32
+            )
+            kube_lat = np.asarray(
+                [b.evict_latency_mean for b in kbops], np.float64
             )
         elif comp_on and self.preemption:
             # The eager eviction-aware folds ARE the walk (see the chunk
@@ -2478,6 +2600,10 @@ class WhatIfEngine:
             engine=self.engine,
             preemptions=kube_preempt,
             retry_dropped=dropped,
+            evictions=kube_evict,
+            evict_rescheduled=kube_resched,
+            evict_stranded=kube_stranded,
+            evict_latency_mean=kube_lat,
         )
 
 

@@ -95,6 +95,22 @@ class WhatIfSpec:
     retry_buffer: int = 0
 
 
+@dataclass
+class ChaosSpec:
+    """Seeded chaos campaign (``chaos:`` YAML section): MTBF/MTTR-style
+    failure injection. ``cmd_run`` turns this into a single
+    ``node_events`` timeline; ``cmd_whatif`` gives each scenario s > 0 its
+    own ``seed + s`` timeline (scenario 0 stays the clean reference)."""
+
+    enabled: bool = False
+    seed: int = 0
+    mtbf: float = 200.0
+    mttr: float = 20.0
+    node_fraction: float = 0.2
+    horizon: Optional[float] = None  # None → workload makespan
+    max_events: Optional[int] = None
+
+
 def _coerce_completions(v: object) -> Optional[bool]:
     """None stays None (default-on with warn); bool/int coerce to bool;
     everything else is a config error, not a truthy surprise."""
@@ -115,6 +131,7 @@ class SimConfig:
     borg: Optional[BorgWorkloadSpec] = None
     framework: FrameworkConfig = field(default_factory=FrameworkConfig)
     whatif: WhatIfSpec = field(default_factory=WhatIfSpec)
+    chaos: Optional[ChaosSpec] = None
     output: Optional[str] = None
     wave_width: int = 8
     chunk_waves: int = 1024
@@ -191,6 +208,23 @@ class SimConfig:
             completions=_coerce_completions(wi.get("completions")),
             retry_buffer=int(wi.get("retryBuffer", 0)),
         )
+        ch = d.get("chaos")
+        if ch is not None:
+            cfg.chaos = ChaosSpec(
+                enabled=bool(ch.get("enabled", True)),
+                seed=int(ch.get("seed", 0)),
+                mtbf=float(ch.get("mtbf", 200.0)),
+                mttr=float(ch.get("mttr", 20.0)),
+                node_fraction=float(ch.get("nodeFraction", 0.2)),
+                horizon=(
+                    float(ch["horizon"]) if ch.get("horizon") is not None
+                    else None
+                ),
+                max_events=(
+                    int(ch["maxEvents"]) if ch.get("maxEvents") is not None
+                    else None
+                ),
+            )
         cfg.output = d.get("output")
         ww = d.get("waveWidth", 8)
         cfg.wave_width = ww if ww == "auto" else int(ww)

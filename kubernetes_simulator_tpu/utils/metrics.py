@@ -60,6 +60,7 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
     }
     pre = getattr(res, "preemptions", None)
     drop = getattr(res, "retry_dropped", None)
+    evi = getattr(res, "evictions", None)
     for s in range(res.placed.shape[0]):
         row = {
             "kind": "whatif-scenario",
@@ -76,6 +77,15 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
             # capacity, not infeasibility.
             row["preemptions"] = int(pre[s])
             row["retry_dropped"] = int(drop[s])
+        if evi is not None:
+            # chaos disruption — distinct from scheduler-initiated
+            # preemption above.
+            row["evictions"] = int(evi[s])
+            row["evict_rescheduled"] = int(res.evict_rescheduled[s])
+            row["evict_stranded"] = int(res.evict_stranded[s])
+            row["evict_latency_mean"] = round(
+                float(res.evict_latency_mean[s]), 4
+            )
         yield row
 
 

@@ -14,7 +14,10 @@ completions run; 0 = off), NS_PREEMPT=1 (tier preemption on the batch
 run — the preemption × completions scaling probe), and
 NS_SINGLE=plain,retry,kube (comma list: single-replay boundary-mode
 walls — the round-6 lazy-sync cost table; skips the batch run unless
-NS_MODE is also set explicitly).
+NS_MODE is also set explicitly), and NS_CHAOS (int: inject that many
+seeded node_down/node_up events into each NS_SINGLE kube run and print
+the chaos overhead vs the event-free kube wall — the round-7 eviction
+cost probe; requires 'kube' in NS_SINGLE).
 """
 
 import os
@@ -72,7 +75,7 @@ def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
     return wall
 
 
-def run_single(ec, ep, tasks, wave, chunk, mode, retry):
+def run_single(ec, ep, tasks, wave, chunk, mode, retry, events=None):
     """One single-replay wall in a boundary mode: 'plain' (no host
     boundary pass), 'retry' (retry_buffer=NS_RETRY or 512) or 'kube'
     (the faithful PostFilter pass; implies the retry buffer). The
@@ -90,25 +93,29 @@ def run_single(ec, ep, tasks, wave, chunk, mode, retry):
     eng = JaxReplayEngine(
         ec, ep, FrameworkConfig(), wave_width=wave, chunk_waves=chunk, **kw
     )
-    tag = f"single-{mode}"
+    tag = f"single-{mode}" + ("-chaos" if events else "")
     if os.environ.get("NS_WARMUP", "1") not in ("", "0"):
         t0 = time.perf_counter()
-        eng.replay()
+        eng.replay(node_events=events)
         print(
             f"[{tag}] warmup (incl. compile): {time.perf_counter() - t0:.1f}s",
             flush=True,
         )
     t0 = time.perf_counter()
-    res = eng.replay()
+    res = eng.replay(node_events=events)
     wall = time.perf_counter() - t0
     folds = (
         getattr(eng, "_last_bops", None).plane_folds
         if getattr(eng, "_last_bops", None) is not None
         else -1
     )
+    ev = (
+        f" evictions={res.evictions} resched={res.evict_rescheduled}"
+        if events else ""
+    )
     print(
         f"[{tag}] N={ec.num_nodes} P={tasks} W={wave} C={chunk}: "
-        f"wall={wall:.1f}s placed={res.placed} plane_folds={folds}",
+        f"wall={wall:.1f}s placed={res.placed} plane_folds={folds}{ev}",
         flush=True,
     )
     return wall
@@ -148,6 +155,27 @@ def main():
                     f"{walls[m] / walls['plain']:.2f}x",
                     flush=True,
                 )
+    n_chaos = int(os.environ.get("NS_CHAOS", 0))
+    if n_chaos > 0 and "kube" in walls:
+        from kubernetes_simulator_tpu.sim.synthetic import make_chaos_timeline
+
+        horizon = float(ep.arrival.max())
+        events = make_chaos_timeline(
+            ec.num_nodes, seed=0, horizon=horizon, mtbf=horizon,
+            mttr=horizon / 10,
+            node_fraction=min(1.0, max(n_chaos / 2, 1) / ec.num_nodes),
+            max_events=n_chaos,
+        )
+        print(f"[single-kube-chaos] injecting {len(events)} events",
+              flush=True)
+        w = run_single(ec, ep, tasks, wave, chunk, "kube", retry,
+                       events=events)
+        if walls["kube"] > 0:
+            print(
+                f"[single-kube-chaos] overhead vs kube: "
+                f"{w / walls['kube']:.2f}x",
+                flush=True,
+            )
     if mode == "skip":
         return
     scenarios = uniform_scenarios(ec, S, seed=0)
