@@ -49,9 +49,24 @@ class Simulator:
             inject_default_spread(self.pods, self.config)
         self.ec, self.ep = encode(cluster, self.pods)
 
-    def run(self, **replay_kw):
-        engine = get_strategy(self.strategy)(self.ec, self.ep, self.config, **self.engine_kw)
-        return engine.replay(**replay_kw)
+    def run(self, timeline_out: Optional[str] = None, **replay_kw):
+        """One replay with the configured strategy. ``timeline_out`` writes
+        the simulated cluster timeline as a Chrome trace JSON (Perfetto-
+        loadable) — it forces ``telemetry='timeline'`` on the engine
+        unless the caller already picked a granularity."""
+        engine_kw = dict(self.engine_kw)
+        if timeline_out and "telemetry" not in engine_kw:
+            engine_kw["telemetry"] = "timeline"
+        engine = get_strategy(self.strategy)(self.ec, self.ep, self.config, **engine_kw)
+        res = engine.replay(**replay_kw)
+        if timeline_out and getattr(res, "telemetry", None) is not None:
+            from .sim.telemetry import write_chrome_trace
+
+            write_chrome_trace(
+                timeline_out, res,
+                arrival=self.ep.arrival, duration=self.ep.duration,
+            )
+        return res
 
     def what_if(
         self,

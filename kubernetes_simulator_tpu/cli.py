@@ -15,8 +15,33 @@ import sys
 
 from .framework.registry import get_strategy
 from .utils.config import SimConfig, build_encoded_case
-from .utils.metrics import JsonlWriter, log, replay_row, whatif_rows
+from .utils.metrics import (
+    JsonlWriter,
+    config_hash,
+    log,
+    replay_row,
+    whatif_rows,
+)
 from .utils.profiling import device_trace
+
+
+def _writer_context(cfg, config_path: str) -> dict:
+    """Row-stamping context (schema v2): the seed / engine / config hash
+    that produced every row in the file, so results stay attributable
+    after the config moves on."""
+    import yaml
+
+    with open(config_path) as f:
+        d = yaml.safe_load(f) or {}
+    seed = (
+        cfg.borg.seed if cfg.borg is not None
+        else (cfg.workload.seed if cfg.workload is not None else 0)
+    )
+    return {
+        "seed": int(seed),
+        "engine": cfg.strategy,
+        "config_hash": config_hash(d),
+    }
 
 
 def _chaos_timeline(cfg, ec, ep, seed):
@@ -44,24 +69,38 @@ def cmd_run(args) -> int:
     cfg = SimConfig.load(args.config)
     if args.strategy:
         cfg.strategy = args.strategy
+    timeline_out = (
+        getattr(args, "timeline_out", None) or cfg.telemetry.timeline_out
+    )
+    gran = cfg.telemetry.granularity
+    if timeline_out and gran != "off":
+        gran = "timeline"  # a timeline sink needs timeline events
     ec, ep = build_encoded_case(cfg)
     log.info("encoded %d nodes / %d pods", ec.num_nodes, ep.num_pods)
     factory = get_strategy(cfg.strategy)
-    kw = {}
+    kw = {"telemetry": gran}
     if cfg.strategy == "jax":
-        kw = {"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves,
-              "preemption": cfg.device_preemption,
-              "retry_buffer": cfg.whatif.retry_buffer}
+        kw.update({"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves,
+                   "preemption": cfg.device_preemption,
+                   "retry_buffer": cfg.whatif.retry_buffer})
     engine = factory(ec, ep, cfg.framework, **kw)
     events = None
     if cfg.chaos is not None and cfg.chaos.enabled:
         events = _chaos_timeline(cfg, ec, ep, cfg.chaos.seed)
         log.info("chaos: injecting %d node events", len(events))
-    with device_trace(args.profile_dir):
-        res = engine.replay(node_events=events) if events else engine.replay()
-    out = JsonlWriter(cfg.output)
-    out.write(replay_row(f"replay-{cfg.strategy}", res, {"config": args.config}))
-    out.close()
+    # The writer owns the output file for the whole command: a failing
+    # replay still closes (and flushes) whatever was written.
+    with JsonlWriter(cfg.output, context=_writer_context(cfg, args.config)) as out:
+        with device_trace(args.profile_dir):
+            res = engine.replay(node_events=events) if events else engine.replay()
+        out.write(replay_row(f"replay-{cfg.strategy}", res, {"config": args.config}))
+    if timeline_out and res.telemetry is not None:
+        from .sim.telemetry import write_chrome_trace
+
+        n_ev = write_chrome_trace(
+            timeline_out, res, arrival=ep.arrival, duration=ep.duration
+        )
+        log.info("timeline: wrote %d trace events to %s", n_ev, timeline_out)
     log.info(
         "placed %d/%d pods in %.3fs (%.0f placements/sec)",
         res.placed,
@@ -115,13 +154,13 @@ def cmd_whatif(args) -> int:
         preemption=cfg.device_preemption,
         completions=cfg.whatif.completions,
         retry_buffer=cfg.whatif.retry_buffer,
+        telemetry=cfg.telemetry.granularity,
     )
-    with device_trace(args.profile_dir):
-        res = eng.run()
-    out = JsonlWriter(cfg.output)
-    for row in whatif_rows(res, {"config": args.config, "mesh": bool(mesh)}):
-        out.write(row)
-    out.close()
+    with JsonlWriter(cfg.output, context=_writer_context(cfg, args.config)) as out:
+        with device_trace(args.profile_dir):
+            res = eng.run()
+        for row in whatif_rows(res, {"config": args.config, "mesh": bool(mesh)}):
+            out.write(row)
     log.info(
         "what-if: %d scenarios, %d placements in %.3fs (%.0f placements/sec aggregate)",
         len(scen),
@@ -258,6 +297,19 @@ def validate_config(cfg) -> list:
                 "(per-scenario timelines apply through the kube-mode "
                 "host mirrors at chunk boundaries)"
             )
+    from .sim.telemetry import _LEVELS as _TEL_LEVELS
+
+    if cfg.telemetry.granularity not in _TEL_LEVELS:
+        errors.append(
+            f"telemetry.granularity: must be one of "
+            f"{', '.join(_TEL_LEVELS)}, got {cfg.telemetry.granularity!r}"
+        )
+    if cfg.telemetry.timeline_out:
+        d = os.path.dirname(cfg.telemetry.timeline_out) or "."
+        if not os.path.isdir(d):
+            errors.append(
+                f"telemetry.timelineOut: directory not found: {d}"
+            )
     if cfg.chunk_waves <= 0:
         errors.append("chunkWaves: must be > 0")
     if cfg.wave_width != "auto" and cfg.wave_width <= 0:
@@ -300,6 +352,13 @@ def main(argv=None) -> int:
         p.add_argument("config")
         p.add_argument("--strategy", choices=["cpu", "jax"])
         p.add_argument("--profile-dir", default=None, help="jax.profiler trace output dir")
+        if name == "run":
+            p.add_argument(
+                "--timeline-out", default=None,
+                help="write the simulated cluster timeline as a Chrome "
+                     "trace JSON (Perfetto-loadable); implies telemetry "
+                     "granularity 'timeline'",
+            )
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -34,6 +34,10 @@ class ScheduleResult:
     node: int  # PAD = unschedulable
     reason: str = ""
     victims: Tuple[int, ...] = ()  # preempted pods (PostFilter)
+    # Per-plugin first-reject node counts (kube "0/N nodes available"
+    # breakdown) — populated only on a fully-failed attempt when the caller
+    # passed ``want_reasons=True``; always sums to num_nodes then.
+    reasons: Optional[Dict[str, int]] = None
 
 
 @dataclass
@@ -63,18 +67,33 @@ class SchedulerFramework:
 
     # -- Filter + Score over all nodes -------------------------------------
 
-    def feasible_mask(self, st: SchedState, p: int) -> np.ndarray:
+    def feasible_mask(
+        self, st: SchedState, p: int, reject_counts: Optional[Dict[str, int]] = None
+    ) -> np.ndarray:
+        """Filter chain over all nodes. ``reject_counts`` (telemetry
+        opt-in) is filled with per-plugin FIRST-reject node counts —
+        each rejected node charged to the earliest plugin in Filter order
+        that rejected it. The short-circuit break is attribution-lossless:
+        once the mask is empty no later plugin can newly reject anything."""
         import time as _time
+
+        from ..ops import cpu as C
 
         mask = np.ones(self.ec.num_nodes, dtype=bool)
         for pl in self.plugins:
             t0 = _time.perf_counter() if self.config.profile else 0.0
+            if reject_counts is not None:
+                reject_counts.setdefault(pl.name, 0)
             m = pl.filter(self.ctx, st, p)
             if self.config.profile:
                 key = f"Filter/{pl.name}"
                 self.plugin_time[key] = self.plugin_time.get(key, 0.0) + _time.perf_counter() - t0
             if m is not None:
-                mask &= m
+                if reject_counts is not None:
+                    newly, mask = C.first_reject_update(mask, m)
+                    reject_counts[pl.name] += newly
+                else:
+                    mask &= m
                 if not mask.any():
                     break
         return mask
@@ -97,7 +116,11 @@ class SchedulerFramework:
         return total
 
     def schedule_one(
-        self, st: SchedState, p: int, allow_preemption: bool = True
+        self,
+        st: SchedState,
+        p: int,
+        allow_preemption: bool = True,
+        want_reasons: bool = False,
     ) -> ScheduleResult:
         """One scheduling cycle (SURVEY.md §3.3). Does NOT bind — the caller
         (runtime) owns Reserve/Permit/Bind so gang commit stays transactional.
@@ -105,14 +128,20 @@ class SchedulerFramework:
         ``allow_preemption=False`` skips PostFilter: the runtime disables it
         for gang members because a speculative reserve must be cheaply
         revertible, and evicting victims for a reservation that later rolls
-        back cannot be undone."""
-        feasible = self.feasible_mask(st, p)
+        back cannot be undone.
+
+        ``want_reasons=True`` (telemetry ``series``+ only) attaches the
+        per-plugin first-reject breakdown to a fully-failed result. A
+        result rescued by PostFilter preemption carries no reasons — in
+        kube terms the pod nominated a node, it is not unschedulable."""
+        rc: Optional[Dict[str, int]] = {} if want_reasons else None
+        feasible = self.feasible_mask(st, p, reject_counts=rc)
         if not feasible.any():
             if self.config.enable_preemption and allow_preemption:
                 res = self._post_filter_preempt(st, p)
                 if res is not None:
                     return res
-            return ScheduleResult(PAD, "Unschedulable")
+            return ScheduleResult(PAD, "Unschedulable", reasons=rc)
         scores = self.score_nodes(st, p, feasible)
         masked = np.where(feasible, scores, -np.inf)
         # Deterministic lowest-index tie-break (SURVEY.md §7 hard part #6).

@@ -429,3 +429,12 @@ def select_node(scores: np.ndarray, feasible: np.ndarray) -> int:
         return PAD
     masked = np.where(feasible, scores, -np.inf)
     return int(np.argmax(masked))
+
+
+def first_reject_update(mask: np.ndarray, m: np.ndarray):
+    """One Filter step of the kube "0/N nodes available" attribution:
+    charge every node the running ``mask`` still allowed but ``m`` rejects
+    to the current plugin, and advance the mask. Returns
+    ``(newly_rejected_count, mask & m)``. :mod:`..ops.tpu` carries the
+    whole-chain device form (``first_reject_counts``)."""
+    return int((mask & ~m).sum()), mask & m

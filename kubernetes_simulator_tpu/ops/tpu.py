@@ -682,6 +682,23 @@ def select_node(scores: jax.Array, feasible: jax.Array):
     return jnp.where(placed, choice.astype(jnp.int32), PAD), placed
 
 
+def first_reject_counts(masks, failed) -> jax.Array:
+    """[K] i32 — per-plugin first-reject node counts for one slot, the
+    device form of the kube "0/N nodes available" attribution
+    (ops.cpu.first_reject_update is the host edition). ``masks`` is the
+    ordered list of per-plugin [N] bool masks from the fused eval;
+    ``failed`` gates the whole vector (a placed or PAD slot charges
+    nothing). Only fully-failed attempts are ever counted, so the K
+    entries always sum to N per counted slot — matching the event
+    engine's episode semantics at W=1/C=1."""
+    so_far = jnp.ones_like(masks[0])
+    outs = []
+    for m in masks:
+        outs.append(jnp.sum(so_far & ~m).astype(jnp.int32))
+        so_far = so_far & m
+    return jnp.where(failed, jnp.stack(outs), 0)
+
+
 # Packed-select bounds: scores are packed as total·2^14 + (2^14−1−n), which
 # is exact in f32 iff every packed value is an integer < 2^24.
 PACK_SHIFT = 16384.0  # 2^14

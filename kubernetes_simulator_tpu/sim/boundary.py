@@ -81,6 +81,7 @@ class BoundaryOps:
         retry_buffer: int = 0,
         kube: bool = False,
         lazy: bool = False,
+        telemetry=None,
     ):
         if kube and not retry_buffer:
             raise ValueError(
@@ -99,6 +100,18 @@ class BoundaryOps:
         self.lazy = lazy
         self.wave_width = wave_width
         self.chunk_waves = chunk_waves
+        # Telemetry (sim.telemetry.TelemetryCollector | None). The mirror
+        # records boundary-granular signals: retry-bind latency
+        # (t_boundary − arrival, first binds only), first-reject
+        # attribution for failed slots/retries, retry/pend depth series,
+        # and timeline events. Telemetry state is deliberately NOT part of
+        # to_blob()/restore() — checkpoint blobs stay bit-identical with
+        # telemetry on or off.
+        self.tel = telemetry
+        self._ever_bound: Optional[np.ndarray] = (
+            (ep.bound_node >= 0).copy() if telemetry is not None else None
+        )
+        self._last_finite_t = 0.0
         self._plane_log: List[tuple] = []  # (key, sign, pods, nodes)
         self.plane_folds = 0  # applied plane deltas (test/bench probe)
         if retry_buffer:
@@ -345,12 +358,32 @@ class BoundaryOps:
         placed = nd >= 0
         pid = ids[placed]
         pnd = nd[placed]
+        tel = self.tel
+        if tel is not None and tel.cfg.want_series and (~placed).any():
+            # First-reject attribution for the chunk's failed slots,
+            # computed against the pre-chunk mirror state (exact at
+            # W=1/C=1 where a chunk IS one slot; chunk-granular
+            # otherwise). A failed slot whose mirror mask is non-empty is
+            # a gang revert (the pod itself was feasible) — the CPU
+            # engine records no attempt for those either.
+            self.flush_planes()  # attribution reads the count planes
+            for p in ids[~placed]:
+                rc: Dict[str, int] = {}
+                if not self.fw.feasible_mask(self.st, int(p), reject_counts=rc).any():
+                    tel.rejection(int(p), rc)
         if pid.size:
             self._plane_op((ci, 1), 1.0, pid, pnd)
             self.st.bound[pid] = pnd
             self.assignments[pid] = pnd
             self.bind_chunk[pid] = ci
             self.placed_total += int(pid.size)
+            if tel is not None:
+                # Wave-placed pods bind in their arrival wave: latency 0.
+                tel.bind_zero(int((~self._ever_bound[pid]).sum()))
+                self._ever_bound[pid] = True
+                if tel.cfg.want_timeline:
+                    for p, n in zip(pid.tolist(), pnd.tolist()):
+                        tel.event("bind", float(self.ep.arrival[p]), p, n)
         for p in ids[~placed]:
             self.offer_failure(int(p))
 
@@ -391,6 +424,11 @@ class BoundaryOps:
         self.flush_planes()
         for v in victims:
             v = int(v)
+            if self.tel is not None:
+                # Eviction starts a fresh unschedulable episode.
+                self.tel.clear_episode(v)
+                if self.tel.cfg.want_timeline:
+                    self.tel.event("evict", float(t_chunk), v, int(node))
             unbind(ec, ep, st, v)
             self.evictions += 1
             self._evict_time[v] = float(t_chunk)
@@ -423,6 +461,12 @@ class BoundaryOps:
         — the device engine turns them into carry-plane deltas; the
         greedy anchor ignores them (its state IS self.st)."""
         ec, ep, st = self.ec, self.ep, self.st
+        tel = self.tel
+        if np.isfinite(t_chunk):
+            # Retry binds at the trailing (t=inf) boundary record latency
+            # clamped to the last finite boundary time — the same
+            # boundary-granular envelope the chaos reschedule latency uses.
+            self._last_finite_t = float(t_chunk)
         binds_l: List[Tuple[int, int]] = []
         evicts_l: List[Tuple[int, int]] = []
         # 1. Pending releases of boundary-placed pods (relb encodes the
@@ -474,15 +518,29 @@ class BoundaryOps:
             q = self.retry_q
             still_q: List[int] = []
             i = 0
+            want_reasons = tel is not None and tel.cfg.want_series
             while i < len(q):
                 p = q[i]
                 i += 1
-                res = self.fw.schedule_one(st, p, allow_preemption=self.kube)
+                res = self.fw.schedule_one(
+                    st, p, allow_preemption=self.kube, want_reasons=want_reasons
+                )
                 if res.node == PAD:
+                    if want_reasons and res.reasons is not None:
+                        # Grows rejection_attempts every boundary; charges
+                        # `reasons` only if the pod's in-scan failure was
+                        # not already attributed (episode semantics).
+                        tel.rejection(int(p), res.reasons)
                     still_q.append(p)
                     continue
                 for v in res.victims:
                     v = int(v)
+                    if tel is not None:
+                        tel.clear_episode(v)
+                        if tel.cfg.want_timeline:
+                            tel.event(
+                                "preempt", self._last_finite_t, v, int(st.bound[v])
+                            )
                     evicts_l.append((v, int(st.bound[v])))
                     unbind(ec, ep, st, v)  # FULL count rewind — no phantoms
                     self.preemptions += 1
@@ -503,6 +561,24 @@ class BoundaryOps:
                 bind(ec, ep, st, p, res.node)
                 binds_l.append((p, int(res.node)))
                 self.assignments[p] = res.node
+                if tel is not None:
+                    tel.clear_episode(p)
+                    t_bind = (
+                        float(t_chunk)
+                        if np.isfinite(t_chunk)
+                        else self._last_finite_t
+                    )
+                    if not self._ever_bound[p]:
+                        # First bind through the retry pass: latency is
+                        # boundary-granular virtual wait since arrival.
+                        self._ever_bound[p] = True
+                        lat = t_bind - float(ep.arrival[p])
+                        if lat <= 0.0:
+                            tel.bind_zero()
+                        else:
+                            tel.bind_latency(p, lat)
+                    if tel.cfg.want_timeline:
+                        tel.event("bind", t_bind, int(p), int(res.node))
                 if ep.bound_node[p] == PAD:
                     self.placed_total += 1
                 if p in self._evict_time:
@@ -527,6 +603,14 @@ class BoundaryOps:
                     if rb < len(self.tb32):
                         self.pend.append([max(rb, b + 1), p, int(res.node)])
             self.retry_q = still_q
+        if tel is not None and tel.cfg.want_series and np.isfinite(t_chunk):
+            # Post-boundary occupancy in virtual time (the device twin of
+            # the CPU engine's per-event queue-depth samples).
+            tel.sample(
+                float(t_chunk),
+                retry_depth=len(self.retry_q),
+                pend_depth=len(self.pend),
+            )
 
         def _pairs(lst: List[Tuple[int, int]]) -> PairArrays:
             if not lst:

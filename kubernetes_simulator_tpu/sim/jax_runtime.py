@@ -39,7 +39,21 @@ from ..models.state import SchedState, init_state
 from ..ops import tpu as T
 from ..plugins.builtin import DEFAULT_WEIGHTS
 from .runtime import ReplayResult, events_hash, validate_node_events
+from .telemetry import TelemetryCollector, TelemetryConfig
 from .waves import WaveBatch, pack_waves
+
+
+class _NullCtx:
+    """No-op context for phase ticks when telemetry is off."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
 
 DEFAULT_PLUGINS = (
     "NodeResourcesFit",
@@ -180,21 +194,60 @@ def _spread_w_table(ec: EncodedCluster) -> Tuple[float, ...]:
     return tuple(float(x) for x in w)
 
 
-def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec: StepSpec):
+def spec_plugin_names(spec: StepSpec) -> Tuple[str, ...]:
+    """Active Filter plugins in evaluation order — the key order for the
+    in-scan rejection counters. Must stay aligned with both
+    :func:`eval_pod`'s mask chain and the CPU ``make_plugins`` default
+    order (plugins.builtin.PLUGIN_FACTORIES)."""
+    names = []
+    if spec.fit:
+        names.append("NodeResourcesFit")
+    if spec.taints:
+        names.append("TaintToleration")
+    if spec.node_affinity:
+        names.append("NodeAffinity")
+    if spec.interpod:
+        names.append("InterPodAffinity")
+    if spec.spread:
+        names.append("PodTopologySpread")
+    return tuple(names)
+
+
+def eval_pod(
+    dc: T.DevCluster,
+    d: T.Derived,
+    st: T.DevState,
+    s: T.PodSlot,
+    spec: StepSpec,
+    want_masks: bool = False,
+):
     """Fused Filter + Score for one slot against all nodes → (feasible [N],
-    scores [N]). Mirrors SchedulerFramework.feasible_mask/score_nodes."""
+    scores [N]). Mirrors SchedulerFramework.feasible_mask/score_nodes.
+    ``want_masks=True`` (telemetry instrumentation) additionally returns
+    the ordered per-plugin masks for first-reject attribution."""
     N = dc.allocatable.shape[0]
+    masks = []
     feasible = jnp.ones(N, dtype=bool)
     if spec.fit:
-        feasible = feasible & T.fit_mask(dc, st, s)
+        m = T.fit_mask(dc, st, s)
+        masks.append(m)
+        feasible = feasible & m
     if spec.taints:
-        feasible = feasible & T.taint_mask(dc, s)
+        m = T.taint_mask(dc, s)
+        masks.append(m)
+        feasible = feasible & m
     if spec.node_affinity:
-        feasible = feasible & T.node_affinity_mask(d, s)
+        m = T.node_affinity_mask(d, s)
+        masks.append(m)
+        feasible = feasible & m
     if spec.interpod:
-        feasible = feasible & T.interpod_filter_mask(d, st, s)
+        m = T.interpod_filter_mask(d, st, s)
+        masks.append(m)
+        feasible = feasible & m
     if spec.spread:
-        feasible = feasible & T.spread_filter_mask(d, st, s)
+        m = T.spread_filter_mask(d, st, s)
+        masks.append(m)
+        feasible = feasible & m
 
     w = dict(spec.weights)
     total = jnp.zeros(N, dtype=jnp.float32)
@@ -225,6 +278,8 @@ def eval_pod(dc: T.DevCluster, d: T.Derived, st: T.DevState, s: T.PodSlot, spec:
         total = total + w.get("PodTopologySpread", 1.0) * T.spread_upstream_normalize(
             raw, ignored, feasible, any_sp, spec.sp_norm_f32
         )
+    if want_masks:
+        return feasible, total, masks
     return feasible, total
 
 
@@ -284,6 +339,59 @@ def make_chunk_fn(wave_width: int, spec: StepSpec):
         return state, choices
 
     return jax.jit(chunk_fn, donate_argnums=(1,))
+
+
+def make_wave_step_rej(dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSpec):
+    """Instrumented v2 wave step (telemetry ``series``+ on the plain
+    path): same placements as :func:`make_wave_step` — via the reference
+    :func:`eval_pod`, bit-identical to the fused path by the parity
+    suites — plus a carried [K] i32 vector of in-scan first-reject
+    counts (ops.tpu.first_reject_counts) in ``spec_plugin_names`` order.
+    Only fully-failed VALID slots charge counts; gang-reverted members
+    (individually feasible, rolled back by Permit) charge nothing —
+    matching the CPU engine, which records no attempt for them."""
+
+    def wave_step(carry, slot_batch: T.PodSlot):
+        st, rej = carry
+        choices, placeds = [], []
+        for wslot in range(wave_width):
+            s = jax.tree.map(lambda a: a[wslot], slot_batch)
+            feasible, scores, masks = eval_pod(dc, d, st, s, spec, want_masks=True)
+            node, placed_any = T.select_node(scores, feasible)
+            placed = placed_any & s.valid
+            rej = rej + T.first_reject_counts(masks, (~placed_any) & s.valid)
+            st = T.apply_binding(d, st, s, node, placed)
+            choices.append(node)
+            placeds.append(placed)
+        choice = jnp.stack(choices)  # [W]
+        placed = jnp.stack(placeds)  # [W]
+        if spec.has_gangs:
+            groups = slot_batch.group  # [W]
+            same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
+            fail = jnp.any(same & ~placed[None, :], axis=1)
+            revert = placed & fail
+            st = T.apply_unbind_wave(d, st, slot_batch, choice, revert)
+            final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
+        else:
+            final = jnp.where(placed, choice, PAD).astype(jnp.int32)
+        return (st, rej), final
+
+    return wave_step
+
+
+def make_chunk_fn_rej(wave_width: int, spec: StepSpec):
+    """jit: (DevCluster, DevState, rej [K] i32, slots [C, W]) → (DevState,
+    rej, choices[C, W]) — :func:`make_chunk_fn` with the rejection counter
+    threaded through the scan carry and fetched once per replay, never per
+    pod. Built lazily by ``replay()`` only at telemetry ``series``+."""
+
+    def chunk_fn(dc: T.DevCluster, state: T.DevState, rej, slots: T.PodSlot):
+        d = T.Derived.build(dc)
+        wave_step = make_wave_step_rej(dc, d, wave_width, spec)
+        (state, rej), choices = jax.lax.scan(wave_step, (state, rej), slots)
+        return state, rej, choices
+
+    return jax.jit(chunk_fn, donate_argnums=(1, 2))
 
 
 def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
@@ -441,6 +549,7 @@ class JaxReplayEngine:
         retry_buffer: int = 0,
         granularity_guard: bool = True,
         lazy_boundary: bool = True,
+        telemetry=None,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
@@ -471,7 +580,15 @@ class JaxReplayEngine:
         retry queue — skip the mirror plane fold entirely and overlap the
         choices fetch with the next chunk's dispatch; only a scalar
         failure count blocks per chunk. Bit-identical to the eager path
-        (set False to force the old per-chunk blocking folds)."""
+        (set False to force the old per-chunk blocking folds).
+        ``telemetry``: granularity knob (str | sim.telemetry.TelemetryConfig
+        | None → "summary"). "summary" never changes any device program
+        (latency bookkeeping + phase timers only); "series" adds rejection
+        attribution — through the boundary mirror in retry/kube modes,
+        via an instrumented reference (v2) chunk program on the plain
+        path — plus boundary-sampled depth series; "timeline" adds the
+        event log for the Chrome-trace export. "off" disables everything
+        (``ReplayResult.telemetry`` is None)."""
         from ..ops import tpu3 as V3
         from .greedy import normalize_preemption
 
@@ -502,6 +619,7 @@ class JaxReplayEngine:
         self.lazy_boundary = bool(lazy_boundary)
         self.completions = completions
         self.granularity_guard = granularity_guard
+        self.telemetry_cfg = TelemetryConfig.resolve(telemetry)
         self.dc = T.DevCluster.from_encoded(ec)
         # "auto": measured optimum is W=8 across shapes (W=16 loses to the
         # W² in-wave coupling even on coarse-only traces) — kept as a
@@ -532,7 +650,7 @@ class JaxReplayEngine:
             else None
         )
 
-    def _init_dev_state(self):
+    def _init_dev_state(self, force_v2: bool = False):
         from ..ops import tpu3 as V3
         from ..ops.cpu import _group_dom_per_node
 
@@ -540,7 +658,7 @@ class JaxReplayEngine:
         gdom = _group_dom_per_node(self.ec)
         self._gdom = gdom
         self._Dhost = host.match_count.shape[1]
-        if self.engine == "v3":
+        if self.engine == "v3" and not force_v2:
             return V3.DevState3.from_host(
                 host.used, host.match_count, host.anti_active, host.pref_wsum,
                 self.ec, self.static3, ep=self.pods,
@@ -584,17 +702,22 @@ class JaxReplayEngine:
         placed = int((assignments[scheduled] >= 0).sum())
         return assignments, placed
 
-    def _apply_release(self, state, rel_idx: np.ndarray, rel_nodes: np.ndarray):
+    def _apply_release(
+        self, state, rel_idx: np.ndarray, rel_nodes: np.ndarray,
+        as_v2: bool = False,
+    ):
         """Subtract the completed pods' aggregate contribution (resources +
         count planes) from the carried device state — the device twin of
-        models.state.unbind, applied at a chunk boundary."""
+        models.state.unbind, applied at a chunk boundary. ``as_v2``: the
+        caller is carrying a node-space DevState even though the engine is
+        v3 (the instrumented telemetry program)."""
         from ..models.state import release_delta
         from ..ops import tpu3 as V3
 
         used_d, mc_d, aa_d, pw_d = release_delta(
             self.ec, self.pods, rel_idx, rel_nodes
         )
-        if self.engine == "v3":
+        if self.engine == "v3" and not as_v2:
             delta = V3.DevState3.from_host(
                 used_d, mc_d, aa_d, pw_d, self.ec, self.static3
             )
@@ -728,11 +851,22 @@ class JaxReplayEngine:
         )
         fw = SchedulerFramework(self.ec, self.pods, cfg)
         lazy = self.lazy_boundary
+        tel = (
+            TelemetryCollector(self.telemetry_cfg)
+            if self.telemetry_cfg.enabled
+            else None
+        )
+        _tick = (
+            (lambda name: tel.phases.tick(name))
+            if tel is not None
+            else (lambda name: _NULL_CTX)
+        )
         bops = BoundaryOps(
             self.ec, self.pods, fw,
             WaveBatch(idx=idx, wave_width=self.wave_width),
             self.wave_width, C,
             retry_buffer=retry_req, kube=self.kube, lazy=lazy,
+            telemetry=tel,
         )
         self._last_bops = bops  # probe for the quiet-path tests/bench
         state = self._init_dev_state()
@@ -812,7 +946,10 @@ class JaxReplayEngine:
             nonlocal pending
             if pending is not None:
                 ci_p, rows_p, ch_d, _nf = pending
-                bops.fold_chunk(ci_p, rows_p, np.asarray(ch_d))
+                with _tick("device_wait"):
+                    ch_np = np.asarray(ch_d)
+                with _tick("boundary_fold"):
+                    bops.fold_chunk(ci_p, rows_p, ch_np)
                 pending = None
 
         t0 = time.perf_counter()
@@ -839,6 +976,12 @@ class JaxReplayEngine:
                             # ci-1 (quiet lazy chunks may not be yet).
                             _fold_pending()
                         self._apply_node_events(due, saved_alloc)
+                        if tel is not None and tel.cfg.want_timeline:
+                            for ev in due:
+                                if ev.kind in ("node_down", "node_up"):
+                                    tel.event(
+                                        ev.kind, float(ev.time), -1, int(ev.node)
+                                    )
                         # The host mirror's plugins read ec.allocatable
                         # live — keep it in lockstep with the device copy.
                         for ev in due:
@@ -863,28 +1006,31 @@ class JaxReplayEngine:
                                 )
                         pending_events = pending_events[len(due):]
                         ev_applied += len(due)
-                rel, binds, evicts = bops.boundary(ci, wave_times[c0])
+                with _tick("boundary_fold"):
+                    rel, binds, evicts = bops.boundary(ci, wave_times[c0])
                 if (
                     rel[0].size or binds[0].size or evicts[0].size or chaos_p
                 ):
-                    state = self._apply_boundary_delta(
-                        state,
-                        (
-                            np.concatenate([rel[0], evicts[0], *chaos_p]),
-                            np.concatenate([rel[1], evicts[1], *chaos_n]),
-                        ),
-                        binds,
-                    )
-                if self.engine == "v3":
-                    state, choices = self.chunk_fn(
-                        self.dc, state, self._slot_src, self._extra_src,
-                        idx_chunks[ci],
-                    )
-                else:
-                    state, choices = self.chunk_fn(
-                        self.dc, state,
-                        T.gather_slots(self.pods, idx[c0 : c0 + C]),
-                    )
+                    with _tick("host_mirror"):
+                        state = self._apply_boundary_delta(
+                            state,
+                            (
+                                np.concatenate([rel[0], evicts[0], *chaos_p]),
+                                np.concatenate([rel[1], evicts[1], *chaos_n]),
+                            ),
+                            binds,
+                        )
+                with _tick("dispatch"):
+                    if self.engine == "v3":
+                        state, choices = self.chunk_fn(
+                            self.dc, state, self._slot_src, self._extra_src,
+                            idx_chunks[ci],
+                        )
+                    else:
+                        state, choices = self.chunk_fn(
+                            self.dc, state,
+                            T.gather_slots(self.pods, idx[c0 : c0 + C]),
+                        )
                 if lazy:
                     ix_dev = (
                         idx_chunks[ci]
@@ -903,7 +1049,10 @@ class JaxReplayEngine:
                     # Eager fold: one blocking fetch per chunk. (The
                     # choices buffer is fully consumed here — the mirror
                     # carries the placements, so checkpoints save NO outs.)
-                    bops.fold_chunk(ci, idx[c0 : c0 + C], np.asarray(choices))
+                    with _tick("device_wait"):
+                        ch_np = np.asarray(choices)
+                    with _tick("boundary_fold"):
+                        bops.fold_chunk(ci, idx[c0 : c0 + C], ch_np)
                 if (
                     checkpoint_path
                     and checkpoint_every
@@ -982,6 +1131,7 @@ class JaxReplayEngine:
             evict_rescheduled=bops.evict_rescheduled,
             evict_stranded=bops.evict_stranded,
             evict_latency_mean=bops.evict_latency_mean,
+            telemetry=tel.result() if tel is not None else None,
         )
 
     def _wave_start_times(self, idx: np.ndarray) -> np.ndarray:
@@ -1093,8 +1243,56 @@ class JaxReplayEngine:
                 [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)]
             )
         from ..ops import tpu3 as V3
+        from ..utils.metrics import log
 
-        state = self._init_dev_state()
+        tel = (
+            TelemetryCollector(self.telemetry_cfg)
+            if self.telemetry_cfg.enabled
+            else None
+        )
+        _tick = (
+            (lambda name: tel.phases.tick(name))
+            if tel is not None
+            else (lambda name: _NULL_CTX)
+        )
+        # In-scan rejection attribution (series+): thread a [K] i32 reject
+        # counter through the scan carry via the instrumented reference
+        # chunk program — one extra fetch per REPLAY, never per pod. The
+        # default "summary" granularity takes none of these branches and
+        # runs the exact same device program as before.
+        use_rej = tel is not None and tel.cfg.want_series
+        if use_rej and self.preemption:
+            log.info(
+                "telemetry: rejection attribution is not available with "
+                "in-scan tier preemption (the instrumented program has no "
+                "tier planes) — latency/phase telemetry still collected"
+            )
+            use_rej = False
+        if use_rej and (checkpoint_path or resume):
+            log.info(
+                "telemetry: rejection attribution is disabled under "
+                "checkpoint/resume (the instrumented carry is not part of "
+                "checkpoints) — latency/phase telemetry still collected"
+            )
+            use_rej = False
+        rej_dev = None
+        if use_rej:
+            if self.engine == "v3":
+                log.info(
+                    "telemetry series: plain v3 replay uses the reference "
+                    "(v2) chunk program for in-scan rejection attribution "
+                    "— placements are bit-identical (parity-pinned), "
+                    "throughput is the v2 envelope"
+                )
+            if not hasattr(self, "_chunk_fn_rej"):
+                self._chunk_fn_rej = make_chunk_fn_rej(
+                    self.wave_width, self.spec
+                )
+            rej_dev = jnp.zeros(
+                len(spec_plugin_names(self.spec)), jnp.int32
+            )
+
+        state = self._init_dev_state(force_v2=use_rej)
         all_choices = []
         start_chunk = 0
         if resume and checkpoint_path:
@@ -1173,7 +1371,7 @@ class JaxReplayEngine:
                 jnp.asarray(idx[c0 : c0 + C])
                 for c0 in range(0, idx.shape[0], C)
             ]
-            if self.engine == "v3"
+            if self.engine == "v3" and not use_rej
             else None
         )
         t0 = time.perf_counter()
@@ -1185,6 +1383,12 @@ class JaxReplayEngine:
                 due = [e for e in pending_events if e.time <= chunk_t]
                 if due:
                     self._apply_node_events(due, saved_alloc)
+                    if tel is not None and tel.cfg.want_timeline:
+                        for ev in due:
+                            if ev.kind in ("node_down", "node_up"):
+                                tel.event(
+                                    ev.kind, float(ev.time), -1, int(ev.node)
+                                )
                     pending_events = pending_events[len(due):]
             if completions_on:
                 if self.preemption and pending_fold is not None:
@@ -1212,19 +1416,28 @@ class JaxReplayEngine:
                         due_m &= chunk_of_arr < ci - 1
                     due_p = np.nonzero(due_m)[0]
                     if due_p.size:
-                        state = self._apply_release(
-                            state, due_p, host_assign[due_p]
-                        )
+                        with _tick("host_mirror"):
+                            state = self._apply_release(
+                                state, due_p, host_assign[due_p],
+                                as_v2=use_rej,
+                            )
                         released[due_p] = True
-            if self.engine == "v3":
-                state, choices = self.chunk_fn(
-                    self.dc, state, self._slot_src, self._extra_src,
-                    idx_chunks[ci],
-                )
-            else:
-                state, choices = self.chunk_fn(
-                    self.dc, state, T.gather_slots(self.pods, idx[c0 : c0 + C])
-                )
+            with _tick("dispatch"):
+                if use_rej:
+                    state, rej_dev, choices = self._chunk_fn_rej(
+                        self.dc, state, rej_dev,
+                        T.gather_slots(self.pods, idx[c0 : c0 + C]),
+                    )
+                elif self.engine == "v3":
+                    state, choices = self.chunk_fn(
+                        self.dc, state, self._slot_src, self._extra_src,
+                        idx_chunks[ci],
+                    )
+                else:
+                    state, choices = self.chunk_fn(
+                        self.dc, state,
+                        T.gather_slots(self.pods, idx[c0 : c0 + C]),
+                    )
             all_choices.append(choices)
             if completions_on and self.preemption:
                 pending_fold = (idx[c0 : c0 + C], choices)
@@ -1248,7 +1461,8 @@ class JaxReplayEngine:
                         else np.zeros(self.pods.num_pods, bool)
                     ),
                 )
-        jax.block_until_ready(all_choices[-1] if all_choices else state)
+        with _tick("device_wait"):
+            jax.block_until_ready(all_choices[-1] if all_choices else state)
         wall = time.perf_counter() - t0
         if node_events:
             self.dc = self.dc._replace(allocatable=jnp.asarray(saved_alloc))
@@ -1296,7 +1510,17 @@ class JaxReplayEngine:
             assignments[flat_idx[valid]] = flat_choice[valid]
             placed = int((flat_choice[valid] >= 0).sum())
 
-        if self.engine == "v3":
+        if tel is not None:
+            # Plain replay: every placement is a wave placement — bound in
+            # the same chunk it arrived in, zero virtual-time latency by
+            # the chunk-granular convention (SURVEY.md §5).
+            tel.bind_zero(placed)
+            if use_rej:
+                tel.rejection_bulk(
+                    spec_plugin_names(self.spec), np.asarray(rej_dev)
+                )
+
+        if self.engine == "v3" and not use_rej:
             used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
         else:
             used = np.asarray(state.used)
@@ -1326,6 +1550,7 @@ class JaxReplayEngine:
             virtual_makespan=float(self.pods.arrival.max()) if self.pods.num_pods else 0.0,
             utilization=util,
             state=host_state,
+            telemetry=tel.result() if tel is not None else None,
         )
 
 

@@ -26,6 +26,7 @@ from ..framework.queue import SchedulingQueue
 from ..framework.registry import register_strategy
 from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import SchedState, bind, init_state, unbind
+from .telemetry import ReplayTelemetry, TelemetryCollector, TelemetryConfig
 
 # Event kinds, in tie-break order at equal timestamps: node events first,
 # then completions (free resources), then arrivals, then permit timeouts.
@@ -149,9 +150,14 @@ class ReplayResult:
     evict_rescheduled: int = 0
     evict_stranded: int = 0
     evict_latency_mean: float = 0.0
+    # Telemetry (sim.telemetry.ReplayTelemetry) — None at granularity
+    # "off". Latency histograms, rejection attribution, series, phase
+    # timers; see the telemetry module docstring for cross-engine
+    # parity semantics.
+    telemetry: Optional["ReplayTelemetry"] = None
 
     def summary(self) -> dict:
-        return {
+        out = {
             "placed": self.placed,
             "unschedulable": self.unschedulable,
             "preemptions": self.preemptions,
@@ -166,6 +172,9 @@ class ReplayResult:
             "evict_stranded": self.evict_stranded,
             "evict_latency_mean": round(self.evict_latency_mean, 4),
         }
+        if self.telemetry is not None:
+            out["telemetry"] = self.telemetry.summary()
+        return out
 
 
 class CpuReplayEngine:
@@ -175,11 +184,16 @@ class CpuReplayEngine:
         pods: EncodedPods,
         config: Optional[FrameworkConfig] = None,
         permit_timeout: float = DEFAULT_PERMIT_TIMEOUT,
+        telemetry=None,
     ):
         self.ec = ec
         self.pods = pods
         self.fw = SchedulerFramework(ec, pods, config)
         self.permit_timeout = permit_timeout
+        # Telemetry granularity (str | TelemetryConfig | None→"summary").
+        # The event engine is the exact oracle: latencies are recorded at
+        # the event clock, rejections at the failing attempt itself.
+        self.telemetry_cfg = TelemetryConfig.resolve(telemetry)
 
     # -- helpers -----------------------------------------------------------
 
@@ -250,6 +264,31 @@ class CpuReplayEngine:
         # so a gang that cannot complete doesn't spin the virtual clock.
         progress_ver = 0
         saved_alloc = ec.allocatable.copy()
+        tel = (
+            TelemetryCollector(self.telemetry_cfg)
+            if self.telemetry_cfg.enabled
+            else None
+        )
+        want_series = tel is not None and tel.cfg.want_series
+        want_timeline = tel is not None and tel.cfg.want_timeline
+        # First COMMITTED bind per pod — latency is arrival→first bind;
+        # re-binds after eviction/preemption must not re-record.
+        lat_seen: set = set()
+
+        def record_bind(m: int, t: float) -> None:
+            if tel is None:
+                return
+            tel.clear_episode(m)
+            if want_timeline:
+                tel.event("bind", t, int(m), int(st.bound[m]))
+            if m not in lat_seen:
+                lat_seen.add(m)
+                lat = t - float(pods.arrival[m])
+                if lat <= 0.0:
+                    tel.bind_zero()
+                else:
+                    tel.bind_latency(m, lat)
+
         t0 = time.perf_counter()
 
         def rollback_group(g: int, park: bool):
@@ -272,6 +311,10 @@ class CpuReplayEngine:
             failed_groups_ver[g] = progress_ver
 
         def evict(p: int, requeue: bool = True):
+            if tel is not None:
+                # A displacement starts a fresh unschedulable episode: the
+                # next fully-failed attempt re-enters the reasons counts.
+                tel.clear_episode(int(p))
             unbind(ec, pods, st, int(p))
             assignments[int(p)] = PAD
             # An evicted reserved gang member returns to the queue
@@ -287,6 +330,7 @@ class CpuReplayEngine:
                 q.push(int(p), int(pods.priority[p]))
 
         while events or len(q):
+            _pt = time.perf_counter() if tel is not None else 0.0
             if events:
                 # Advance to the next event OR the next backoff expiry,
                 # whichever is first — a 1s backoff must not stretch to the
@@ -309,13 +353,19 @@ class CpuReplayEngine:
                         ev = node_events[payload]
                         if ev.kind == "node_down":
                             ec.allocatable[ev.node] = 0.0
+                            if want_timeline:
+                                tel.event("node_down", now, -1, int(ev.node))
                             # NoExecute semantics: evict and requeue ([K8S]).
                             for m in np.nonzero(st.bound == ev.node)[0]:
+                                if want_timeline:
+                                    tel.event("evict", now, int(m), int(ev.node))
                                 evict(int(m))
                                 evictions += 1
                                 evict_time[int(m)] = now
                         elif ev.kind == "node_up":
                             ec.allocatable[ev.node] = saved_alloc[ev.node]
+                            if want_timeline:
+                                tel.event("node_up", now, -1, int(ev.node))
                         elif ev.kind == "capacity_scale":
                             ec.allocatable[ev.node] = saved_alloc[ev.node] * ev.scale
                         progressed_cluster = True
@@ -329,6 +379,16 @@ class CpuReplayEngine:
                 if progressed_cluster:
                     q.flush_unschedulable(now)
             q.flush_backoff(now)
+            if tel is not None:
+                tel.phases.add("host_events", time.perf_counter() - _pt)
+                if want_series:
+                    tel.sample(
+                        now,
+                        active=len(q),
+                        unschedulable=q.num_unschedulable,
+                        backoff=q.num_backoff,
+                    )
+                _pt = time.perf_counter()
 
             made_bind = False
             while True:
@@ -343,13 +403,19 @@ class CpuReplayEngine:
                     q.mark_unschedulable(p, int(pods.priority[p]))
                     continue
                 attempts += 1
-                res = self.fw.schedule_one(st, p, allow_preemption=g == PAD)
+                res = self.fw.schedule_one(
+                    st, p, allow_preemption=g == PAD, want_reasons=want_series
+                )
                 if res.node == PAD:
+                    if want_series and res.reasons is not None:
+                        tel.rejection(int(p), res.reasons)
                     if g != PAD and g in reserved:
                         rollback_group(g, park=True)
                     q.mark_unschedulable(p, int(pods.priority[p]), now)
                     continue
                 for v in res.victims:
+                    if want_timeline:
+                        tel.event("preempt", now, int(v), int(st.bound[v]))
                     evict(v)
                     preemptions += 1
                     progress_ver += 1
@@ -368,6 +434,7 @@ class CpuReplayEngine:
                             made_bind = True
                             progress_ver += 1
                             assignments[m] = st.bound[m]
+                            record_bind(m, now)
                             if m in evict_time:
                                 evict_rescheduled += 1
                                 evict_lat_sum += now - evict_time.pop(m)
@@ -383,6 +450,7 @@ class CpuReplayEngine:
                     made_bind = True
                     progress_ver += 1
                     assignments[p] = res.node
+                    record_bind(p, now)
                     if p in evict_time:
                         evict_rescheduled += 1
                         evict_lat_sum += now - evict_time.pop(p)
@@ -393,6 +461,8 @@ class CpuReplayEngine:
                 if made_bind and q.num_unschedulable:
                     # Binding is a cluster event for affinity/spread waiters.
                     q.flush_unschedulable(now)
+            if tel is not None:
+                tel.phases.add("host_schedule", time.perf_counter() - _pt)
             # Idle until the next event (or backoff expiry).
             nb = q.next_backoff_time()
             if not events and len(q) == 0 and nb is not None:
@@ -433,6 +503,7 @@ class CpuReplayEngine:
             evict_latency_mean=(
                 evict_lat_sum / evict_rescheduled if evict_rescheduled else 0.0
             ),
+            telemetry=tel.result() if tel is not None else None,
         )
 
 

@@ -1,0 +1,395 @@
+"""Simulation telemetry — layer L7 (SURVEY.md §5).
+
+Cross-engine observability signals collected DURING replay and reduced to
+compact summaries on ``ReplayResult``/``WhatIfResult``:
+
+* **Per-pod scheduling latency** — arrival → *first* bind in virtual time.
+  The CPU event engine records exact event-clock latencies; the device path
+  is chunk-granular (wave-placed pods bind in their arrival wave ⇒ latency
+  0, boundary-retry binds record ``t_boundary − arrival``). Both engines
+  reduce through :func:`latency_summary`, so at W=1/C=1 on
+  boundary-cadence-aligned traces the histograms bit-match.
+
+* **Filter-rejection attribution** — kube-style "0/N nodes available"
+  breakdown: for each fully-failed scheduling attempt, every node is
+  charged to the FIRST plugin (in Filter order) that rejected it. Two
+  counters are kept:
+
+  - ``reasons`` — per *unschedulable episode*: counted once when a pod
+    first goes unschedulable (and again only after an eviction starts a
+    new episode). Invariant to retry cadence, so it bit-matches across
+    engines wherever placements do.
+  - ``rejection_attempts`` — accumulated across every failed attempt.
+    Engine-cadence-dependent (the CPU queue uses exponential backoff, the
+    device path retries at chunk boundaries); bit-matches only on traces
+    whose retry instants coincide.
+
+* **Virtual-time series** (``series`` granularity) — queue/retry-buffer
+  depth sampled at event instants (CPU) or chunk boundaries (device).
+
+* **Wall-clock phase breakdown** — perf-counter timers over dispatch /
+  device step / boundary fold / host mirror, attached at every
+  granularity except ``off``.
+
+* **Timeline events** (``timeline`` granularity) — bind / preempt / evict
+  / node_down / node_up instants in virtual time, exportable as a Chrome
+  trace (Perfetto-loadable) via :func:`write_chrome_trace`.
+
+Granularity knob (``telemetry:`` YAML section, ``TelemetryConfig``):
+
+    off      — collect nothing, ``ReplayResult.telemetry`` is None.
+    summary  — latency histogram + phase timers. Never changes a device
+               program: the plain scan stays byte-identical (bench-safe).
+    series   — + rejection attribution + virtual-time series. On the
+               plain device path this swaps in an instrumented chunk
+               program carrying in-scan per-plugin reject counters.
+    timeline — + timeline events + Chrome-trace export.
+
+Checkpoint note: telemetry state is deliberately EXCLUDED from boundary
+checkpoint blobs — blobs stay bit-identical with telemetry on or off.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Fixed exponential bucket edges (virtual seconds), kube-histogram style.
+# The overflow bucket is implicit (label "+Inf").
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+)
+
+_LEVELS = ("off", "summary", "series", "timeline")
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    granularity: str = "summary"
+
+    def __post_init__(self):
+        if self.granularity not in _LEVELS:
+            raise ValueError(
+                f"telemetry granularity {self.granularity!r} must be one of "
+                f"{', '.join(_LEVELS)}"
+            )
+
+    @classmethod
+    def resolve(cls, v) -> "TelemetryConfig":
+        """None → default (summary); str → validated; config → itself."""
+        if v is None:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        return cls(granularity=str(v))
+
+    @property
+    def enabled(self) -> bool:
+        return self.granularity != "off"
+
+    @property
+    def want_series(self) -> bool:
+        return _LEVELS.index(self.granularity) >= 2
+
+    @property
+    def want_timeline(self) -> bool:
+        return _LEVELS.index(self.granularity) >= 3
+
+
+def latency_summary(
+    zero_count: int, values: Sequence[float]
+) -> Optional[dict]:
+    """Reduce first-bind latencies (``zero_count`` exact zeros + explicit
+    ``values``) to count/mean/p50/p90/p99 plus fixed-bucket cumulative
+    counts. Shared by BOTH engines — quantiles use ``np.percentile``
+    with ``method='lower'`` (an exact data value), so engines that record
+    the same latency multiset produce bit-identical summaries."""
+    vals = np.asarray(list(values), dtype=np.float64)
+    n = int(zero_count) + vals.size
+    if n == 0:
+        return None
+    arr = np.concatenate([np.zeros(int(zero_count), dtype=np.float64), vals])
+    arr.sort()
+    buckets: Dict[str, int] = {}
+    # Cumulative "le" buckets (kube-style); searchsorted on the sorted array.
+    idx = np.searchsorted(arr, np.asarray(LATENCY_BUCKETS), side="right")
+    for edge, c in zip(LATENCY_BUCKETS, idx):
+        buckets[f"le_{edge:g}"] = int(c)
+    buckets["le_inf"] = n
+    p50, p90, p99 = (
+        float(np.percentile(arr, q, method="lower")) for q in (50, 90, 99)
+    )
+    return {
+        "count": n,
+        "mean": float(arr.mean()),
+        "max": float(arr[-1]),
+        "p50": p50,
+        "p90": p90,
+        "p99": p99,
+        "buckets": buckets,
+    }
+
+
+class PhaseTimers:
+    """Accumulating wall-clock phase breakdown. ``tick(phase)`` returns a
+    context manager; overhead is two ``perf_counter`` calls per use, so it
+    is safe at chunk cadence (never per pod)."""
+
+    def __init__(self):
+        self.acc: Dict[str, float] = {}
+
+    class _Tick:
+        __slots__ = ("timers", "phase", "t0")
+
+        def __init__(self, timers: "PhaseTimers", phase: str):
+            self.timers = timers
+            self.phase = phase
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.timers.add(self.phase, time.perf_counter() - self.t0)
+            return False
+
+    def tick(self, phase: str) -> "_Tick":
+        return PhaseTimers._Tick(self, phase)
+
+    def add(self, phase: str, dt: float) -> None:
+        self.acc[phase] = self.acc.get(phase, 0.0) + dt
+
+    def summary(self) -> Dict[str, float]:
+        return {k: round(v, 6) for k, v in sorted(self.acc.items())}
+
+
+@dataclass
+class ReplayTelemetry:
+    """Telemetry attached to ``ReplayResult.telemetry`` (None at ``off``)."""
+
+    granularity: str
+    # Latency histogram (see latency_summary); None when nothing bound.
+    latency: Optional[dict] = None
+    # Per-episode first-reject counts by plugin name ("unschedulable
+    # reasons" — each sums to num_nodes per episode).
+    reasons: Optional[Dict[str, int]] = None
+    # Per-attempt first-reject counts (cadence-dependent; >= reasons).
+    rejection_attempts: Optional[Dict[str, int]] = None
+    # Virtual-time series: {"t": [...], "<depth name>": [...], ...}.
+    series: Optional[Dict[str, List[float]]] = None
+    # Wall-clock phase accumulators (seconds).
+    phases: Dict[str, float] = field(default_factory=dict)
+    # Raw first-bind latencies for pods that did NOT bind in their arrival
+    # instant/wave (pod → virtual seconds) + count of exact-zero binds.
+    # Kept for the timeline exporter and tests; not in summary().
+    bind_latency: Dict[int, float] = field(default_factory=dict)
+    zero_latency_binds: int = 0
+    # Timeline events: (kind, t, pod, node) with pod/node = -1 when n/a.
+    events: List[Tuple[str, float, int, int]] = field(default_factory=list)
+
+    def summary(self) -> dict:
+        out: dict = {"granularity": self.granularity, "phases": self.phases}
+        if self.latency is not None:
+            out["latency"] = self.latency
+        if self.reasons is not None:
+            out["reasons"] = dict(self.reasons)
+            out["rejection_attempts"] = dict(self.rejection_attempts or {})
+        if self.series is not None:
+            out["series_samples"] = len(self.series.get("t", ()))
+        if self.events:
+            out["timeline_events"] = len(self.events)
+        return out
+
+
+class TelemetryCollector:
+    """Mutable per-replay accumulator. Engines call the record hooks (all
+    cheap, most gated behind granularity properties); :meth:`result`
+    freezes into a :class:`ReplayTelemetry`.
+
+    Episode semantics for rejection attribution: a pod is *attributed*
+    after its first fully-failed attempt is charged to ``reasons``;
+    further failed attempts only grow ``rejection_attempts`` until a bind
+    or an eviction (``clear_episode``) re-arms it."""
+
+    def __init__(self, config: Optional[TelemetryConfig] = None):
+        self.cfg = TelemetryConfig.resolve(config)
+        self.phases = PhaseTimers()
+        self._lat: Dict[int, float] = {}
+        self._zero = 0
+        self._reasons: Dict[str, int] = {}
+        self._attempts: Dict[str, int] = {}
+        self._attributed: set = set()
+        self._series: Dict[str, List[float]] = {}
+        self._events: List[Tuple[str, float, int, int]] = []
+
+    # -- latency ----------------------------------------------------------
+
+    def bind_zero(self, n: int = 1) -> None:
+        """n pods bound at their arrival instant/wave (latency exactly 0)."""
+        self._zero += int(n)
+
+    def bind_latency(self, pod: int, lat: float) -> None:
+        """First bind of ``pod`` at ``lat`` virtual seconds after arrival.
+        Caller guarantees first-bind (re-binds after eviction/preemption
+        must not re-record)."""
+        self._lat[int(pod)] = float(lat)
+
+    # -- rejection attribution -------------------------------------------
+
+    def rejection(self, pod: int, counts: Dict[str, int]) -> None:
+        """One fully-failed scheduling attempt for ``pod`` with first-reject
+        ``counts`` by plugin name."""
+        for k, v in counts.items():
+            self._attempts[k] = self._attempts.get(k, 0) + int(v)
+        if pod not in self._attributed:
+            self._attributed.add(pod)
+            for k, v in counts.items():
+                self._reasons[k] = self._reasons.get(k, 0) + int(v)
+
+    def rejection_bulk(self, names: Sequence[str], vec) -> None:
+        """In-scan device counters: [K] totals in plugin order. On the plain
+        path every failure is both terminal and a fresh episode, so the
+        vector feeds both counters."""
+        for k, v in zip(names, np.asarray(vec).tolist()):
+            if v:
+                self._attempts[k] = self._attempts.get(k, 0) + int(v)
+                self._reasons[k] = self._reasons.get(k, 0) + int(v)
+
+    def clear_episode(self, pod: int) -> None:
+        """A bind or an eviction ends the pod's unschedulable episode."""
+        self._attributed.discard(int(pod))
+
+    def is_attributed(self, pod: int) -> bool:
+        return int(pod) in self._attributed
+
+    def mark_attributed(self, pod: int) -> None:
+        """Pod already charged to ``reasons`` elsewhere (e.g. the in-scan
+        failure that routed it into the retry buffer)."""
+        self._attributed.add(int(pod))
+
+    # -- series / timeline ------------------------------------------------
+
+    def sample(self, t: float, **depths: float) -> None:
+        self._series.setdefault("t", []).append(float(t))
+        for k, v in depths.items():
+            self._series.setdefault(k, []).append(float(v))
+
+    def event(self, kind: str, t: float, pod: int = -1, node: int = -1) -> None:
+        self._events.append((kind, float(t), int(pod), int(node)))
+
+    # -- finalize ---------------------------------------------------------
+
+    def result(self) -> Optional[ReplayTelemetry]:
+        if not self.cfg.enabled:
+            return None
+        tel = ReplayTelemetry(
+            granularity=self.cfg.granularity,
+            latency=latency_summary(self._zero, list(self._lat.values())),
+            phases=self.phases.summary(),
+            bind_latency=dict(self._lat),
+            zero_latency_binds=self._zero,
+        )
+        if self.cfg.want_series:
+            # Zero entries are dropped so engine comparisons see the same
+            # dict regardless of which plugins happened to run (the CPU
+            # Filter chain short-circuits; the device one does not).
+            tel.reasons = {k: v for k, v in self._reasons.items() if v}
+            tel.rejection_attempts = {
+                k: v for k, v in self._attempts.items() if v
+            }
+            tel.series = {k: list(v) for k, v in self._series.items()}
+        if self.cfg.want_timeline:
+            tel.events = list(self._events)
+        return tel
+
+
+def first_reject_counts_host(
+    plugins, ctx, st, p: int, num_nodes: int
+) -> Tuple[np.ndarray, Dict[str, int]]:
+    """Host-side first-reject attribution: run the Filter chain charging
+    each node to the first plugin that rejects it. Returns (final mask,
+    counts). Counting mirrors ``SchedulerFramework.feasible_mask``'s
+    short-circuit exactly — once the running mask is empty every later
+    plugin rejects 0 additional nodes, so stopping early is lossless."""
+    mask = np.ones(num_nodes, dtype=bool)
+    counts: Dict[str, int] = {}
+    for pl in plugins:
+        counts[pl.name] = 0
+        m = pl.filter(ctx, st, p)
+        if m is not None:
+            counts[pl.name] = int((mask & ~m).sum())
+            mask &= m
+    return mask, counts
+
+
+# -- Chrome-trace (Perfetto) export --------------------------------------
+
+
+def write_chrome_trace(
+    path: str,
+    res,
+    arrival: Optional[np.ndarray] = None,
+    duration: Optional[np.ndarray] = None,
+) -> int:
+    """Export the SIMULATED cluster timeline as a Chrome trace JSON
+    (load in Perfetto / chrome://tracing). Virtual seconds map to trace
+    microseconds. Rows (tids) are nodes under pid 0 ("cluster"); chaos
+    node_down→node_up windows render as spans under pid 1 ("chaos").
+
+    Pod spans are drawn from each pod's FIRST bind (arrival + recorded
+    latency) to its completion (or the makespan); disruptions (preempt /
+    evict / boundary re-binds) appear as instant events on the node row.
+    Returns the number of trace events written."""
+    tel = getattr(res, "telemetry", None)
+    assignments = np.asarray(res.assignments)
+    makespan = float(getattr(res, "virtual_makespan", 0.0))
+    ev: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "cluster"}},
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "chaos"}},
+    ]
+    used_nodes = sorted({int(n) for n in assignments if n >= 0})
+    for n in used_nodes:
+        ev.append({"name": "thread_name", "ph": "M", "pid": 0, "tid": n,
+                   "args": {"name": f"node{n}"}})
+    lat = tel.bind_latency if tel is not None else {}
+    if arrival is not None:
+        placed = np.nonzero(assignments >= 0)[0]
+        for p in placed.tolist():
+            start = float(arrival[p]) + float(lat.get(p, 0.0))
+            end = makespan
+            if duration is not None and np.isfinite(duration[p]):
+                end = min(end, start + float(duration[p]))
+            ev.append({
+                "name": f"pod{p}", "ph": "X", "pid": 0,
+                "tid": int(assignments[p]),
+                "ts": start * 1e6, "dur": max(end - start, 0.0) * 1e6,
+            })
+    down_at: Dict[int, float] = {}
+    for kind, t, pod, node in (tel.events if tel is not None else ()):
+        if kind == "node_down":
+            down_at[node] = t
+        elif kind == "node_up":
+            t0 = down_at.pop(node, t)
+            ev.append({"name": f"node{node} down", "ph": "X", "pid": 1,
+                       "tid": node, "ts": t0 * 1e6,
+                       "dur": max(t - t0, 0.0) * 1e6})
+        else:
+            ev.append({
+                "name": kind, "ph": "i", "s": "t", "pid": 0,
+                "tid": node if node >= 0 else 0, "ts": t * 1e6,
+                "args": ({"pod": pod} if pod >= 0 else {}),
+            })
+    for node, t0 in sorted(down_at.items()):
+        # Unrecovered failure: span runs to the makespan.
+        ev.append({"name": f"node{node} down", "ph": "X", "pid": 1,
+                   "tid": node, "ts": t0 * 1e6,
+                   "dur": max(makespan - t0, 0.0) * 1e6})
+    with open(path, "w") as f:
+        json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
+    return len(ev)

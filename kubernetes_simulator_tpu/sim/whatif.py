@@ -459,6 +459,16 @@ class WhatIfResult:
     evict_rescheduled: Optional[np.ndarray] = None  # [S] i32
     evict_stranded: Optional[np.ndarray] = None  # [S] i32
     evict_latency_mean: Optional[np.ndarray] = None  # [S] f64
+    # Per-scenario first-bind scheduling-latency quantiles (telemetry
+    # layer, kube batches only — the host mirrors are the only per-
+    # scenario bind-time carrier; plain/batch paths report None, their
+    # placements are all wave placements with latency 0 by construction).
+    # NaN where a scenario bound nothing.
+    latency_p50: Optional[np.ndarray] = None  # [S] f64
+    latency_p90: Optional[np.ndarray] = None  # [S] f64
+    latency_p99: Optional[np.ndarray] = None  # [S] f64
+    # Per-scenario ReplayTelemetry (kube batches at series+; else None).
+    scenario_telemetry: Optional[list] = None
 
 
 class WhatIfEngine:
@@ -480,6 +490,7 @@ class WhatIfEngine:
         completions: Optional[bool] = None,
         retry_buffer: int = 0,
         granularity_guard: bool = True,
+        telemetry=None,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -524,7 +535,9 @@ class WhatIfEngine:
         completions path without DynTables; 0 = off (the r01–r03
         semantics)."""
         from .greedy import normalize_preemption
+        from .telemetry import TelemetryConfig
 
+        self.telemetry_cfg = TelemetryConfig.resolve(telemetry)
         pmode = normalize_preemption(preemption)
         # "kube" (round 5): the EXACT minimal-victims PostFilter runs in
         # per-scenario HOST boundary passes (sim.boundary) against the
@@ -2063,14 +2076,25 @@ class WhatIfEngine:
                 self._config if self._config is not None else _FC(),
                 enable_preemption=True,
             )
+            from .telemetry import TelemetryCollector
+
             wb = WaveBatch(idx=idx, wave_width=self.wave_width)
+            # One collector per scenario: the host mirrors are the only
+            # carrier of per-scenario bind times / rejection reasons.
+            ktel = [
+                TelemetryCollector(self.telemetry_cfg)
+                if self.telemetry_cfg.enabled
+                else None
+                for _ in range(self.S)
+            ]
             kbops = [
                 BoundaryOps(
                     ec_s, self.pods, SchedulerFramework(ec_s, self.pods, cfgk),
                     wb, self.wave_width, C,
                     retry_buffer=self.retry_buffer, kube=True, lazy=True,
+                    telemetry=ktel[si],
                 )
-                for ec_s in self.sset.host_clusters(self.ec)
+                for si, ec_s in enumerate(self.sset.host_clusters(self.ec))
             ]
             from .jax_runtime import wave_start_times
 
@@ -2259,6 +2283,14 @@ class WhatIfEngine:
                             ev = tl[cur]
                             cur += 1
                             dirty_alloc = True
+                            if (
+                                ktel[s] is not None
+                                and ktel[s].cfg.want_timeline
+                                and ev.kind in ("node_down", "node_up")
+                            ):
+                                ktel[s].event(
+                                    ev.kind, float(ev.time), -1, int(ev.node)
+                                )
                             if ev.kind == "node_down":
                                 hs["alloc"][s, ev.node] = 0.0
                                 cp, cn = kbops[s].evict_node(
@@ -2461,6 +2493,7 @@ class WhatIfEngine:
         to_schedule = int((idx >= 0).sum())
         kube_preempt = kube_dropped = None
         kube_evict = kube_resched = kube_stranded = kube_lat = None
+        sc_lat_p50 = sc_lat_p90 = sc_lat_p99 = sc_telemetry = None
         if kbops is not None:
             host_k = np.stack([b.assignments for b in kbops])
             assignments = host_k if self.collect_assignments else None
@@ -2484,6 +2517,20 @@ class WhatIfEngine:
             kube_lat = np.asarray(
                 [b.evict_latency_mean for b in kbops], np.float64
             )
+            if self.telemetry_cfg.enabled:
+                stel = [t.result() for t in ktel]
+                lat_q = np.full((3, self.S), np.nan, np.float64)
+                for s, t in enumerate(stel):
+                    if t is not None and t.latency is not None:
+                        lat_q[:, s] = (
+                            t.latency["p50"],
+                            t.latency["p90"],
+                            t.latency["p99"],
+                        )
+                sc_lat_p50, sc_lat_p90, sc_lat_p99 = lat_q
+                sc_telemetry = (
+                    stel if self.telemetry_cfg.want_series else None
+                )
         elif comp_on and self.preemption:
             # The eager eviction-aware folds ARE the walk (see the chunk
             # loop); host_assign is the result carrier. Counting device
@@ -2604,6 +2651,10 @@ class WhatIfEngine:
             evict_rescheduled=kube_resched,
             evict_stranded=kube_stranded,
             evict_latency_mean=kube_lat,
+            latency_p50=sc_lat_p50,
+            latency_p90=sc_lat_p90,
+            latency_p99=sc_lat_p99,
+            scenario_telemetry=sc_telemetry,
         )
 
 

@@ -111,6 +111,21 @@ class ChaosSpec:
     max_events: Optional[int] = None
 
 
+@dataclass
+class TelemetrySpec:
+    """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
+
+    ``granularity`` is the collection knob (sim.telemetry docstring):
+    off / summary (default; latency histogram + phase timers, zero
+    device-program change) / series (+ rejection attribution and
+    virtual-time depth series) / timeline (+ bind/preempt/evict/chaos
+    events). ``timeline_out`` writes the simulated cluster timeline as a
+    Chrome trace JSON (load in Perfetto) and implies ``timeline``."""
+
+    granularity: str = "summary"
+    timeline_out: Optional[str] = None
+
+
 def _coerce_completions(v: object) -> Optional[bool]:
     """None stays None (default-on with warn); bool/int coerce to bool;
     everything else is a config error, not a truthy surprise."""
@@ -132,6 +147,7 @@ class SimConfig:
     framework: FrameworkConfig = field(default_factory=FrameworkConfig)
     whatif: WhatIfSpec = field(default_factory=WhatIfSpec)
     chaos: Optional[ChaosSpec] = None
+    telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     output: Optional[str] = None
     wave_width: int = 8
     chunk_waves: int = 1024
@@ -225,6 +241,21 @@ class SimConfig:
                     else None
                 ),
             )
+        tl = d.get("telemetry")
+        if tl is not None:
+            cfg.telemetry = TelemetrySpec(
+                granularity=str(tl.get("granularity", "summary")),
+                timeline_out=tl.get("timelineOut"),
+            )
+            if (
+                cfg.telemetry.timeline_out
+                and cfg.telemetry.granularity != "off"
+            ):
+                # A timeline sink needs timeline events collected.
+                cfg.telemetry = TelemetrySpec(
+                    granularity="timeline",
+                    timeline_out=cfg.telemetry.timeline_out,
+                )
         cfg.output = d.get("output")
         ww = d.get("waveWidth", 8)
         cfg.wave_width = ww if ww == "auto" else int(ww)

@@ -6,6 +6,7 @@ metric is pod-placements/sec ([BASELINE])."""
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import sys
@@ -19,14 +20,36 @@ if not log.handlers:
     log.addHandler(_h)
     log.setLevel(logging.INFO)
 
+# JSONL row schema version. Bump on any breaking change to the row shape;
+# scripts/check_metrics_schema.py validates emitted files against it.
+#   v1 — rows carried only "ts" + payload (implicit, unversioned).
+#   v2 — every row stamped with "schema" plus writer context
+#        (seed / engine / config_hash from the CLI).
+SCHEMA_VERSION = 2
+
+
+def config_hash(cfg_dict: dict) -> str:
+    """Short stable hash of a config mapping (canonical-JSON sha256).
+    Stamped on every JSONL row so runs are attributable to the exact
+    config that produced them."""
+    blob = json.dumps(cfg_dict, sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
 
 class JsonlWriter:
-    def __init__(self, path: Optional[str] = None):
+    """Append-mode JSONL sink (stdout when ``path`` is None). Usable as a
+    context manager — the CLI wraps whole commands in ``with`` so the file
+    is closed (rows flushed) even when the run raises. Every row is
+    stamped with ``ts``, ``schema`` and the writer's ``context`` (seed /
+    engine / config hash); explicit row keys win over context keys."""
+
+    def __init__(self, path: Optional[str] = None, context: Optional[dict] = None):
         self.path = path
+        self.context = dict(context or {})
         self._f: Optional[IO] = open(path, "a") if path else None
 
     def write(self, row: dict) -> None:
-        row = {"ts": time.time(), **row}
+        row = {"ts": time.time(), "schema": SCHEMA_VERSION, **self.context, **row}
         line = json.dumps(row)
         if self._f:
             self._f.write(line + "\n")
@@ -37,6 +60,14 @@ class JsonlWriter:
     def close(self) -> None:
         if self._f:
             self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "JsonlWriter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 def replay_row(kind: str, res, extra: Optional[dict] = None) -> dict:
@@ -61,6 +92,7 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
     pre = getattr(res, "preemptions", None)
     drop = getattr(res, "retry_dropped", None)
     evi = getattr(res, "evictions", None)
+    lat50 = getattr(res, "latency_p50", None)
     for s in range(res.placed.shape[0]):
         row = {
             "kind": "whatif-scenario",
@@ -86,6 +118,18 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
             row["evict_latency_mean"] = round(
                 float(res.evict_latency_mean[s]), 4
             )
+        if lat50 is not None:
+            # Telemetry layer: per-scenario first-bind latency quantiles
+            # (virtual seconds); None when the scenario bound nothing.
+            import math
+
+            for key, arr in (
+                ("latency_p50", lat50),
+                ("latency_p90", res.latency_p90),
+                ("latency_p99", res.latency_p99),
+            ):
+                v = float(arr[s])
+                row[key] = None if math.isnan(v) else round(v, 6)
         yield row
 
 

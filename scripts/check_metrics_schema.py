@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Validate emitted JSONL metrics files against the versioned row schema
+(utils.metrics.SCHEMA_VERSION).
+
+    python scripts/check_metrics_schema.py results.jsonl [more.jsonl ...]
+
+Exit 0 when every row validates, 1 otherwise (one line per offending row).
+Wired as a tier-1 test (tests/test_metrics_schema.py) over a fresh CLI
+run, so schema drift between the writers and this contract fails CI.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+_NUM = (int, float)
+
+# Base stamp every v2 row carries (JsonlWriter.write + CLI context).
+_BASE_V2 = {
+    "ts": _NUM,
+    "schema": int,
+    "seed": int,
+    "engine": str,
+    "config_hash": str,
+    "kind": str,
+}
+
+# kind → required payload fields. "replay-*" kinds share one shape.
+_REPLAY_REQUIRED = {
+    "placed": int,
+    "unschedulable": int,
+    "wall_clock_s": _NUM,
+    "placements_per_sec": _NUM,
+}
+_WHATIF_AGG_REQUIRED = {
+    "scenarios": int,
+    "total_placed": int,
+    "wall_clock_s": _NUM,
+    "placements_per_sec": _NUM,
+    "completions_on": bool,
+}
+_WHATIF_SCEN_REQUIRED = {
+    "scenario": int,
+    "placed": int,
+    "unschedulable": int,
+}
+
+# Optional typed fields (present ⇒ must have this type; None allowed
+# where the writer emits explicit nulls).
+_OPTIONAL = {
+    "preemptions": _NUM,
+    "attempts": _NUM,
+    "retry_dropped": _NUM,
+    "evictions": _NUM,
+    "evict_rescheduled": _NUM,
+    "evict_stranded": _NUM,
+    "evict_latency_mean": _NUM,
+    "virtual_makespan": _NUM,
+    "utilization": dict,
+    "utilization_cpu": (*_NUM, type(None)),
+    "latency_p50": (*_NUM, type(None)),
+    "latency_p90": (*_NUM, type(None)),
+    "latency_p99": (*_NUM, type(None)),
+    "telemetry": dict,
+    "config": str,
+    "mesh": bool,
+}
+
+_TEL_GRANULARITIES = ("summary", "series", "timeline")
+
+
+def _check_telemetry(tel: dict) -> List[str]:
+    errs = []
+    if tel.get("granularity") not in _TEL_GRANULARITIES:
+        errs.append(
+            f"telemetry.granularity: expected one of "
+            f"{_TEL_GRANULARITIES}, got {tel.get('granularity')!r}"
+        )
+    if not isinstance(tel.get("phases"), dict):
+        errs.append("telemetry.phases: expected an object")
+    lat = tel.get("latency")
+    if lat is not None:
+        for k in ("count", "mean", "max", "p50", "p90", "p99", "buckets"):
+            if k not in lat:
+                errs.append(f"telemetry.latency.{k}: missing")
+        b = lat.get("buckets")
+        if isinstance(b, dict) and "le_inf" not in b:
+            errs.append("telemetry.latency.buckets.le_inf: missing")
+    for k in ("reasons", "rejection_attempts"):
+        v = tel.get(k)
+        if v is not None and not isinstance(v, dict):
+            errs.append(f"telemetry.{k}: expected an object")
+    return errs
+
+
+def validate_row(row: dict) -> List[str]:
+    """Errors for one parsed row ([] = valid)."""
+    errs = []
+    schema = row.get("schema")
+    if schema is None:
+        # v1 (pre-versioning) rows: "ts" + payload only; accepted as-is
+        # so old result files keep validating.
+        return [] if isinstance(row.get("ts"), _NUM) else ["ts: missing"]
+    if schema != 2:
+        return [f"schema: unknown version {schema!r}"]
+    for k, t in _BASE_V2.items():
+        v = row.get(k)
+        if v is None or (not isinstance(v, t)) or isinstance(v, bool):
+            errs.append(f"{k}: expected {t}, got {v!r}")
+    kind = row.get("kind")
+    if isinstance(kind, str):
+        if kind.startswith("replay-"):
+            required = _REPLAY_REQUIRED
+        elif kind == "whatif-aggregate":
+            required = _WHATIF_AGG_REQUIRED
+        elif kind == "whatif-scenario":
+            required = _WHATIF_SCEN_REQUIRED
+        else:
+            return errs + [f"kind: unknown {kind!r}"]
+        for k, t in required.items():
+            v = row.get(k)
+            if not isinstance(v, t) or (
+                isinstance(v, bool) and t is not bool
+            ):
+                errs.append(f"{k}: expected {t}, got {v!r}")
+    for k, t in _OPTIONAL.items():
+        if k in row and not isinstance(row[k], t):
+            errs.append(f"{k}: expected {t}, got {row[k]!r}")
+    if isinstance(row.get("telemetry"), dict):
+        errs.extend(_check_telemetry(row["telemetry"]))
+    return errs
+
+
+def validate_file(path: str) -> List[str]:
+    """All errors in a JSONL file, prefixed ``path:lineno:`` ([] = valid)."""
+    errs = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"{path}:{i}: invalid JSON: {e}")
+                continue
+            if not isinstance(row, dict):
+                errs.append(f"{path}:{i}: row is not an object")
+                continue
+            for e in validate_row(row):
+                errs.append(f"{path}:{i}: {e}")
+    return errs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__.strip())
+        return 2
+    all_errs = []
+    for path in argv:
+        all_errs.extend(validate_file(path))
+    for e in all_errs:
+        print(e)
+    if not all_errs:
+        print(f"ok: {len(argv)} file(s) validate against schema v2")
+    return 1 if all_errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

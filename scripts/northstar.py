@@ -118,6 +118,14 @@ def run_single(ec, ep, tasks, wave, chunk, mode, retry, events=None):
         f"wall={wall:.1f}s placed={res.placed} plane_folds={folds}{ev}",
         flush=True,
     )
+    if res.telemetry is not None and res.telemetry.phases:
+        # Default telemetry ('summary') times the pipeline phases at chunk
+        # cadence — where the wall actually goes (dispatch vs device wait
+        # vs boundary folds vs host mirror).
+        ph = " ".join(
+            f"{k}={v:.2f}s" for k, v in res.telemetry.phases.items()
+        )
+        print(f"[{tag}] phases: {ph}", flush=True)
     return wall
 
 

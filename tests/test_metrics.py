@@ -1,0 +1,122 @@
+"""utils.metrics coverage (satellite of the telemetry round): JSONL row
+builders, the context-stamping JsonlWriter, and the BASELINE.md table
+emitter."""
+
+import json
+
+import numpy as np
+
+from kubernetes_simulator_tpu.sim.whatif import WhatIfResult
+from kubernetes_simulator_tpu.utils.metrics import (
+    SCHEMA_VERSION,
+    JsonlWriter,
+    baseline_table,
+    config_hash,
+    replay_row,
+    whatif_rows,
+)
+
+
+def _plain_result(**kw):
+    return WhatIfResult(
+        placed=np.array([10, 9], np.int32),
+        unschedulable=np.array([0, 1], np.int32),
+        total_placed=19,
+        wall_clock_s=0.5,
+        placements_per_sec=38.0,
+        utilization_cpu=np.array([0.25, 0.3]),
+        **kw,
+    )
+
+
+def test_whatif_rows_plain_batch():
+    rows = list(whatif_rows(_plain_result(), {"config": "c.yaml"}))
+    agg, s0, s1 = rows
+    assert agg["kind"] == "whatif-aggregate"
+    assert agg["scenarios"] == 2 and agg["total_placed"] == 19
+    assert agg["engine"] == "v3" and agg["config"] == "c.yaml"
+    assert s0["kind"] == "whatif-scenario" and s0["scenario"] == 0
+    assert s1["placed"] == 9 and s1["unschedulable"] == 1
+    # No kube/chaos/telemetry signals ⇒ their fields stay absent.
+    for k in ("preemptions", "evictions", "latency_p50"):
+        assert k not in s0
+
+
+def test_whatif_rows_kube_chaos_telemetry_fields():
+    res = _plain_result(
+        preemptions=np.array([2, 0], np.int32),
+        retry_dropped=np.array([0, 1], np.int32),
+        evictions=np.array([3, 0], np.int32),
+        evict_rescheduled=np.array([2, 0], np.int32),
+        evict_stranded=np.array([1, 0], np.int32),
+        evict_latency_mean=np.array([1.25, 0.0]),
+        latency_p50=np.array([0.0, np.nan]),
+        latency_p90=np.array([2.0, np.nan]),
+        latency_p99=np.array([4.0, np.nan]),
+    )
+    _, s0, s1 = list(whatif_rows(res))
+    assert s0["preemptions"] == 2 and s0["retry_dropped"] == 0
+    assert s0["evictions"] == 3 and s0["evict_latency_mean"] == 1.25
+    assert s0["latency_p50"] == 0.0 and s0["latency_p99"] == 4.0
+    # NaN (scenario bound nothing) serializes as null, not NaN.
+    assert s1["latency_p50"] is None
+    json.dumps(s1)  # must be valid JSON
+
+
+def test_replay_row_carries_summary_and_extra():
+    class R:
+        def summary(self):
+            return {"placed": 5, "unschedulable": 0}
+
+    row = replay_row("replay-cpu", R(), {"config": "x.yaml"})
+    assert row == {"kind": "replay-cpu", "placed": 5, "unschedulable": 0,
+                   "config": "x.yaml"}
+    bare = replay_row("replay-cpu", object())
+    assert bare == {"kind": "replay-cpu"}
+
+
+def test_jsonl_writer_stamps_and_context(tmp_path):
+    path = str(tmp_path / "rows.jsonl")
+    ctx = {"seed": 7, "engine": "cpu", "config_hash": "abc123"}
+    with JsonlWriter(path, context=ctx) as out:
+        out.write({"kind": "replay-cpu", "placed": 1})
+        # Explicit row keys beat context keys (whatif aggregate rows
+        # carry the real engine).
+        out.write({"kind": "whatif-aggregate", "engine": "v3"})
+    rows = [json.loads(l) for l in open(path)]
+    assert rows[0]["schema"] == SCHEMA_VERSION
+    assert rows[0]["seed"] == 7 and rows[0]["engine"] == "cpu"
+    assert rows[0]["ts"] > 0
+    assert rows[1]["engine"] == "v3"
+
+
+def test_jsonl_writer_closes_on_error(tmp_path):
+    path = str(tmp_path / "rows.jsonl")
+    try:
+        with JsonlWriter(path) as out:
+            out.write({"kind": "replay-cpu"})
+            raise RuntimeError("replay blew up")
+    except RuntimeError:
+        pass
+    assert out._f is None  # closed despite the error
+    assert len(open(path).readlines()) == 1  # the row was flushed
+    out.close()  # idempotent
+
+
+def test_config_hash_stable_and_order_insensitive():
+    a = config_hash({"x": 1, "y": {"z": 2}})
+    b = config_hash({"y": {"z": 2}, "x": 1})
+    assert a == b and len(a) == 12
+    assert config_hash({"x": 2}) != a
+
+
+def test_baseline_table():
+    md = baseline_table([
+        {"metric": "placements/sec", "value": "1.62M", "hardware": "v4-8",
+         "source": "BENCH_r05"},
+        {"kind": "whatif-aggregate", "placements_per_sec": 123.0},
+    ])
+    lines = md.splitlines()
+    assert lines[0].startswith("| Metric ")
+    assert "| placements/sec | 1.62M | v4-8 | BENCH_r05 |" in md
+    assert "| whatif-aggregate | 123.0 | - | this run |" in md

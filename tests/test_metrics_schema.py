@@ -1,0 +1,77 @@
+"""Tier-1 schema gate: a fresh CLI run's JSONL must validate against
+scripts/check_metrics_schema.py, and the checker must actually reject
+malformed rows (no rubber stamp)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+sys.path.insert(0, os.path.abspath(_SCRIPTS))
+
+from check_metrics_schema import main, validate_file, validate_row  # noqa: E402
+
+from kubernetes_simulator_tpu.cli import main as cli_main  # noqa: E402
+
+
+@pytest.fixture()
+def run_jsonl(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    out = tmp_path / "out.jsonl"
+    cfg.write_text(
+        "strategy: cpu\n"
+        "cluster:\n  synthetic: {nodes: 4, seed: 0}\n"
+        "workload:\n  synthetic: {pods: 40, seed: 1}\n"
+        "telemetry:\n  granularity: series\n"
+        f"output: {out}\n"
+    )
+    assert cli_main(["run", str(cfg)]) == 0
+    return str(out)
+
+
+def test_cli_run_emits_valid_schema(run_jsonl):
+    assert validate_file(run_jsonl) == []
+    rows = [json.loads(l) for l in open(run_jsonl)]
+    assert rows and rows[0]["schema"] == 2
+    assert {"seed", "engine", "config_hash", "telemetry"} <= rows[0].keys()
+    assert main([run_jsonl]) == 0
+
+
+def test_checker_rejects_malformed_rows(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps({"ts": 1.0, "schema": 2, "kind": "replay-cpu"}) + "\n"
+        + json.dumps({"ts": 1.0, "schema": 99, "kind": "replay-cpu"}) + "\n"
+        + "not json\n"
+    )
+    errs = validate_file(str(bad))
+    assert any("seed" in e for e in errs)
+    assert any("unknown version" in e for e in errs)
+    assert any("invalid JSON" in e for e in errs)
+    assert main([str(bad)]) == 1
+
+
+def test_v1_rows_still_accepted():
+    # Pre-versioning rows (no "schema" field) keep validating so old
+    # result files don't rot.
+    assert validate_row({"ts": 1.0, "kind": "replay-cpu", "placed": 3}) == []
+    assert validate_row({"kind": "replay-cpu"}) == ["ts: missing"]
+
+
+def test_whatif_rows_validate(tmp_path):
+    cfg = tmp_path / "w.yaml"
+    out = tmp_path / "w.jsonl"
+    cfg.write_text(
+        "strategy: jax\n"
+        "cluster:\n  synthetic: {nodes: 4, seed: 0}\n"
+        "workload:\n  synthetic: {pods: 40, seed: 1}\n"
+        "whatIf:\n  scenarios: 2\n"
+        "chunkWaves: 4\n"
+        f"output: {out}\n"
+    )
+    assert cli_main(["what-if", str(cfg)]) == 0
+    assert validate_file(str(out)) == []
+    kinds = [json.loads(l)["kind"] for l in open(out)]
+    assert kinds == ["whatif-aggregate", "whatif-scenario", "whatif-scenario"]

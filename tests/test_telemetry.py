@@ -1,0 +1,305 @@
+"""Telemetry layer (SURVEY.md §5): per-pod latency histograms,
+filter-rejection attribution, virtual-time series, phase timers and the
+Chrome-trace exporter.
+
+The cross-engine contracts under test: at W=1 / C=1 on queue-trivial
+traces the CPU event engine and the device path produce bit-identical
+latency summaries and per-episode rejection reasons (the device is
+chunk-granular but the crafted instants coincide); ``summary``
+granularity never changes a device program; telemetry state never leaks
+into checkpoint blobs."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod, Taint
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
+from kubernetes_simulator_tpu.sim.synthetic import make_chaos_timeline
+from kubernetes_simulator_tpu.sim.telemetry import (
+    TelemetryConfig,
+    latency_summary,
+    write_chrome_trace,
+)
+from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+FIT_ONLY = lambda: FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+
+
+def _light_trace(num_pods=28, num_nodes=5, duration=30.0, seed=None):
+    """Queue-trivial parity envelope (tests/test_chaos.py twin)."""
+    rng = np.random.default_rng(seed) if seed is not None else None
+    nodes = [Node(f"n{i}", {"cpu": 8.0}) for i in range(num_nodes)]
+    pods = []
+    for i in range(num_pods):
+        d = duration if rng is None else float(rng.integers(30, 61))
+        pods.append(
+            Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+                duration=d)
+        )
+    return encode(Cluster(nodes=nodes), pods)
+
+
+# -- config / units -------------------------------------------------------
+
+
+def test_granularity_validation():
+    assert TelemetryConfig.resolve(None).granularity == "summary"
+    assert TelemetryConfig.resolve("off").enabled is False
+    assert TelemetryConfig.resolve("series").want_series
+    assert not TelemetryConfig.resolve("series").want_timeline
+    assert TelemetryConfig.resolve("timeline").want_timeline
+    with pytest.raises(ValueError, match="granularity"):
+        TelemetryConfig.resolve("verbose")
+
+
+def test_latency_summary_exact():
+    s = latency_summary(3, [0.5, 1.0, 4.0, 600.0])
+    assert s["count"] == 7
+    assert s["max"] == 600.0
+    # method="lower" quantiles are exact data values (sorted multiset is
+    # [0, 0, 0, 0.5, 1, 4, 600]; p99 index floors to 4.0 at n=7).
+    assert s["p50"] == 0.5
+    assert s["p99"] == 4.0
+    assert s["buckets"]["le_0"] == 3
+    assert s["buckets"]["le_0.5"] == 4
+    assert s["buckets"]["le_4"] == 6
+    assert s["buckets"]["le_512"] == 6  # 600 overflows every finite edge
+    assert s["buckets"]["le_inf"] == 7
+    assert latency_summary(0, []) is None
+
+
+# -- engine off/summary behavior -----------------------------------------
+
+
+def test_off_granularity_yields_none():
+    ec, ep = _light_trace(num_pods=6, num_nodes=2)
+    assert CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="off").replay(
+    ).telemetry is None
+    assert JaxReplayEngine(
+        ec, ep, FIT_ONLY(), wave_width=1, chunk_waves=1, telemetry="off"
+    ).replay().telemetry is None
+
+
+def test_default_summary_attached_both_engines():
+    ec, ep = _light_trace(num_pods=6, num_nodes=2)
+    for res in (
+        CpuReplayEngine(ec, ep, FIT_ONLY()).replay(),
+        JaxReplayEngine(ec, ep, FIT_ONLY(), wave_width=1,
+                        chunk_waves=1).replay(),
+    ):
+        t = res.telemetry
+        assert t is not None and t.granularity == "summary"
+        assert t.latency["count"] == res.placed
+        assert t.reasons is None  # series-only signal
+        assert t.phases  # timers ran
+        assert "telemetry" in res.summary()
+
+
+# -- rejection attribution parity (plain path, in-scan counters) ----------
+
+
+def _reject_trace(num_pods=10):
+    """n0 (cpu=2) fills after two pods; n1 is big but tainted NoSchedule.
+    Every later pod fails with a two-plugin breakdown: NodeResourcesFit
+    is charged n0 (first in Filter order), TaintToleration n1."""
+    nodes = [
+        Node("n0", {"cpu": 2.0}),
+        Node("n1", {"cpu": 100.0},
+             taints=[Taint("dedicated", "infra", "NoSchedule")]),
+    ]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i))
+        for i in range(num_pods)
+    ]
+    return encode(Cluster(nodes=nodes), pods)
+
+
+@pytest.mark.parametrize("engine", ["v2", "v3"])
+def test_plain_rejection_attribution_matches_cpu(engine):
+    """Device in-scan [K] reject counters (series granularity) bit-match
+    the CPU event engine's per-episode reasons at W=1/C=1 — including the
+    v3 path, which swaps in the v2-reference instrumented program."""
+    ec, ep = _reject_trace()
+    cfg = FrameworkConfig()
+    cpu = CpuReplayEngine(ec, ep, cfg, telemetry="series").replay()
+    dev = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, engine=engine,
+        telemetry="series",
+    ).replay()
+    np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+    assert cpu.telemetry.reasons == dev.telemetry.reasons
+    assert cpu.telemetry.reasons == {
+        "NodeResourcesFit": 8, "TaintToleration": 8,
+    }
+    # Episode semantics: CPU backoff retries grow attempts, never reasons.
+    assert sum(cpu.telemetry.rejection_attempts.values()) >= sum(
+        cpu.telemetry.reasons.values()
+    )
+    # Plain-path device failures are terminal: attempts == reasons.
+    assert dev.telemetry.rejection_attempts == dev.telemetry.reasons
+    assert cpu.telemetry.latency == dev.telemetry.latency
+
+
+def test_summary_granularity_keeps_device_program():
+    """The default granularity must never swap in the instrumented chunk
+    program (bench safety): the engine reuses the plain chunk_fn and the
+    placements equal the off-telemetry run."""
+    ec, ep = _reject_trace()
+    eng = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), wave_width=1, chunk_waves=1,
+        telemetry="summary",
+    )
+    res = eng.replay()
+    assert not hasattr(eng, "_chunk_fn_rej")  # never built
+    off = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), wave_width=1, chunk_waves=1,
+        telemetry="off",
+    ).replay()
+    np.testing.assert_array_equal(res.assignments, off.assignments)
+
+
+# -- boundary-retry latency parity ---------------------------------------
+
+
+def test_boundary_retry_latency_matches_cpu():
+    """Crafted coincidence trace: p1 fails at t=1 (node full), the slot
+    frees at t=1.5, the CPU backoff expiry (1 + 1.0) and the device chunk
+    boundary (arrival of p2) both land at t=2 → both engines record the
+    SAME latency multiset {0, 0, 1.0} and one failed attempt."""
+    nodes = [Node("n0", {"cpu": 1.0})]
+    pods = [
+        Pod("p0", requests={"cpu": 1.0}, arrival_time=0.0, duration=1.5),
+        Pod("p1", requests={"cpu": 1.0}, arrival_time=1.0),
+        Pod("p2", requests={"cpu": 0.0}, arrival_time=2.0),
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FIT_ONLY()
+    cpu = CpuReplayEngine(ec, ep, cfg, telemetry="series").replay()
+    dev = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, retry_buffer=8,
+        telemetry="series",
+    ).replay()
+    np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+    for t in (cpu.telemetry, dev.telemetry):
+        assert t.latency["count"] == 3
+        assert t.zero_latency_binds == 2
+        assert t.bind_latency == {1: 1.0}
+        assert t.reasons == {"NodeResourcesFit": 1}
+        assert t.rejection_attempts == {"NodeResourcesFit": 1}
+    assert cpu.telemetry.latency == dev.telemetry.latency
+
+
+@pytest.mark.fuzz_quick
+def test_seeded_chaos_telemetry_parity():
+    """Chaos fuzz slice (tests/test_chaos.py twin at series granularity):
+    seeded queue-trivial traces with mttr=0 timelines must hold latency-
+    histogram AND rejection-reason parity bit-for-bit alongside the
+    existing assignment/eviction parity."""
+    cfg = FIT_ONLY()
+    evictions = 0
+    for seed in (1, 2, 3):
+        ec, ep = _light_trace(num_pods=28, num_nodes=6, seed=seed)
+        evs = make_chaos_timeline(
+            ec.num_nodes, seed=seed, horizon=float(ep.arrival.max()),
+            mtbf=12.0, mttr=0.0, node_fraction=0.34,
+        )
+        cpu = CpuReplayEngine(ec, ep, cfg, telemetry="series").replay(
+            node_events=evs
+        )
+        dev = JaxReplayEngine(
+            ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+            retry_buffer=64, telemetry="series",
+        ).replay(node_events=evs)
+        np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+        assert cpu.telemetry.latency == dev.telemetry.latency, f"seed {seed}"
+        assert cpu.telemetry.reasons == dev.telemetry.reasons, f"seed {seed}"
+        evictions += dev.evictions
+    assert evictions > 0  # non-vacuous
+
+
+# -- checkpoint purity ----------------------------------------------------
+
+
+def test_checkpoint_blob_identical_with_telemetry(tmp_path):
+    """Telemetry state is NOT checkpoint state: boundary-mode blobs are
+    bit-identical with telemetry off vs timeline."""
+    ec, ep = _light_trace(num_pods=24, num_nodes=4)
+    blobs = {}
+    for gran in ("off", "timeline"):
+        ck = str(tmp_path / f"ck_{gran}.npz")
+        JaxReplayEngine(
+            ec, ep, FIT_ONLY(), wave_width=1, chunk_waves=4,
+            preemption="kube", retry_buffer=64, telemetry=gran,
+        ).replay(checkpoint_path=ck, checkpoint_every=3)
+        blobs[gran] = np.load(ck, allow_pickle=True)
+    off, tl = blobs["off"], blobs["timeline"]
+    assert sorted(off.files) == sorted(tl.files)
+    for k in off.files:
+        np.testing.assert_array_equal(off[k], tl[k])
+
+
+# -- what-if per-scenario latency ----------------------------------------
+
+
+def test_whatif_kube_scenario_latency_quantiles():
+    """Kube batches expose per-scenario latency quantiles; the clean
+    scenario equals the single-replay telemetry, and the plain batch
+    reports None."""
+    ec, ep = _light_trace(num_pods=20, num_nodes=4)
+    cfg = FIT_ONLY()
+    evs = [e for e in make_chaos_timeline(
+        ec.num_nodes, seed=7, horizon=float(ep.arrival.max()),
+        mtbf=10.0, mttr=0.0, node_fraction=0.5,
+    )]
+    single = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+        retry_buffer=64,
+    ).replay()
+    res = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario(events=evs)], cfg, wave_width=1,
+        chunk_waves=1, preemption="kube", retry_buffer=64,
+        telemetry="series",
+    ).run()
+    assert res.latency_p50.shape == (2,)
+    st = single.telemetry.latency
+    assert float(res.latency_p50[0]) == st["p50"]
+    assert float(res.latency_p99[0]) == st["p99"]
+    assert res.scenario_telemetry[1].latency["count"] > 0
+    plain = WhatIfEngine(ec, ep, [Scenario()], cfg, chunk_waves=4).run()
+    assert plain.latency_p50 is None and plain.scenario_telemetry is None
+
+
+# -- chrome trace exporter ------------------------------------------------
+
+
+def test_chrome_trace_export(tmp_path):
+    ec, ep = _light_trace(num_pods=12, num_nodes=3)
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+
+    evs = [
+        NodeEvent(time=4.0, kind="node_down", node=0),
+        NodeEvent(time=9.0, kind="node_up", node=0),
+    ]
+    res = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="timeline").replay(
+        node_events=evs
+    )
+    path = str(tmp_path / "trace.json")
+    n = write_chrome_trace(path, res, arrival=ep.arrival, duration=ep.duration)
+    with open(path) as f:
+        doc = json.load(f)
+    ev = doc["traceEvents"]
+    assert len(ev) == n > 0
+    phases = {e["ph"] for e in ev}
+    assert "X" in phases and "M" in phases
+    names = {e["name"] for e in ev}
+    assert "node0 down" in names  # chaos span got stitched
+    # Every pod span sits on the node it was bound to.
+    for e in ev:
+        if e["ph"] == "X" and e.get("pid") == 0 and e["name"].startswith("pod"):
+            p = int(e["name"][3:])
+            assert e["tid"] == int(res.assignments[p])
