@@ -15,7 +15,11 @@ continuity with r01–r03. CPU rate is measured on a pod subsample of the
 same workload (it is orders of magnitude slower).
 
 Env knobs: BENCH_NODES, BENCH_PODS, BENCH_SCENARIOS, BENCH_CPU_PODS,
-BENCH_RUNS, BENCH_DURATION_MEAN (seconds; 0 disables durations).
+BENCH_RUNS, BENCH_DURATION_MEAN (seconds; 0 disables durations),
+BENCH_TUNE_POP / BENCH_TUNE_SCEN (the ``tune_popsweep`` detail headline:
+candidate-policies/sec through the policy tuner's batched sweep — the
+config2 search space, i.e. the full default plugin set's 5 Score weights
+plus the NodeResourcesFit strategy selector; 0 population disables).
 """
 
 from __future__ import annotations
@@ -108,6 +112,47 @@ def main():
             "durationless_walls_s": [round(w, 3) for w in walls_c],
         }
 
+    # Policy-tuner population sweep (round 9): P candidate policy vectors
+    # × S_t train scenarios flattened onto the scenario axis, values
+    # swapped between runs via set_policies — one compile, so the rate is
+    # pure sweep throughput, the quantity a search round pays per
+    # candidate. Same search space as examples/config2_full_plugins_5k
+    # (all 5 default Score weights + the fit-strategy selector).
+    tune_sweep = {}
+    P_t = int(os.environ.get("BENCH_TUNE_POP", 16))
+    S_t = int(os.environ.get("BENCH_TUNE_SCEN", 4))
+    if P_t > 0:
+        from kubernetes_simulator_tpu.ops import tpu as T
+
+        rng = np.random.default_rng(0)
+        K = len(T.POLICY_COLS)
+
+        def _cands():
+            c = rng.uniform(0.0, 10.0, size=(P_t, K)).astype(np.float32)
+            c[:, T.IDX_FIT_LEAST] = (rng.random(P_t) < 0.5).astype(np.float32)
+            return np.repeat(c, S_t, axis=0)
+
+        train = uniform_scenarios(ec, S_t, seed=0)
+        eng_t = WhatIfEngine(
+            ec, ep, train * P_t, cfg, chunk_waves=512, policies=_cands(),
+        )
+        eng_t.run()  # warmup: compile + first execution
+        walls_t = []
+        for _ in range(runs):
+            eng_t.set_policies(_cands())
+            walls_t.append(eng_t.run().wall_clock_s)
+        med_t = float(np.median(sorted(walls_t)))
+        tune_sweep = {
+            "tune_popsweep": {
+                "candidate_policies_per_sec": round(
+                    P_t / med_t if med_t > 0 else 0.0, 2
+                ),
+                "population": P_t,
+                "train_scenarios": S_t,
+                "wall_median_s": round(med_t, 3),
+            }
+        }
+
     print(
         json.dumps(
             {
@@ -134,6 +179,7 @@ def main():
                     "scenario0_placed": int(res.placed[0]),
                     "device": _device_kind(),
                     **cont,
+                    **tune_sweep,
                 },
             }
         )

@@ -3,6 +3,7 @@
     sim = Simulator(cluster, pods, strategy="jax")
     result = sim.run()
     whatif = sim.what_if(scenarios=256, mesh=True)
+    tuned = sim.tune(rounds=6, population=16)
 """
 
 from __future__ import annotations
@@ -97,6 +98,40 @@ class Simulator:
             **kw,
         )
         return eng.run()
+
+    def tune(
+        self,
+        algo: str = "cem",
+        population: int = 16,
+        rounds: int = 6,
+        seed: int = 0,
+        objective: Optional[dict] = None,
+        mesh: bool = False,
+        output: Optional[str] = None,
+        **kw,
+    ):
+        """Policy tuning (round 9): seeded search over this simulator's
+        Score-plugin policy surface — weights plus the NodeResourcesFit
+        strategy — evaluating each round's whole candidate population in
+        ONE batched what-if sweep (the policy vector is a traced
+        per-scenario input, so only values change between rounds).
+        Returns a :class:`~.sim.tuner.TuneResult`; ``output`` streams the
+        search trajectory as schema-v3 JSONL. Extra ``kw`` forwards to
+        :class:`~.sim.tuner.PolicyTuner` (scenario split sizes, bounds,
+        CPU-oracle knobs, ...)."""
+        from .parallel.mesh import make_mesh
+        from .sim.tuner import PolicyTuner
+        from .utils.metrics import JsonlWriter
+
+        tuner = PolicyTuner(
+            self.ec, self.ep, self.config,
+            algo=algo, population=population, rounds=rounds, seed=seed,
+            objective=objective, mesh=make_mesh() if mesh else None, **kw,
+        )
+        if output is None:
+            return tuner.run()
+        with JsonlWriter(output) as out:
+            return tuner.run(writer=out)
 
     def chaos_timeline(
         self,

@@ -3,6 +3,7 @@ YAML config.
 
     python -m kubernetes_simulator_tpu run config.yaml [--strategy jax]
     python -m kubernetes_simulator_tpu what-if config.yaml
+    python -m kubernetes_simulator_tpu tune config.yaml
     python -m kubernetes_simulator_tpu validate config.yaml
 """
 
@@ -51,10 +52,9 @@ def _chaos_timeline(cfg, ec, ep, seed):
     from .sim.synthetic import make_chaos_timeline
 
     ch = cfg.chaos
-    horizon = (
-        ch.horizon if ch.horizon is not None else float(ep.arrival.max())
-    )
-    return make_chaos_timeline(
+    last_arrival = float(ep.arrival.max())
+    horizon = ch.horizon if ch.horizon is not None else last_arrival
+    events = make_chaos_timeline(
         ec.num_nodes,
         seed=seed,
         horizon=horizon,
@@ -63,6 +63,19 @@ def _chaos_timeline(cfg, ec, ep, seed):
         node_fraction=ch.node_fraction,
         max_events=ch.max_events,
     )
+    # Envelope guard: device engines replay no chunks past the final
+    # wave, so events beyond the last arrival can only fire on the CPU
+    # engine — a configured horizon out there is almost always a
+    # mis-set horizon, not a longer campaign.
+    late = sum(1 for ev in events if ev.time > last_arrival)
+    if late:
+        log.warning(
+            "chaos: %d event(s) beyond the trace's last arrival "
+            "(t=%.1f; chaos.horizon=%.1f) — device engines stop at the "
+            "final wave and will never apply them",
+            late, last_arrival, horizon,
+        )
+    return events
 
 
 def cmd_run(args) -> int:
@@ -168,6 +181,67 @@ def cmd_whatif(args) -> int:
         res.wall_clock_s,
         res.placements_per_sec,
     )
+    return 0
+
+
+def cmd_tune(args) -> int:
+    from .parallel.mesh import make_mesh
+    from .sim.tuner import PolicyTuner
+
+    cfg = SimConfig.load(args.config)
+    if cfg.tune is None:
+        log.error("config has no tune: section")
+        return 2
+    errors = validate_config(cfg)
+    if errors:
+        for e in errors:
+            log.error("config: %s", e)
+        return 2
+    tu = cfg.tune
+    ec, ep = build_encoded_case(cfg)
+    log.info("encoded %d nodes / %d pods", ec.num_nodes, ep.num_pods)
+    mesh = make_mesh() if tu.mesh else None
+    tuner = PolicyTuner(
+        ec, ep, cfg.framework,
+        algo=tu.algo, population=tu.population, rounds=tu.rounds,
+        seed=tu.seed, elite_frac=tu.elite_frac, objective=tu.objective,
+        train_scenarios=tu.train_scenarios,
+        heldout_scenarios=tu.heldout_scenarios,
+        scenario_seed=tu.scenario_seed,
+        p_node_down=tu.node_down_p, p_capacity=tu.capacity_p,
+        p_taint=tu.taint_p,
+        weight_bounds=(
+            tuple(tu.weight_bounds) if tu.weight_bounds else None
+        ),
+        tune_strategy=tu.tune_strategy,
+        wave_width=8 if cfg.wave_width == "auto" else cfg.wave_width,
+        chunk_waves=cfg.chunk_waves,
+        completions=cfg.whatif.completions,
+        mesh=mesh,
+        cpu_oracle=tu.cpu_oracle, cpu_envelope=tu.cpu_envelope,
+    )
+    out_path = tu.output or cfg.output
+    with JsonlWriter(out_path, context=_writer_context(cfg, args.config)) as out:
+        with device_trace(args.profile_dir):
+            res = tuner.run(writer=out)
+    log.info(
+        "tune: %s over %d rounds x %d candidates (%d evaluations, "
+        "%d compile%s) in %.3fs",
+        tu.algo, res.rounds, res.population, res.evaluations,
+        res.compile_count or 0, "" if res.compile_count == 1 else "s",
+        res.wall_clock_s,
+    )
+    log.info(
+        "tune: held-out objective %.6f vs default %.6f (%s); best policy %s",
+        res.heldout_objective, res.default_heldout_objective,
+        "improved" if res.improved() else "no improvement",
+        res.best_policy,
+    )
+    if res.cpu_envelope is not None:
+        log.info(
+            "tune: CPU-oracle objective %.6f (envelope %.3g)",
+            res.cpu_objective, res.cpu_envelope,
+        )
     return 0
 
 
@@ -297,6 +371,45 @@ def validate_config(cfg) -> list:
                 "(per-scenario timelines apply through the kube-mode "
                 "host mirrors at chunk boundaries)"
             )
+    tu = cfg.tune
+    if tu is not None:
+        from .sim.tuner import _ALWAYS_METRICS, _RESULT_METRICS
+
+        if tu.algo not in ("cem", "random"):
+            errors.append(
+                f"tune.algo: must be 'cem' or 'random', got {tu.algo!r}"
+            )
+        if tu.population < 2:
+            errors.append("tune.population: must be >= 2")
+        if tu.rounds < 1:
+            errors.append("tune.rounds: must be >= 1")
+        if not 0.0 < tu.elite_frac <= 1.0:
+            errors.append("tune.eliteFrac: must be in (0, 1]")
+        if tu.train_scenarios < 1 or tu.heldout_scenarios < 1:
+            errors.append(
+                "tune.scenarios: train and heldout must both be >= 1 "
+                "(the acceptance check runs on the held-out split)"
+            )
+        for term in tu.objective or {}:
+            if term not in _RESULT_METRICS:
+                errors.append(
+                    f"tune.objective: unknown term '{term}' "
+                    f"(known: {', '.join(sorted(_RESULT_METRICS))})"
+                )
+            elif term not in _ALWAYS_METRICS:
+                errors.append(
+                    f"tune.objective: term '{term}' needs what-if modes "
+                    "(kube/tier preemption) the per-scenario policy axis "
+                    "does not support — use terms from "
+                    f"{', '.join(sorted(_ALWAYS_METRICS))}"
+                )
+        wb = tu.weight_bounds
+        if wb is not None and (len(wb) != 2 or wb[0] >= wb[1]):
+            errors.append(
+                "tune.weightBounds: must be [lo, hi] with lo < hi"
+            )
+        if tu.cpu_envelope < 0:
+            errors.append("tune.cpuEnvelope: must be >= 0")
     from .sim.telemetry import _LEVELS as _TEL_LEVELS
 
     if cfg.telemetry.granularity not in _TEL_LEVELS:
@@ -347,7 +460,8 @@ def cmd_validate(args) -> int:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubernetes_simulator_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name, fn in (("run", cmd_run), ("what-if", cmd_whatif), ("validate", cmd_validate)):
+    for name, fn in (("run", cmd_run), ("what-if", cmd_whatif),
+                     ("tune", cmd_tune), ("validate", cmd_validate)):
         p = sub.add_parser(name)
         p.add_argument("config")
         p.add_argument("--strategy", choices=["cpu", "jax"])

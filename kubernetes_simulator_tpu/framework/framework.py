@@ -47,6 +47,44 @@ class FrameworkConfig:
     enable_preemption: bool = True
     profile: bool = False  # per-extension-point latency accounting
 
+    def with_policy(
+        self,
+        weights: Dict[str, float],
+        fit_strategy: Optional[str] = None,
+    ) -> "FrameworkConfig":
+        """A copy of this config with the given Score weights merged in
+        and (optionally) the NodeResourcesFit scoring strategy replaced —
+        how the policy tuner (round 9, sim.tuner) re-materializes a
+        searched policy vector as an ordinary scheduler config for the
+        CPU-oracle re-evaluation. Plugin entries other than
+        NodeResourcesFit are carried unchanged."""
+        merged = dict(self.weights or {})
+        merged.update(weights)
+        plugins = self.plugins
+        if fit_strategy is not None:
+            entries = (
+                [dict(e) for e in plugins]
+                if plugins is not None
+                else [{"name": n} for n in DEFAULT_WEIGHTS]
+            )
+            found = False
+            for e in entries:
+                if e.get("name") == "NodeResourcesFit":
+                    e["args"] = {**e.get("args", {}), "strategy": fit_strategy}
+                    found = True
+            if not found:
+                entries.append(
+                    {"name": "NodeResourcesFit",
+                     "args": {"strategy": fit_strategy}}
+                )
+            plugins = entries
+        return FrameworkConfig(
+            plugins=plugins,
+            weights=merged,
+            enable_preemption=self.enable_preemption,
+            profile=self.profile,
+        )
+
 
 class SchedulerFramework:
     def __init__(self, ec: EncodedCluster, pods: EncodedPods, config: Optional[FrameworkConfig] = None):

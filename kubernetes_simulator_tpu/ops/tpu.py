@@ -37,6 +37,55 @@ from ..models.core import Effect, Operator
 
 MAX_NODE_SCORE = 100.0
 NEG_INF = -jnp.inf
+
+#: Policy-vector column order (round 9 policy tuner). A wave step built
+#: with ``wvec`` (a traced f32 [len(POLICY_COLS)] vector) reads Score
+#: weights from these columns instead of the static spec, so one compiled
+#: program serves a whole population of scheduler policies — the vector
+#: rides the scenario (vmap/mesh) axis and only its VALUES change between
+#: candidates. The first five columns are plugin weights; ``fit_least`` is
+#: the NodeResourcesFit scoring-strategy selector (> 0.5 → LeastAllocated,
+#: else MostAllocated; ignored when the static base strategy is
+#: RequestedToCapacityRatio, whose shape table has no cheap traced form).
+POLICY_WEIGHT_COLS = (
+    "NodeResourcesFit",
+    "TaintToleration",
+    "NodeAffinity",
+    "InterPodAffinity",
+    "PodTopologySpread",
+)
+IDX_FIT_LEAST = len(POLICY_WEIGHT_COLS)
+POLICY_COLS = POLICY_WEIGHT_COLS + ("fit_least",)
+
+
+def policy_weight_fns(spec, wvec):
+    """(_w, _on) weight accessors for the score fold.
+
+    Static mode (wvec is None): ``_w`` returns the np.float32 config weight
+    and ``_on`` gates zero-weight rows OUT of the program (the historical
+    behaviour). Traced mode: ``_w`` indexes wvec and ``_on`` keeps every
+    spec-enabled row IN the program — a zero weight then contributes an
+    exact ``0.0 * normalized`` term, and because each row's hi/lo extrema
+    never depend on the weights, totals bit-match the static program at
+    equal weight values."""
+    if wvec is None:
+        w = dict(spec.weights)
+
+        def _w(name):
+            return np.float32(w.get(name, 1.0))
+
+        def _on(name):
+            return w.get(name, 1.0) != 0
+
+    else:
+
+        def _w(name):
+            return wvec[POLICY_WEIGHT_COLS.index(name)]
+
+        def _on(name):
+            return True
+
+    return _w, _on
 # One-hot contractions must accumulate exactly (integer-valued f32 counts).
 _HI = jax.lax.Precision.HIGHEST
 
@@ -933,10 +982,15 @@ def eval_pod_fused(
     p: WavePre,
     spec,
     widths: tuple,
+    wvec=None,
 ):
     """Fused Filter+Score for one slot using wave-precomputed tensors.
     Bit-identical to the reference chain (sim.jax_runtime.eval_pod) — the
-    parity suites pin this. Returns (feasible [N], scores [N], any_f)."""
+    parity suites pin this. Returns (feasible [N], scores [N], any_f).
+
+    ``wvec`` (optional [len(POLICY_COLS)] traced f32) swaps the static
+    config weights for per-scenario policy-vector columns (round 9 tuner);
+    filtering is weight-independent and unchanged."""
     N = dc.allocatable.shape[0]
     A, B, SP = widths
     K = p.lhs.shape[0]
@@ -990,34 +1044,42 @@ def eval_pod_fused(
     any_f = jnp.any(feasible)
 
     # ---- scores: stack raw rows, one masked min+max, per-row normalize ----
-    w = dict(spec.weights)
+    _w, _on = policy_weight_fns(spec, wvec)
     total = jnp.zeros(N, dtype=jnp.float32)
-    if spec.fit and w.get("NodeResourcesFit", 1.0) != 0:
+    if spec.fit and _on("NodeResourcesFit"):
         rw = np.asarray(spec.resource_weights, dtype=np.float32)
-        if spec.fit_strategy == "LeastAllocated":
-            raw = least_allocated_score(dc, st, s, rw)
-        elif spec.fit_strategy == "MostAllocated":
-            raw = most_allocated_score(dc, st, s, rw)
-        else:
+        if spec.fit_strategy not in ("LeastAllocated", "MostAllocated"):
             raw = requested_to_capacity_ratio_score(
                 dc, st, s, rw, spec.shape_x, spec.shape_y
             )
-        total = total + w.get("NodeResourcesFit", 1.0) * raw
+        elif wvec is None:
+            raw = (
+                least_allocated_score(dc, st, s, rw)
+                if spec.fit_strategy == "LeastAllocated"
+                else most_allocated_score(dc, st, s, rw)
+            )
+        else:
+            raw = jnp.where(
+                wvec[IDX_FIT_LEAST] > 0.5,
+                least_allocated_score(dc, st, s, rw),
+                most_allocated_score(dc, st, s, rw),
+            )
+        total = total + _w("NodeResourcesFit") * raw
 
     # (raw, weight, minmax?, reverse?) rows, in the reference accumulation
     # order: taint, node-affinity, interpod, spread.
     rows = []
-    if spec.taints and spec.taint_score and w.get("TaintToleration", 1.0) != 0:
-        rows.append((p.taint_raw, w.get("TaintToleration", 1.0), False, True))
-    if spec.node_affinity and w.get("NodeAffinity", 1.0) != 0:
-        rows.append((p.na_raw, w.get("NodeAffinity", 1.0), False, False))
-    if spec.interpod and w.get("InterPodAffinity", 1.0) != 0:
+    if spec.taints and spec.taint_score and _on("TaintToleration"):
+        rows.append((p.taint_raw, _w("TaintToleration"), False, True))
+    if spec.node_affinity and _on("NodeAffinity"):
+        rows.append((p.na_raw, _w("NodeAffinity"), False, False))
+    if spec.interpod and _on("InterPodAffinity"):
         raw = reads[A + B + SP]
         if spec.has_symmetric_pref:
             raw = raw + jnp.einsum("g,gn->n", p.pmg_f, st.pref_wsum, precision=_HI)
-        rows.append((raw, w.get("InterPodAffinity", 1.0), True, False))
+        rows.append((raw, _w("InterPodAffinity"), True, False))
     sp_pack = None
-    if spec.spread and w.get("PodTopologySpread", 1.0) != 0 and SP:
+    if spec.spread and _on("PodTopologySpread") and SP:
         # Upstream scoring: raw + ignored mask computed here; the extrema
         # (over feasible & ~ignored) ride the shared stacked reduce below
         # as an extra row with the ignored nodes pre-masked to ±inf.
@@ -1043,14 +1105,14 @@ def eval_pod_fused(
         lo = jnp.min(lo_stack, axis=1)
         for i, (raw, wt, minmax, reverse) in enumerate(rows):
             out = _normalize_row(raw, lo[i], hi[i], any_f, minmax, reverse)
-            total = total + np.float32(wt) * out
+            total = total + wt * out
         if sp_pack is not None:
             raw_sp, ignored = sp_pack
             out = spread_norm_from_extrema(
                 raw_sp, ignored, hi[-1], lo[-1], jnp.any(p.sp_scored),
                 getattr(spec, "sp_norm_f32", False),
             )
-            total = total + np.float32(w.get("PodTopologySpread", 1.0)) * out
+            total = total + _w("PodTopologySpread") * out
     return feasible, total, any_f
 
 

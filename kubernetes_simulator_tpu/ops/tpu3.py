@@ -908,18 +908,22 @@ def class_masks(dc: DevCluster, d: Derived, st: V3Static, spec, rep_slots):
 def make_wave_step3(
     dc: DevCluster, d: Derived, sh: Shared3, st: V3Static,
     wave_width: int, spec, cmasks=None, dyn: Optional[DynTables] = None,
-    dyn_flip: bool = True,
+    dyn_flip: bool = True, wvec=None,
 ):
     """Scan body over (PodSlot, SlotExtra) wave batches. Bit-identical to
     the v2 step; see module docstring for the traffic model. ``cmasks``:
     per-chunk class masks from :func:`class_masks`. ``dyn``: per-scenario
     DynTables for labels_dirty batches — base expansion tables stay
-    shared; corrections apply as K-term fused elementwise updates."""
+    shared; corrections apply as K-term fused elementwise updates.
+    ``wvec``: optional traced policy vector (T2.POLICY_COLS) replacing the
+    static score weights — the round 9 tuner's population axis; disables
+    the packed select (its integer-weight bound needs static weights)."""
     cmasks = cmasks or {}
     G = st.G
     Dcap = st.Dcap
     o0, o1, o2, o3, o4, o5, o6 = st.sections
     w_cfg = dict(spec.weights)
+    _w, _on = T2.policy_weight_fns(spec, wvec)
     kmask = kind_masks(st)
     # Bound-node domain vectors are only needed when some plane is carried.
     maintain_dom = st.maintain_mc or st.maintain_anti or st.maintain_pref
@@ -942,7 +946,7 @@ def make_wave_step3(
         or st.has_host_rows
         or (st.SP and (st.has_dns or not spread_dom_hilo))
     )
-    pack_select = pack_select_ok(spec, w_cfg, dc.allocatable.shape[0])
+    pack_select = wvec is None and pack_select_ok(spec, w_cfg, dc.allocatable.shape[0])
 
     def wave_step(carry: DevState3, batch):
         sb, sx = batch
@@ -1264,18 +1268,30 @@ def make_wave_step3(
             feasible = fit_ok & nonfit
             any_f = None  # derived from the hi reduce when rows exist
             total = jnp.zeros(N, jnp.float32)
-            if spec.fit and w_cfg.get("NodeResourcesFit", 1.0) != 0:
+            if spec.fit and _on("NodeResourcesFit"):
                 rw = np.asarray(spec.resource_weights, dtype=np.float32)
-                raw = _fit_score_r(
-                    used1_r, alloc_r, rw, spec.fit_strategy, spec.shape_x, spec.shape_y
-                )
-                total = total + w_cfg.get("NodeResourcesFit", 1.0) * raw
+                if wvec is not None and spec.fit_strategy in (
+                    "LeastAllocated", "MostAllocated"
+                ):
+                    raw = jnp.where(
+                        wvec[T2.IDX_FIT_LEAST] > 0.5,
+                        _fit_score_r(used1_r, alloc_r, rw, "LeastAllocated",
+                                     spec.shape_x, spec.shape_y),
+                        _fit_score_r(used1_r, alloc_r, rw, "MostAllocated",
+                                     spec.shape_x, spec.shape_y),
+                    )
+                else:
+                    raw = _fit_score_r(
+                        used1_r, alloc_r, rw, spec.fit_strategy,
+                        spec.shape_x, spec.shape_y,
+                    )
+                total = total + _w("NodeResourcesFit") * raw
             rows_n = []
-            if spec.taints and spec.taint_score and w_cfg.get("TaintToleration", 1.0) != 0:
-                rows_n.append((traw_k, w_cfg.get("TaintToleration", 1.0), False, True))
-            if spec.node_affinity and w_cfg.get("NodeAffinity", 1.0) != 0:
-                rows_n.append((naraw_k, w_cfg.get("NodeAffinity", 1.0), False, False))
-            if spec.interpod and w_cfg.get("InterPodAffinity", 1.0) != 0:
+            if spec.taints and spec.taint_score and _on("TaintToleration"):
+                rows_n.append((traw_k, _w("TaintToleration"), False, True))
+            if spec.node_affinity and _on("NodeAffinity"):
+                rows_n.append((naraw_k, _w("NodeAffinity"), False, False))
+            if spec.interpod and _on("InterPodAffinity"):
                 raw = jnp.zeros(dc.allocatable.shape[0], jnp.float32)
                 if st.PA:
                     raw = raw + jnp.einsum(
@@ -1283,11 +1299,11 @@ def make_wave_step3(
                     )
                 if st.MP:
                     raw = raw + jnp.sum(vals[o5:o6], axis=0)
-                rows_n.append((raw, w_cfg.get("InterPodAffinity", 1.0), True, False))
+                rows_n.append((raw, _w("InterPodAffinity"), True, False))
             sp_pack = None
             if (
                 spec.spread
-                and w_cfg.get("PodTopologySpread", 1.0) != 0
+                and _on("PodTopologySpread")
                 and st.SP
                 and not spread_dom_hilo
             ):
@@ -1324,12 +1340,12 @@ def make_wave_step3(
                     hi[0] > -jnp.inf if rows_n else jnp.any(feasible)
                 )
                 for i, (raw, wt, minmax, reverse) in enumerate(rows_n):
-                    total = total + np.float32(wt) * _normalize_row(
+                    total = total + wt * _normalize_row(
                         raw, lo[i], hi[i], any_f, minmax, reverse
                     )
                 if sp_pack is not None:
-                    total = total + np.float32(
-                        w_cfg.get("PodTopologySpread", 1.0)
+                    total = total + _w(
+                        "PodTopologySpread"
                     ) * T2.spread_norm_from_extrema(
                         sp_pack[0], sp_pack[1], hi[-1], lo[-1],
                         jnp.any(pre.sp_scored[k]),
@@ -1339,13 +1355,13 @@ def make_wave_step3(
                 any_f = None
             if (
                 spec.spread
-                and w_cfg.get("PodTopologySpread", 1.0) != 0
+                and _on("PodTopologySpread")
                 and st.SP
                 and spread_dom_hilo
             ):
                 # Upstream scoring ([K8S] scoring.go): cnt·log(size+2) +
                 # (maxSkew−1), rounded, two-pass integer normalize.
-                wt = w_cfg.get("PodTopologySpread", 1.0)
+                wt = _w("PodTopologySpread")
                 # Domain-space form (SP == 1, coarse row): raw takes one
                 # value per existing domain; label-less nodes are the
                 # ignored set (the extra bucket), excluded from extrema
@@ -1437,7 +1453,7 @@ def make_wave_step3(
                     )
                 if any_f is None:
                     any_f = jnp.any(domfeas)
-                total = total + np.float32(wt) * out
+                total = total + wt * out
             if any_f is None:
                 any_f = jnp.any(feasible)
 

@@ -84,3 +84,24 @@ def replicate_tree(mesh: Mesh, tree):
     if jax.process_count() > 1:
         return jax.tree.map(lambda a: _global_put(a, sh), tree)
     return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+
+
+def fit_population(population: int, per_candidate: int, mesh: Optional[Mesh]) -> int:
+    """Smallest population ≥ ``population`` whose FLAT sweep axis
+    (population × per_candidate scenarios) divides over the mesh devices.
+
+    The policy tuner (round 9, sim.tuner) evaluates its whole candidate
+    population in one sweep by flattening (candidate, train-scenario)
+    pairs onto the scenario axis — the same data-parallel axis the
+    perturbation sweeps shard. A mesh requires that flat axis to divide
+    evenly over devices (WhatIfEngine raises otherwise), so the tuner
+    rounds the population UP here and fills the extra rows with fresh
+    samples rather than failing or silently truncating. No-op without a
+    mesh."""
+    population = max(int(population), 1)
+    if mesh is None:
+        return population
+    ndev = int(mesh.devices.size)
+    while (population * per_candidate) % ndev:
+        population += 1
+    return population

@@ -191,6 +191,57 @@ DEFAULT_WEIGHTS = {
     "PodTopologySpread": 2.0,
 }
 
+#: Policy-tuner surface (round 9, sim.tuner): the default Score-weight
+#: search range. Upstream accepts weights in [0, 100]; the useful dynamic
+#: range is far smaller — only weight RATIOS matter to the argmax.
+TUNABLE_WEIGHT_RANGE = (0.0, 10.0)
+
+#: NodeResourcesFit scoring strategies with a cheap traced selector in the
+#: device score fold (ops.tpu POLICY_COLS "fit_least" column; index order
+#: matters: fit_least > 0.5 selects LeastAllocated).
+TUNABLE_FIT_STRATEGIES = ("MostAllocated", "LeastAllocated")
+
+
+def tunable_parameters(config=None) -> List[dict]:
+    """The tunable-parameter surface for the policy tuner: one ``weight``
+    entry per Score plugin (canonical PLUGIN_FACTORIES order — the same
+    order as ops.tpu.POLICY_WEIGHT_COLS) plus the NodeResourcesFit
+    strategy ``choice``. ``enabled`` marks parameters whose plugin is in
+    the config's plugin list (disabled plugins' score rows are statically
+    absent from the device program, so their columns are inert — the
+    search pins them to their defaults). ``default`` reflects the config's
+    own weights/args, so the unmodified policy vector reproduces the
+    configured scheduler exactly."""
+    weights = dict(DEFAULT_WEIGHTS)
+    enabled = set(PLUGIN_FACTORIES)
+    strategy = "LeastAllocated"
+    if config is not None:
+        weights.update(config.weights or {})
+        if config.plugins is not None:
+            enabled = {e["name"] for e in config.plugins}
+            for e in config.plugins:
+                if e.get("name") == "NodeResourcesFit":
+                    strategy = e.get("args", {}).get("strategy", strategy)
+    lo, hi = TUNABLE_WEIGHT_RANGE
+    out = [
+        {
+            "name": name, "kind": "weight", "lo": lo, "hi": hi,
+            "default": float(weights[name]), "enabled": name in enabled,
+        }
+        for name in PLUGIN_FACTORIES
+    ]
+    out.append({
+        "name": "NodeResourcesFit.strategy", "kind": "choice",
+        "choices": TUNABLE_FIT_STRATEGIES, "default": strategy,
+        # A RequestedToCapacityRatio base strategy has no traced selector
+        # (its shape table is static) — the column is inert then.
+        "enabled": (
+            "NodeResourcesFit" in enabled
+            and strategy in TUNABLE_FIT_STRATEGIES
+        ),
+    })
+    return out
+
 
 def make_plugins(
     ctx: SchedulingContext, plugin_config: Optional[List[dict]] = None

@@ -283,9 +283,14 @@ def eval_pod(
     return feasible, total
 
 
-def make_wave_step(dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSpec):
+def make_wave_step(
+    dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSpec, wvec=None
+):
     """Build the scan body: one wave = W sequential slot placements +
     wave-boundary gang commit (SURVEY.md §3.3 Permit-as-masked-commit).
+
+    ``wvec``: optional traced policy vector (ops.tpu.POLICY_COLS) replacing
+    the static score weights — the round 9 tuner's population axis.
 
     ``dc``/``d`` are loop invariants CLOSED OVER, not carried — keeping them
     out of the scan carry stops XLA copying ~10s of MB per iteration (the
@@ -304,7 +309,9 @@ def make_wave_step(dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSp
         for wslot in range(wave_width):
             s = jax.tree.map(lambda a: a[wslot], slot_batch)
             p = jax.tree.map(lambda a: a[wslot], pre)
-            feasible, scores, any_f = T.eval_pod_fused(dc, d, st, s, p, spec, widths)
+            feasible, scores, any_f = T.eval_pod_fused(
+                dc, d, st, s, p, spec, widths, wvec=wvec
+            )
             node, _ = T.select_node(scores, feasible)  # XLA CSEs the any()
             placed = any_f & s.valid
             st = T.apply_binding(d, st, s, node, placed)

@@ -491,6 +491,7 @@ class WhatIfEngine:
         retry_buffer: int = 0,
         granularity_guard: bool = True,
         telemetry=None,
+        policies=None,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -533,7 +534,17 @@ class WhatIfEngine:
         plugin set is covered). Semantics anchored by
         ``greedy_replay(retry_buffer=...)``. Requires the device-release
         completions path without DynTables; 0 = off (the r01–r03
-        semantics)."""
+        semantics).
+
+        ``policies`` (round 9, sim.tuner): a [S, len(ops.tpu.POLICY_COLS)]
+        f32 array of PER-SCENARIO policy vectors — score-plugin weights
+        plus the NodeResourcesFit strategy selector — threaded into the
+        score fold as a traced input on the scenario axis. The whole
+        population compiles ONCE (only vector VALUES differ per
+        scenario); swap values between runs with :meth:`set_policies`.
+        Supported on the plain, device-release and host pending-fold
+        paths (vmap and mesh); kube/tier preemption, retry_buffer and
+        fork checkpoints keep static weights."""
         from .greedy import normalize_preemption
         from .telemetry import TelemetryConfig
 
@@ -894,6 +905,39 @@ class WhatIfEngine:
         self._need_choices = collect_assignments or self.kube or (
             self.completions_on and not self._completions_dev
         )
+        # Per-scenario policy vectors (round 9 tuner). Validated AFTER the
+        # retry/granularity resolution above: the gates below read the
+        # final self.retry_buffer, not the requested one.
+        self._policies = None
+        if policies is not None:
+            pol = np.asarray(policies, dtype=np.float32)
+            K = len(T.POLICY_COLS)
+            if pol.ndim != 2 or pol.shape[1] != K:
+                raise ValueError(
+                    f"policies must be [num_scenarios, {K}] (columns "
+                    f"{T.POLICY_COLS}), got shape {pol.shape}"
+                )
+            if pol.shape[0] != self.S:
+                raise ValueError(
+                    f"policies rows ({pol.shape[0]}) must match "
+                    f"num_scenarios ({self.S})"
+                )
+            blockers_p = []
+            if self.kube:
+                blockers_p.append("kube preemption")
+            if self.preemption:
+                blockers_p.append("tier preemption")
+            if self.retry_buffer:
+                blockers_p.append("retry_buffer")
+            if fork_checkpoint is not None:
+                blockers_p.append("fork checkpoints")
+            if blockers_p:
+                raise ValueError(
+                    "per-scenario policies run on the plain/completions "
+                    "what-if paths — not supported with "
+                    + ", ".join(blockers_p)
+                )
+            self._policies = pol
         self._rel_fn_cache: Dict[tuple, Callable] = {}
         self._rel_core: Optional[Callable] = None
         self._dev_rel_stage: Optional[dict] = None
@@ -909,9 +953,30 @@ class WhatIfEngine:
                 V3.ExtraSource.build(self.static3, pods.num_pods),
             )
 
+    def set_policies(self, policies) -> None:
+        """Swap the per-scenario policy VECTORS without rebuilding the
+        engine: the compiled chunk program takes the vectors as a traced
+        [S, K] input, so same-shape updates reuse the executable — the
+        round 9 tuner runs its whole search against one compile (pinned
+        by tests/test_tuner.py via ``_chunk_fn._cache_size()``)."""
+        if self._policies is None:
+            raise ValueError(
+                "engine was built without policies — pass policies=[S, K] "
+                "at construction to enable the policy axis"
+            )
+        pol = np.asarray(policies, dtype=np.float32)
+        if pol.shape != self._policies.shape:
+            raise ValueError(
+                f"policies shape {pol.shape} must match the engine's "
+                f"{self._policies.shape} (the compiled program is "
+                "shape-specialized)"
+            )
+        self._policies = pol
+
     def _build_chunk_fn(self):
         collect = self._need_choices
         spec, wave_width = self.spec, self.wave_width
+        pol_on = self._policies is not None
 
         if self.engine == "v3":
             from ..ops import tpu3 as V3
@@ -927,12 +992,12 @@ class WhatIfEngine:
                 and getattr(self._dyn, "has_presence_change", True)
             )
 
-            def per_scenario(dc, state, slots, extra, dyn=None):
+            def per_scenario(dc, state, slots, extra, dyn=None, wvec=None):
                 d = T.Derived.build(dc)
                 cmasks = V3.class_masks(dc, d, st3, spec, reps)
                 wave_step = V3.make_wave_step3(
                     dc, d, sh3, st3, wave_width, spec, cmasks, dyn=dyn,
-                    dyn_flip=dyn_flip,
+                    dyn_flip=dyn_flip, wvec=wvec,
                 )
 
                 def step(st, batch):
@@ -967,16 +1032,17 @@ class WhatIfEngine:
                 # Device-side slot gathers INSIDE the jitted program: one
                 # dispatch per chunk, only indices as per-chunk input
                 # (scenario-shared → gathered once, not per scenario).
-                def per_scenario_src(dc, state, src, xsrc, idx, dyn=None):
+                def per_scenario_src(dc, state, src, xsrc, idx, dyn=None, wvec=None):
                     slots = T.gather_slots_device(src, idx)
                     from ..ops import tpu3 as V3m
 
                     extra = V3m.gather_extra_device(xsrc, idx)
-                    return per_scenario(dc, state, slots, extra, dyn)
+                    return per_scenario(dc, state, slots, extra, dyn, wvec)
 
                 if self._completions_dev:
                     def per_scenario_rel(
                         dc, state, src, xsrc, idx, b, vassign, dyn=None,
+                        wvec=None,
                     ):
                         # Static releases run in the separate bucketed
                         # _release_fn BEFORE this call (ordering by data
@@ -987,7 +1053,7 @@ class WhatIfEngine:
                         # wave positions, which is exactly how the static
                         # release lists address them (rel_pos).
                         state, out = per_scenario_src(
-                            dc, state, src, xsrc, idx, dyn
+                            dc, state, src, xsrc, idx, dyn, wvec
                         )
                         choices, counts = out
                         vassign = jax.lax.dynamic_update_slice(
@@ -1165,37 +1231,47 @@ class WhatIfEngine:
                             donate_argnums=(1, 13, 14, 15, 16, 17, 18, 19),
                         )
 
+                    # vmap matches in_axes against the args actually
+                    # passed; with policies on, a literal None rides the
+                    # dyn slot (no leaves — its axis spec is inert) and
+                    # the [S, K] policy matrix maps on axis 0.
+                    axes_rel = [0, 0, None, None, None, None, 0]
+                    if dyn_on:
+                        axes_rel.append(0)
+                    elif pol_on:
+                        axes_rel.append(None)
+                    if pol_on:
+                        axes_rel.append(0)
                     vmapped_rel = jax.vmap(
-                        per_scenario_rel,
-                        in_axes=(
-                            (0, 0, None, None, None, None, 0, 0)
-                            if dyn_on
-                            else (0, 0, None, None, None, None, 0)
-                        ),
+                        per_scenario_rel, in_axes=tuple(axes_rel)
                     )
                     return jax.jit(vmapped_rel, donate_argnums=(1, 6))
                 # vmap matches in_axes against the args actually passed,
                 # so the defaulted dyn arg needs no wrapper.
+                axes_src = [0, 0, None, None, None]
+                if dyn_on:
+                    axes_src.append(0)
+                elif pol_on:
+                    axes_src.append(None)
+                if pol_on:
+                    axes_src.append(0)
                 vmapped_src = jax.vmap(
-                    per_scenario_src,
-                    in_axes=(
-                        (0, 0, None, None, None, 0)
-                        if dyn_on
-                        else (0, 0, None, None, None)
-                    ),
+                    per_scenario_src, in_axes=tuple(axes_src)
                 )
                 return jax.jit(vmapped_src, donate_argnums=(1,))
 
-            vmapped = jax.vmap(
-                per_scenario,
-                in_axes=(
-                    (0, 0, None, None, 0) if dyn_on else (0, 0, None, None)
-                ),
-            )
+            axes_plain = [0, 0, None, None]
+            if dyn_on:
+                axes_plain.append(0)
+            elif pol_on:
+                axes_plain.append(None)
+            if pol_on:
+                axes_plain.append(0)
+            vmapped = jax.vmap(per_scenario, in_axes=tuple(axes_plain))
         else:
-            def per_scenario(dc, state, slots):
+            def per_scenario(dc, state, slots, wvec=None):
                 d = T.Derived.build(dc)
-                wave_step = make_wave_step(dc, d, wave_width, spec)
+                wave_step = make_wave_step(dc, d, wave_width, spec, wvec=wvec)
 
                 def step(st, slot_batch):
                     st, choices = wave_step(st, slot_batch)
@@ -1206,7 +1282,10 @@ class WhatIfEngine:
                 state, outs = jax.lax.scan(step, state, slots)
                 return state, outs
 
-            vmapped = jax.vmap(per_scenario, in_axes=(0, 0, None))
+            vmapped = jax.vmap(
+                per_scenario,
+                in_axes=(0, 0, None, 0) if pol_on else (0, 0, None),
+            )
 
         if self.mesh is None:
             return jax.jit(vmapped, donate_argnums=(1,))
@@ -1230,6 +1309,12 @@ class WhatIfEngine:
             in_specs.append(rp)
             if self._dyn_dev is not None:
                 in_specs.append(sh)
+            elif pol_on:
+                in_specs.append(rp)  # literal None in the dyn slot
+        if pol_on:
+            # The policy population rides the scenario axis: each device
+            # evaluates its local slice of [S, K] candidate vectors.
+            in_specs.append(sh)
         return jax.jit(
             shard_map(
                 vmapped,
@@ -2051,6 +2136,14 @@ class WhatIfEngine:
         if dyn_sharded is not None and self.mesh is not None:
             # Chunk-invariant: shard once, not per chunk.
             dyn_sharded = shard_scenario_tree(self.mesh, dyn_sharded)
+        pol_d = None
+        if self._policies is not None:
+            # Per-scenario policy vectors (round 9): value-only input to
+            # the compiled chunk program — set_policies + run() reuses the
+            # executable. Sharded once (chunk-invariant) under a mesh.
+            pol_d = jnp.asarray(self._policies)
+            if self.mesh is not None:
+                pol_d = shard_scenario_tree(self.mesh, pol_d)
         srcs = self._slot_srcs
         idx_chunks = (
             [jnp.asarray(idx[c0 : c0 + C]) for c0 in range(0, idx.shape[0], C)]
@@ -2389,6 +2482,10 @@ class WhatIfEngine:
                 )
                 if dyn_sharded is not None:
                     args = args + (dyn_sharded,)
+                elif pol_d is not None:
+                    args = args + (None,)  # dyn slot
+                if pol_d is not None:
+                    args = args + (pol_d,)
                 states, vassign_d, out = self._chunk_fn(*args)
             elif self.mesh is None and self.engine == "v3" and srcs is not None:
                 # Fused device-side gather + wave scan: one dispatch per
@@ -2396,6 +2493,10 @@ class WhatIfEngine:
                 args = (dc, states, srcs[0], srcs[1], idx_chunks[ci])
                 if dyn_sharded is not None:
                     args = args + (dyn_sharded,)
+                elif pol_d is not None:
+                    args = args + (None,)  # dyn slot
+                if pol_d is not None:
+                    args = args + (pol_d,)
                 states, out = self._chunk_fn(*args)
             else:
                 slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
@@ -2410,9 +2511,16 @@ class WhatIfEngine:
                     args = (dc, states, slots, extra)
                     if dyn_sharded is not None:
                         args = args + (dyn_sharded,)
+                    elif pol_d is not None:
+                        args = args + (None,)  # dyn slot
+                    if pol_d is not None:
+                        args = args + (pol_d,)
                     states, out = self._chunk_fn(*args)
                 else:
-                    states, out = self._chunk_fn(dc, states, slots)
+                    args = (dc, states, slots)
+                    if pol_d is not None:
+                        args = args + (pol_d,)
+                    states, out = self._chunk_fn(*args)
             if pre_comp:
                 # Deferred eviction-aware fold (round 6): fetch only the
                 # [S] eviction summary now; the previous chunk resolves

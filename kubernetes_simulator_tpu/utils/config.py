@@ -112,6 +112,38 @@ class ChaosSpec:
 
 
 @dataclass
+class TuneSpec:
+    """Policy-tuner section (``tune:`` YAML, round 9 — sim.tuner). Drives
+    ``cmd_tune`` / ``Simulator.tune()``: a seeded search over the Score
+    policy surface of the ``profile:`` scheduler against scenarios derived
+    from the config's cluster/workload. ``objective`` maps metric name →
+    weight (maximized; costs use negative weights). ``scenarios`` holds
+    the train/held-out split sizes plus the perturbation sampler knobs;
+    ``weight_bounds`` overrides the default search range for every weight
+    column; ``output`` is the trajectory JSONL sink (falls back to the
+    top-level ``output``)."""
+
+    algo: str = "cem"
+    population: int = 16
+    rounds: int = 6
+    seed: int = 0
+    elite_frac: float = 0.25
+    objective: Optional[Dict[str, float]] = None
+    train_scenarios: int = 4
+    heldout_scenarios: int = 2
+    scenario_seed: int = 0
+    node_down_p: float = 0.02
+    capacity_p: float = 0.3
+    taint_p: float = 0.1
+    weight_bounds: Optional[List[float]] = None
+    tune_strategy: bool = True
+    mesh: bool = False
+    cpu_oracle: bool = True
+    cpu_envelope: float = 1e-6
+    output: Optional[str] = None
+
+
+@dataclass
 class TelemetrySpec:
     """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
 
@@ -146,6 +178,7 @@ class SimConfig:
     borg: Optional[BorgWorkloadSpec] = None
     framework: FrameworkConfig = field(default_factory=FrameworkConfig)
     whatif: WhatIfSpec = field(default_factory=WhatIfSpec)
+    tune: Optional[TuneSpec] = None
     chaos: Optional[ChaosSpec] = None
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     output: Optional[str] = None
@@ -224,6 +257,32 @@ class SimConfig:
             completions=_coerce_completions(wi.get("completions")),
             retry_buffer=int(wi.get("retryBuffer", 0)),
         )
+        tu = d.get("tune")
+        if tu is not None:
+            sc = tu.get("scenarios", {}) or {}
+            wb = tu.get("weightBounds")
+            cfg.tune = TuneSpec(
+                algo=str(tu.get("algo", "cem")),
+                population=int(tu.get("population", 16)),
+                rounds=int(tu.get("rounds", 6)),
+                seed=int(tu.get("seed", 0)),
+                elite_frac=float(tu.get("eliteFrac", 0.25)),
+                objective=tu.get("objective"),
+                train_scenarios=int(sc.get("train", 4)),
+                heldout_scenarios=int(sc.get("heldout", 2)),
+                scenario_seed=int(sc.get("seed", 0)),
+                node_down_p=float(sc.get("nodeDownP", 0.02)),
+                capacity_p=float(sc.get("capacityP", 0.3)),
+                taint_p=float(sc.get("taintP", 0.1)),
+                weight_bounds=(
+                    [float(wb[0]), float(wb[1])] if wb is not None else None
+                ),
+                tune_strategy=bool(tu.get("tuneStrategy", True)),
+                mesh=bool(tu.get("mesh", False)),
+                cpu_oracle=bool(tu.get("cpuOracle", True)),
+                cpu_envelope=float(tu.get("cpuEnvelope", 1e-6)),
+                output=tu.get("output"),
+            )
         ch = d.get("chaos")
         if ch is not None:
             cfg.chaos = ChaosSpec(

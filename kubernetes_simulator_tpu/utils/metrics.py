@@ -25,7 +25,11 @@ if not log.handlers:
 #   v1 — rows carried only "ts" + payload (implicit, unversioned).
 #   v2 — every row stamped with "schema" plus writer context
 #        (seed / engine / config_hash from the CLI).
+#   v3 — tuner rows (sim.tuner): "run_type" required, "ts" optional —
+#        trajectory files are bit-deterministic for a fixed seed + config,
+#        so no wall-clock fields. Non-tuner rows stay v2.
 SCHEMA_VERSION = 2
+TUNE_SCHEMA_VERSION = 3
 
 
 def config_hash(cfg_dict: dict) -> str:
@@ -48,8 +52,11 @@ class JsonlWriter:
         self.context = dict(context or {})
         self._f: Optional[IO] = open(path, "a") if path else None
 
-    def write(self, row: dict) -> None:
-        row = {"ts": time.time(), "schema": SCHEMA_VERSION, **self.context, **row}
+    def write(self, row: dict, stamp_ts: bool = True) -> None:
+        # stamp_ts=False drops the wall-clock stamp — the policy tuner's
+        # trajectory rows must be byte-identical across same-seed runs.
+        stamp = {"ts": time.time()} if stamp_ts else {}
+        row = {**stamp, "schema": SCHEMA_VERSION, **self.context, **row}
         line = json.dumps(row)
         if self._f:
             self._f.write(line + "\n")

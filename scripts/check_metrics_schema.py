@@ -70,6 +70,76 @@ _OPTIONAL = {
 
 _TEL_GRANULARITIES = ("summary", "series", "timeline")
 
+# v3 (policy tuner, sim.tuner): "run_type" is required and "ts" becomes
+# OPTIONAL — trajectory rows are bit-deterministic for a fixed seed +
+# config, so the writer omits the wall-clock stamp (JsonlWriter
+# stamp_ts=False). The CLI context stamp (seed/engine/config_hash) is
+# optional too: API-driven tuner runs write without a context.
+_BASE_V3 = {
+    "schema": int,
+    "run_type": str,
+    "kind": str,
+}
+_OPTIONAL_V3 = {
+    "ts": _NUM,
+    "seed": int,
+    "engine": str,
+    "config_hash": str,
+    "config": str,
+}
+_TUNE_CAND_REQUIRED = {
+    "round": int,
+    "candidate": int,
+    "policy": dict,
+    "objective": _NUM,
+    "split": str,
+}
+_TUNE_ROUND_REQUIRED = {
+    "round": int,
+    "best_objective": _NUM,
+    "round_best_objective": _NUM,
+    "mean_objective": _NUM,
+    "best_candidate": int,
+}
+_TUNE_RESULT_REQUIRED = {
+    "best_policy": dict,
+    "train_objective": _NUM,
+    "heldout_objective": _NUM,
+    "default_heldout_objective": _NUM,
+    "cpu_objective": (*_NUM, type(None)),
+    "cpu_envelope": (*_NUM, type(None)),
+    "rounds": int,
+    "population": int,
+    "evaluations": int,
+    "objective_weights": dict,
+    "algo": str,
+}
+
+
+def _validate_v3(row: dict) -> List[str]:
+    errs = []
+    for k, t in _BASE_V3.items():
+        v = row.get(k)
+        if v is None or not isinstance(v, t) or isinstance(v, bool):
+            errs.append(f"{k}: expected {t}, got {v!r}")
+    for k, t in _OPTIONAL_V3.items():
+        if k in row and (not isinstance(row[k], t) or isinstance(row[k], bool)):
+            errs.append(f"{k}: expected {t}, got {row[k]!r}")
+    kind = row.get("kind")
+    if isinstance(kind, str):
+        required = {
+            "tune-candidate": _TUNE_CAND_REQUIRED,
+            "tune-round": _TUNE_ROUND_REQUIRED,
+            "tune-result": _TUNE_RESULT_REQUIRED,
+        }.get(kind)
+        if required is None:
+            return errs + [f"kind: unknown {kind!r}"]
+        for k, t in required.items():
+            v = row.get(k)
+            if not isinstance(v, t) or (isinstance(v, bool) and t is not bool):
+                errs.append(f"{k}: expected {t}, got {v!r}")
+    return errs
+
 
 def _check_telemetry(tel: dict) -> List[str]:
     errs = []
@@ -103,6 +173,8 @@ def validate_row(row: dict) -> List[str]:
         # v1 (pre-versioning) rows: "ts" + payload only; accepted as-is
         # so old result files keep validating.
         return [] if isinstance(row.get("ts"), _NUM) else ["ts: missing"]
+    if schema == 3:
+        return _validate_v3(row)
     if schema != 2:
         return [f"schema: unknown version {schema!r}"]
     for k, t in _BASE_V2.items():
@@ -165,7 +237,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for e in all_errs:
         print(e)
     if not all_errs:
-        print(f"ok: {len(argv)} file(s) validate against schema v2")
+        print(f"ok: {len(argv)} file(s) validate against schema v2/v3")
     return 1 if all_errs else 0
 
 

@@ -257,3 +257,33 @@ def test_seeded_chaos_slice():
         assert dev.evict_rescheduled == cpu.evict_rescheduled, f"seed {seed}"
         total += dev.evictions
     assert total > 0  # non-vacuous across the slice
+
+
+def test_cli_chaos_envelope_warning(caplog):
+    """Config-validation-time envelope guard: chaos events beyond the
+    trace's last arrival warn loudly — device engines replay no chunks
+    past the final wave, so those events could only ever fire on the CPU
+    engine (usually a mis-set chaos.horizon)."""
+    import logging
+
+    from kubernetes_simulator_tpu.cli import _chaos_timeline
+    from kubernetes_simulator_tpu.utils.config import SimConfig
+
+    ec, ep = _light_trace(num_pods=28, num_nodes=5)  # last arrival t=27
+    cfg = SimConfig.from_dict({
+        "chaos": {"horizon": 1000.0, "mtbf": 50.0, "mttr": 10.0,
+                  "nodeFraction": 1.0},
+    })
+    with caplog.at_level(logging.WARNING, logger="k8sim"):
+        events = _chaos_timeline(cfg, ec, ep, seed=0)
+    assert any(e.time > 27.0 for e in events)
+    assert "beyond the trace's last arrival" in caplog.text
+    # Default horizon (None -> last arrival) stays inside the envelope.
+    caplog.clear()
+    cfg = SimConfig.from_dict({
+        "chaos": {"mtbf": 5.0, "mttr": 2.0, "nodeFraction": 1.0},
+    })
+    with caplog.at_level(logging.WARNING, logger="k8sim"):
+        events = _chaos_timeline(cfg, ec, ep, seed=0)
+    assert events and all(e.time <= 27.0 for e in events)
+    assert "beyond the trace's last arrival" not in caplog.text

@@ -303,3 +303,37 @@ def test_chrome_trace_export(tmp_path):
         if e["ph"] == "X" and e.get("pid") == 0 and e["name"].startswith("pod"):
             p = int(e["name"][3:])
             assert e["tid"] == int(res.assignments[p])
+
+
+def test_series_attribution_fallback_notes(caplog, tmp_path):
+    """series+ attribution fallback pin: in-scan tier preemption and
+    checkpoint/resume each disable the instrumented chunk program with a
+    log note — placements stay unchanged and latency/phase telemetry is
+    still collected; only ``reasons`` goes dark."""
+    import logging
+
+    ec, ep = _reject_trace()
+    cfg = FrameworkConfig()
+    # Tier preemption: the instrumented program has no tier planes.
+    ref = JaxReplayEngine(ec, ep, cfg, wave_width=1, chunk_waves=1,
+                          preemption=True, telemetry="summary").replay()
+    with caplog.at_level(logging.INFO, logger="k8sim"):
+        res = JaxReplayEngine(ec, ep, cfg, wave_width=1, chunk_waves=1,
+                              preemption=True, telemetry="series").replay()
+    assert "not available with in-scan tier preemption" in caplog.text
+    np.testing.assert_array_equal(ref.assignments, res.assignments)
+    assert res.telemetry is not None and not res.telemetry.reasons
+    assert res.telemetry.latency["count"] == res.placed
+    # Checkpointing: the instrumented carry is not part of checkpoints.
+    caplog.clear()
+    plain = JaxReplayEngine(ec, ep, cfg, wave_width=1, chunk_waves=1,
+                            telemetry="series").replay()
+    with caplog.at_level(logging.INFO, logger="k8sim"):
+        ck = JaxReplayEngine(ec, ep, cfg, wave_width=1, chunk_waves=1,
+                             telemetry="series").replay(
+            checkpoint_path=str(tmp_path / "ck.npz"), checkpoint_every=2,
+        )
+    assert "disabled under checkpoint/resume" in caplog.text
+    np.testing.assert_array_equal(plain.assignments, ck.assignments)
+    assert ck.telemetry is not None and not ck.telemetry.reasons
+    assert plain.telemetry.reasons is not None  # instrumented run still works

@@ -1,0 +1,328 @@
+"""Policy tuner (round 9, sim.tuner): traced policy-vector parity vs the
+static-weight programs, single-compile population sweeps, search
+improvement on the held-out split, the CPU-oracle envelope, trajectory
+determinism, and schema-v3 JSONL validation."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.ops import tpu as T
+from kubernetes_simulator_tpu.parallel.mesh import fit_population, make_mesh
+from kubernetes_simulator_tpu.plugins.builtin import tunable_parameters
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+from kubernetes_simulator_tpu.sim.tuner import (
+    PolicyTuner,
+    SearchSpace,
+    make_objective,
+)
+from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+sys.path.insert(0, os.path.abspath(_SCRIPTS))
+
+from check_metrics_schema import validate_file, validate_row  # noqa: E402
+
+
+def small_case(seed=0, n=15, p=80):
+    cluster = make_cluster(n, seed=seed, taint_fraction=0.1)
+    pods, _ = make_workload(p, seed=seed, with_affinity=True, with_spread=True,
+                            with_tolerations=True)
+    return encode(cluster, pods)
+
+
+def _tile(vec, s):
+    return np.repeat(np.asarray(vec, np.float32)[None], s, axis=0)
+
+
+def _default_vec(config=None):
+    return SearchSpace.from_config(config).defaults
+
+
+# -- traced policy vector vs the static-weight program ---------------------
+
+
+def test_default_policy_vector_matches_static():
+    """Weights equal to the config's own ⇒ bit-identical placements: the
+    per-row normalize extrema are weight-independent, so a traced weight
+    with the static value reproduces the static fold exactly."""
+    ec, ep = small_case()
+    cfg = FrameworkConfig()
+    base = WhatIfEngine(ec, ep, [Scenario()] * 3, cfg,
+                        collect_assignments=True).run()
+    pol = WhatIfEngine(ec, ep, [Scenario()] * 3, cfg,
+                       collect_assignments=True,
+                       policies=_tile(_default_vec(cfg), 3)).run()
+    assert (base.assignments == pol.assignments).all()
+    assert (np.asarray(base.placed) == np.asarray(pol.placed)).all()
+
+
+def test_nondefault_weights_and_strategy_parity():
+    """A non-default weight vector + the MostAllocated selector must match
+    a static config carrying the same weights and strategy."""
+    ec, ep = small_case(seed=2)
+    weights = {"NodeResourcesFit": 2.5, "TaintToleration": 0.5,
+               "NodeAffinity": 4.0, "InterPodAffinity": 1.5,
+               "PodTopologySpread": 3.0}
+    static_cfg = FrameworkConfig().with_policy(
+        weights, fit_strategy="MostAllocated"
+    )
+    static = WhatIfEngine(ec, ep, [Scenario()] * 2, static_cfg,
+                          collect_assignments=True).run()
+    vec = np.array([weights[n] for n in T.POLICY_WEIGHT_COLS] + [0.0],
+                   np.float32)  # fit_least=0 → MostAllocated
+    traced = WhatIfEngine(ec, ep, [Scenario()] * 2, FrameworkConfig(),
+                          collect_assignments=True,
+                          policies=_tile(vec, 2)).run()
+    assert (static.assignments == traced.assignments).all()
+
+
+def test_per_scenario_policies_differ():
+    """Different vectors on different scenarios of ONE batch actually
+    produce the per-policy outcomes (the population sweep mechanism)."""
+    ec, ep = small_case(seed=1)
+    cfg = FrameworkConfig()
+    least = _default_vec(cfg).copy()
+    most = least.copy()
+    most[T.IDX_FIT_LEAST] = 0.0
+    batch = WhatIfEngine(ec, ep, [Scenario()] * 2, cfg,
+                         collect_assignments=True,
+                         policies=np.stack([least, most])).run()
+    ref_most = WhatIfEngine(
+        ec, ep, [Scenario()],
+        FrameworkConfig().with_policy({}, fit_strategy="MostAllocated"),
+        collect_assignments=True,
+    ).run()
+    ref_least = WhatIfEngine(ec, ep, [Scenario()], cfg,
+                             collect_assignments=True).run()
+    assert (batch.assignments[0] == ref_least.assignments[0]).all()
+    assert (batch.assignments[1] == ref_most.assignments[0]).all()
+
+
+def test_population_sweep_single_compile():
+    """set_policies swaps values only — the chunk program must not
+    recompile across rounds (the tuner's whole-search pin)."""
+    ec, ep = small_case(seed=3, n=10, p=48)
+    rng = np.random.default_rng(0)
+    S = 6
+    eng = WhatIfEngine(ec, ep, [Scenario()] * S, FrameworkConfig(),
+                       policies=_tile(_default_vec(), S))
+    eng.run()
+    for _ in range(3):
+        vals = rng.uniform(0.0, 10.0, size=(S, len(T.POLICY_COLS)))
+        vals[:, T.IDX_FIT_LEAST] = (rng.random(S) < 0.5)
+        eng.set_policies(vals.astype(np.float32))
+        res = eng.run()
+        assert res.placed.shape == (S,)
+    assert eng._chunk_fn._cache_size() == 1
+
+
+def test_mesh_policy_sweep_matches_vmap():
+    ec, ep = small_case(seed=4, n=12, p=64)
+    cfg = FrameworkConfig()
+    S = 8
+    rng = np.random.default_rng(5)
+    pol = rng.uniform(0.0, 8.0, size=(S, len(T.POLICY_COLS))).astype(np.float32)
+    pol[:, T.IDX_FIT_LEAST] = (rng.random(S) < 0.5)
+    vmapped = WhatIfEngine(ec, ep, [Scenario()] * S, cfg, policies=pol).run()
+    meshed = WhatIfEngine(ec, ep, [Scenario()] * S, cfg, policies=pol,
+                          mesh=make_mesh()).run()
+    assert (np.asarray(vmapped.placed) == np.asarray(meshed.placed)).all()
+    assert (
+        np.asarray(vmapped.unschedulable) == np.asarray(meshed.unschedulable)
+    ).all()
+
+
+# -- guard rails -----------------------------------------------------------
+
+
+def test_policies_rejected_on_unsupported_paths():
+    # Finite durations so retry_buffer itself is a VALID configuration —
+    # the error under test is the policies gate, not the retry gate.
+    cluster = make_cluster(8, seed=0)
+    pods, _ = make_workload(32, seed=0, duration_mean=0.5)
+    ec, ep = encode(cluster, pods)
+    pol = _tile(_default_vec(), 2)
+    with pytest.raises(ValueError, match="policies"):
+        WhatIfEngine(ec, ep, [Scenario()] * 2, FrameworkConfig(),
+                     policies=pol, retry_buffer=8)
+    with pytest.raises(ValueError, match="policies"):
+        WhatIfEngine(ec, ep, [Scenario()] * 2, FrameworkConfig(),
+                     policies=pol, preemption="tier")
+
+
+def test_set_policies_shape_checked():
+    ec, ep = small_case(seed=0, n=8, p=32)
+    eng = WhatIfEngine(ec, ep, [Scenario()] * 2, FrameworkConfig(),
+                       policies=_tile(_default_vec(), 2))
+    with pytest.raises(ValueError):
+        eng.set_policies(np.zeros((3, len(T.POLICY_COLS)), np.float32))
+    with pytest.raises(ValueError):
+        eng.set_policies(np.zeros((2, 3), np.float32))
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="unknown objective term"):
+        make_objective({"nope": 1.0})
+    with pytest.raises(ValueError, match="at least one term"):
+        make_objective({})
+
+
+def test_tunable_parameters_surface():
+    params = {p["name"]: p for p in tunable_parameters(None)}
+    assert list(params)[:5] == list(T.POLICY_WEIGHT_COLS)
+    assert params["NodeResourcesFit.strategy"]["enabled"]
+    # A plugin outside the config's list is marked disabled (its rows are
+    # statically absent from the device program — searching it is noise).
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    params = {p["name"]: p for p in tunable_parameters(cfg)}
+    assert not params["TaintToleration"]["enabled"]
+    # Ratio base strategy has no traced selector.
+    cfg = FrameworkConfig(plugins=[{
+        "name": "NodeResourcesFit",
+        "args": {"strategy": "RequestedToCapacityRatio"},
+    }])
+    params = {p["name"]: p for p in tunable_parameters(cfg)}
+    assert not params["NodeResourcesFit.strategy"]["enabled"]
+
+
+def test_fit_population_rounds_up():
+    assert fit_population(5, 3, None) == 5
+    mesh = make_mesh()
+    P = fit_population(5, 3, mesh)
+    assert P >= 5 and (P * 3) % mesh.devices.size == 0
+
+
+# -- the search itself -----------------------------------------------------
+
+
+def _fragmentation_case():
+    """4 identical nodes × 4 cpu; 8 one-cpu pods arrive before two 4-cpu
+    pods. The default LeastAllocated spreads the small pods two per node
+    (no node can then host a large pod: 2 unschedulable); MostAllocated
+    packs them onto two nodes and places everything — a policy the search
+    must discover for a strict held-out win."""
+    nodes = [Node(f"n{i}", capacity={"cpu": 4.0, "memory": 16.0})
+             for i in range(4)]
+    pods = [
+        Pod(f"small-{i}", requests={"cpu": 1.0, "memory": 1.0},
+            arrival_time=float(i))
+        for i in range(8)
+    ] + [
+        Pod(f"large-{i}", requests={"cpu": 4.0, "memory": 4.0},
+            arrival_time=float(8 + i))
+        for i in range(2)
+    ]
+    return encode(Cluster(nodes=nodes), pods)
+
+
+def test_tune_beats_default_on_heldout(tmp_path):
+    ec, ep = _fragmentation_case()
+    tuner = PolicyTuner(
+        ec, ep, FrameworkConfig(),
+        algo="cem", population=8, rounds=4, seed=0,
+        train_scenarios=2, heldout_scenarios=2, scenario_seed=1,
+        p_node_down=0.0, p_capacity=0.25, p_taint=0.0,
+        chunk_waves=4,
+    )
+    res = tuner.run()
+    assert res.compile_count == 1  # whole search, one executable
+    assert res.best_policy["fitStrategy"] == "MostAllocated"
+    assert res.heldout_objective > res.default_heldout_objective
+    assert res.improved()
+    # CPU oracle: greedy_replay with the winning weights re-derives the
+    # device objective within the pinned envelope.
+    assert res.cpu_envelope is not None
+    assert res.cpu_envelope <= 1e-6
+    assert res.evaluations == 4 * 8 * 2
+
+
+def test_random_search_also_finds_packing():
+    ec, ep = _fragmentation_case()
+    res = PolicyTuner(
+        ec, ep, FrameworkConfig(),
+        algo="random", population=8, rounds=3, seed=2,
+        train_scenarios=2, heldout_scenarios=1, scenario_seed=1,
+        p_node_down=0.0, p_capacity=0.25, p_taint=0.0,
+        chunk_waves=4, cpu_oracle=False,
+    ).run()
+    assert res.best_policy["fitStrategy"] == "MostAllocated"
+    assert res.heldout_objective >= res.default_heldout_objective
+
+
+# -- trajectory JSONL: determinism + schema v3 -----------------------------
+
+
+def _tune_config(tmp_path, out_name):
+    out = tmp_path / out_name
+    cfg = tmp_path / f"{out_name}.yaml"
+    cfg.write_text(
+        "cluster:\n  synthetic: {nodes: 8, seed: 0}\n"
+        "workload:\n  synthetic: {pods: 40, seed: 1}\n"
+        "chunkWaves: 8\n"
+        "tune:\n"
+        "  algo: cem\n  population: 4\n  rounds: 2\n  seed: 3\n"
+        "  objective: {placementRate: 1.0, unschedulable: -0.001}\n"
+        "  scenarios: {train: 2, heldout: 1, seed: 0}\n"
+        f"  output: {out}\n"
+    )
+    return cfg, out
+
+
+def test_trajectory_deterministic_and_schema_v3(tmp_path):
+    """Same seed + config ⇒ byte-identical trajectory files (rows carry no
+    wall-clock), and every row validates as schema v3."""
+    from kubernetes_simulator_tpu.cli import main as cli_main
+
+    # The SAME config file twice (the context stamp hashes the config, so
+    # a config differing only in output path would differ legitimately);
+    # the output is renamed away between runs since JsonlWriter appends.
+    cfg_a, out_a = _tune_config(tmp_path, "a.jsonl")
+    assert cli_main(["tune", str(cfg_a)]) == 0
+    first = tmp_path / "first.jsonl"
+    out_a.rename(first)
+    assert cli_main(["tune", str(cfg_a)]) == 0
+    bytes_a = out_a.read_bytes()
+    assert bytes_a == first.read_bytes()
+    assert validate_file(str(out_a)) == []
+    rows = [json.loads(l) for l in bytes_a.decode().splitlines()]
+    assert all(r["schema"] == 3 and r["run_type"] == "tune" for r in rows)
+    assert all("ts" not in r for r in rows)
+    kinds = {r["kind"] for r in rows}
+    assert kinds == {"tune-candidate", "tune-round", "tune-result"}
+    final = rows[-1]
+    assert final["kind"] == "tune-result"
+    assert {"best_policy", "heldout_objective",
+            "default_heldout_objective"} <= final.keys()
+
+
+def test_schema_v3_checker_rejects_malformed():
+    good = {"schema": 3, "run_type": "tune", "kind": "tune-round",
+            "round": 0, "best_objective": 1.0, "round_best_objective": 1.0,
+            "mean_objective": 0.5, "best_candidate": 0}
+    assert validate_row(good) == []
+    assert any("run_type" in e for e in validate_row(
+        {"schema": 3, "kind": "tune-round"}))
+    assert any("kind: unknown" in e for e in validate_row(
+        {"schema": 3, "run_type": "tune", "kind": "tune-bogus"}))
+    assert any("objective" in e for e in validate_row(
+        {"schema": 3, "run_type": "tune", "kind": "tune-candidate",
+         "round": 0, "candidate": 1, "policy": {}, "split": "train"}))
+
+
+def test_cmd_tune_validates_objective_terms(tmp_path):
+    from kubernetes_simulator_tpu.cli import main as cli_main
+
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text(
+        "cluster:\n  synthetic: {nodes: 4, seed: 0}\n"
+        "workload:\n  synthetic: {pods: 16, seed: 0}\n"
+        "tune:\n  objective: {latencyP99: -1.0}\n"
+    )
+    assert cli_main(["tune", str(cfg)]) == 2
