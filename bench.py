@@ -14,12 +14,26 @@ a durationless (arrivals-only) run ships in ``detail`` for cross-round
 continuity with r01–r03. CPU rate is measured on a pod subsample of the
 same workload (it is orders of magnitude slower).
 
-Env knobs: BENCH_NODES, BENCH_PODS, BENCH_SCENARIOS, BENCH_CPU_PODS,
-BENCH_RUNS, BENCH_DURATION_MEAN (seconds; 0 disables durations),
-BENCH_TUNE_POP / BENCH_TUNE_SCEN (the ``tune_popsweep`` detail headline:
-candidate-policies/sec through the policy tuner's batched sweep — the
-config2 search space, i.e. the full default plugin set's 5 Score weights
-plus the NodeResourcesFit strategy selector; 0 population disables).
+Round 10: the headline is MESH-DEFAULT. When >1 accelerator is visible
+the what-if engine runs shard_map over all of them and the scenario
+count scales with the device count (BENCH_SCENARIOS per device — 128 ×
+8 = 1024 on a v5e-8), with weak/strong-scaling reference runs in
+``detail.scaling`` (see README § Performance for how to read them).
+``n_devices`` / ``mesh_shape`` / ``scenarios`` are stamped at the TOP
+level of the JSON line so BENCH_r0*.json rounds stay comparable across
+configurations. On one device everything falls back to the r05
+single-chip protocol unchanged. The durationless continuity run and the
+tuner sweep intentionally STAY single-chip/per-device-shaped — they are
+the cross-round continuity anchors.
+
+Env knobs: BENCH_NODES, BENCH_PODS, BENCH_SCENARIOS (per device),
+BENCH_CPU_PODS, BENCH_RUNS, BENCH_REF_RUNS (timed runs for the scaling
+reference configurations), BENCH_DURATION_MEAN (seconds; 0 disables
+durations), BENCH_TUNE_POP / BENCH_TUNE_SCEN (the ``tune_popsweep``
+detail headline: candidate-policies/sec through the policy tuner's
+batched sweep — the config2 search space, i.e. the full default plugin
+set's 5 Score weights plus the NodeResourcesFit strategy selector; 0
+population disables).
 """
 
 from __future__ import annotations
@@ -45,11 +59,26 @@ def main():
 
     _cc()
 
+    import jax
+
     from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
     from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.parallel.mesh import make_mesh
     from kubernetes_simulator_tpu.sim.greedy import greedy_replay
     from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
     from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+    # Mesh-default headline (round 10): shard the scenario axis over every
+    # visible device; scenario count scales with the device count so each
+    # device keeps the r05 per-chip shape (weak-scaling protocol).
+    ndev = len(jax.devices())
+    mesh = make_mesh() if ndev > 1 else None
+    S_head = S * ndev if mesh is not None else S
+    mesh_shape = (
+        dict(zip(mesh.axis_names, (int(d) for d in mesh.devices.shape)))
+        if mesh is not None
+        else None
+    )
 
     cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
 
@@ -81,18 +110,76 @@ def main():
     # cross-round comparisons indistinguishable from noise (round-2
     # verdict); min/max/all walls ship in detail for spread inspection.
     runs = max(1, int(os.environ.get("BENCH_RUNS", 5)))
-    scenarios = uniform_scenarios(ec, S, seed=0)
-    eng = WhatIfEngine(ec, ep, scenarios, cfg, chunk_waves=512)
-    eng.run()  # warmup: compile + first execution
-    results = [eng.run() for _ in range(runs)]
-    walls = sorted(r.wall_clock_s for r in results)
-    med_wall = float(np.median(walls))
-    res = results[0]  # placement counts are identical across runs
+
+    def _timed(eng, n):
+        eng.run()  # warmup: compile + first execution
+        rs = [eng.run() for _ in range(n)]
+        ws = sorted(r.wall_clock_s for r in rs)
+        return rs[0], float(np.median(ws)), ws
+
+    res, med_wall, walls = _timed(
+        WhatIfEngine(
+            ec, ep, uniform_scenarios(ec, S_head, seed=0), cfg,
+            chunk_waves=512, mesh=mesh,
+        ),
+        runs,
+    )
     value = res.total_placed / med_wall if med_wall > 0 else 0.0
     vs = value / cpu_pps if cpu_pps > 0 else 0.0
 
+    # Weak/strong-scaling references (mesh only). Weak: the r05 per-chip
+    # shape (S scenarios, one device) — efficiency is per-device headline
+    # rate over that. Strong: the SAME total scenario count on one device
+    # — speedup is the headline rate over that. References get fewer
+    # timed runs (they exist for the ratio, not the headline).
+    scaling = {}
+    if mesh is not None:
+        runs_ref = max(1, int(os.environ.get("BENCH_REF_RUNS", 2)))
+        res_w, med_w, _ = _timed(
+            WhatIfEngine(
+                ec, ep, uniform_scenarios(ec, S, seed=0), cfg,
+                chunk_waves=512,
+            ),
+            runs_ref,
+        )
+        weak_pps = res_w.total_placed / med_w if med_w > 0 else 0.0
+        res_st, med_st, _ = _timed(
+            WhatIfEngine(
+                ec, ep, uniform_scenarios(ec, S_head, seed=0), cfg,
+                chunk_waves=512,
+            ),
+            runs_ref,
+        )
+        strong_pps = res_st.total_placed / med_st if med_st > 0 else 0.0
+        scaling = {
+            "scaling": {
+                "per_device_pps": round(value / ndev, 1),
+                "weak": {
+                    "single_chip_scenarios": S,
+                    "single_chip_pps": round(weak_pps, 1),
+                    "efficiency": round(
+                        (value / ndev) / weak_pps if weak_pps > 0 else 0.0, 3
+                    ),
+                },
+                "strong": {
+                    "single_chip_scenarios": S_head,
+                    "single_chip_pps": round(strong_pps, 1),
+                    "speedup": round(
+                        value / strong_pps if strong_pps > 0 else 0.0, 2
+                    ),
+                    "efficiency": round(
+                        value / strong_pps / ndev if strong_pps > 0 else 0.0,
+                        3,
+                    ),
+                },
+                "reference_timed_runs": runs_ref,
+            }
+        }
+
     # Arrivals-only continuity run (the r01–r03 protocol, same shape
     # minus durations) so rounds stay comparable across the change.
+    # Deliberately single-chip at the per-device scenario count: this is
+    # the cross-round anchor, so its configuration never moves.
     cont = {}
     if dur_mean:
         ec_c, ep_c = encode(cluster, _make_pods(None))
@@ -156,16 +243,23 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, %s)"
+                "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, %s, %d device%s)"
                 % (
-                    S, nodes, pods_n,
+                    S_head, nodes, pods_n,
                     "completions on"
                     if res.completions_on
                     else "arrivals-only",
+                    ndev, "" if ndev == 1 else "s",
                 ),
                 "value": round(value, 1),
                 "unit": "placements/sec",
                 "vs_baseline": round(vs, 2),
+                # Top-level provenance (round 10): rounds are only
+                # comparable within a configuration — stamp it where the
+                # round-over-round diff tooling looks first.
+                "n_devices": ndev,
+                "mesh_shape": mesh_shape,
+                "scenarios": S_head,
                 "detail": {
                     "jax_wall_median_s": round(med_wall, 3),
                     "jax_wall_min_s": round(walls[0], 3),
@@ -178,6 +272,7 @@ def main():
                     "cpu_default_path_pps": round(cpu_pps, 1),
                     "scenario0_placed": int(res.placed[0]),
                     "device": _device_kind(),
+                    **scaling,
                     **cont,
                     **tune_sweep,
                 },

@@ -731,6 +731,34 @@ def select_node(scores: jax.Array, feasible: jax.Array):
     return jnp.where(placed, choice.astype(jnp.int32), PAD), placed
 
 
+def masked_argmin(scores: jax.Array, mask: jax.Array):
+    """(choice i32, any bool) — lowest-index argmin over the masked
+    entries, in ONE variadic reduce (the ``select_node`` comparator with
+    the sign flipped). Selection is identical to
+    ``argmax(where(mask, -scores, -inf))`` + a separate ``any(mask)``
+    (numpy first-occurrence tie-break) but pays one pass instead of two —
+    the preempt-select's victim-node rank is the hot consumer (round 10
+    fused tier-preemption). ``choice`` is PAD when nothing is masked
+    in."""
+    masked = jnp.where(mask, -scores, NEG_INF)
+    iota = jax.lax.broadcasted_iota(jnp.int32, masked.shape, masked.ndim - 1)
+
+    def comb(a, b):
+        av, ai = a
+        bv, bi = b
+        better = (bv > av) | ((bv == av) & (bi < ai))
+        return jnp.where(better, bv, av), jnp.where(better, bi, ai)
+
+    mx, choice = jax.lax.reduce(
+        (masked, iota),
+        (np.float32(-np.inf), np.int32(np.iinfo(np.int32).max)),
+        comb,
+        dimensions=(masked.ndim - 1,),
+    )
+    ok = mx > NEG_INF
+    return jnp.where(ok, choice.astype(jnp.int32), PAD), ok
+
+
 def first_reject_counts(masks, failed) -> jax.Array:
     """[K] i32 — per-plugin first-reject node counts for one slot, the
     device form of the kube "0/N nodes available" attribution

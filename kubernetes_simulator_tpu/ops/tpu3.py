@@ -48,6 +48,7 @@ forks — sim/whatif.py gates and reports via ``WhatIfEngine.engine``).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import NamedTuple, Optional, Tuple
 
@@ -91,6 +92,19 @@ try:  # pragma: no cover - version-dependent
         _batching.primitive_batchers[_ob_p] = _ob_batch
 except Exception:
     pass
+
+# Round 10 (fused tier-preemption, PR 2's measured 4.1× standalone cost):
+# when on, the preemption wave program (a) packs the three prefix-over-
+# tiers stacks into ONE [Tt+1, R+2, N] tensor so each slot pays a single
+# dynamic gather instead of three, (b) takes the victim-node rank through
+# one variadic (value, index) reduce (tpu.masked_argmin) instead of
+# argmax + any, and (c) commits all Tt tier planes in one batched
+# einsum pass instead of a per-tier Python loop. Same summands in the
+# same w-order per output element — bit-identical to the pre-fusion
+# program (tests/test_preemption_device.py pins fused≡prefusion≡oracle).
+# Read at TRACE time: monkeypatch ops.tpu3.FUSED_PREEMPT (or set
+# KSIM_FUSED_PREEMPT=0) before building an engine to get the old program.
+FUSED_PREEMPT = os.environ.get("KSIM_FUSED_PREEMPT", "1") not in ("", "0")
 
 # ---------------------------------------------------------------------------
 # Static (per-trace) structure
@@ -1038,6 +1052,15 @@ def make_wave_step3(
             pfx_u = jnp.stack(pfx_u)  # [Tt+1, R, N]
             pfx_n = jnp.stack(pfx_n)  # [Tt+1, N]
             mts = jnp.stack(mts)  # [Tt+1, N]
+            if FUSED_PREEMPT:
+                # One packed [Tt+1, R+2, N] stack: each slot's tier gather
+                # becomes a single dynamic read (rows [:R] usage, row R
+                # pod counts, row R+1 max tier) instead of three. Pure
+                # layout — every element is the same f32 value the
+                # separate stacks hold.
+                pfx_pack = jnp.concatenate(
+                    [pfx_u, pfx_n[:, None, :], mts[:, None, :]], axis=1
+                )
             preempted = jnp.zeros((), bool)
             ev_node = jnp.asarray(PAD, jnp.int32)
             ev_tier = jnp.zeros((), jnp.int32)
@@ -1464,11 +1487,21 @@ def make_wave_step3(
             placed = any_f & s.valid
             if st.preemption:
                 tier_k = sx.tier[k]  # shared scalar
-                lt_u = jax.lax.dynamic_index_in_dim(
-                    pfx_u, tier_k, axis=0, keepdims=False
-                )  # [R, N] usage of tiers < tier_k (wave start)
-                lt_np = jax.lax.dynamic_index_in_dim(pfx_n, tier_k, 0, False)
-                mt0 = jax.lax.dynamic_index_in_dim(mts, tier_k, 0, False)
+                if FUSED_PREEMPT:
+                    pk = jax.lax.dynamic_index_in_dim(
+                        pfx_pack, tier_k, axis=0, keepdims=False
+                    )  # [R+2, N] packed lower-tier aggregates (wave start)
+                    lt_u = pk[:R]  # [R, N] usage of tiers < tier_k
+                    lt_np = pk[R]
+                    mt0 = pk[R + 1]
+                else:
+                    lt_u = jax.lax.dynamic_index_in_dim(
+                        pfx_u, tier_k, axis=0, keepdims=False
+                    )  # [R, N] usage of tiers < tier_k (wave start)
+                    lt_np = jax.lax.dynamic_index_in_dim(
+                        pfx_n, tier_k, 0, False
+                    )
+                    mt0 = jax.lax.dynamic_index_in_dim(mts, tier_k, 0, False)
                 lt_u_eff = [lt_u[r] for r in range(R)]
                 lt_np_eff = lt_np
                 mt_eff = mt0
@@ -1503,8 +1536,15 @@ def make_wave_step3(
                 # Rank (fewest victims, lowest max victim tier, lowest
                 # index) — exact small ints in f32; mirrors sim.greedy.
                 score = lt_np_eff * np.float32(1024.0) + mt_eff
-                pnode = jnp.argmax(jnp.where(cand, -score, -jnp.inf)).astype(jnp.int32)
-                p_ok = jnp.any(cand)
+                if FUSED_PREEMPT:
+                    # One variadic reduce for (victim node, any candidate)
+                    # — selection identical to the argmax + any pair.
+                    pnode, p_ok = T2.masked_argmin(score, cand)
+                else:
+                    pnode = jnp.argmax(
+                        jnp.where(cand, -score, -jnp.inf)
+                    ).astype(jnp.int32)
+                    p_ok = jnp.any(cand)
                 evict_k = p_ok & ~any_f & s.valid
                 node = jnp.where(evict_k, pnode, node)
                 placed = placed | evict_k
@@ -1613,24 +1653,59 @@ def make_wave_step3(
             used = used - jnp.stack([eu_acc[r] * oh_e for r in range(R)])
             nong = (sb.group == PAD).astype(jnp.float32)  # [W]
             tiers_w = sx.tier  # [W] shared
-            new_ut, new_np = [], []
-            for t in range(st.Tt):
-                zmask = (
-                    preempted & (jnp.asarray(t) < ev_tier)
-                ).astype(jnp.float32) * (iota_n == ev_node).astype(jnp.float32)
-                w_t = wv_used * nong * (tiers_w == t).astype(jnp.float32)
-                du = jnp.einsum(
-                    "w,wn,wr->rn", w_t, oh_all, sb.req,
+            if st.Tt and FUSED_PREEMPT:
+                # Batched tier commit: one [Tt, W] slot-weight one-hot and
+                # two einsums replace the per-tier Python loop (Tt× fewer
+                # passes over the [W, N] placement one-hot). Each
+                # (t, ·, n) output still reduces the SAME summands over w
+                # — bit-parity with the loop form.
+                wt_all = (
+                    wv_used[None, :]
+                    * nong[None, :]
+                    * (
+                        tiers_w[None, :] == jnp.arange(st.Tt)[:, None]
+                    ).astype(jnp.float32)
+                )  # [Tt, W]
+                du_all = jnp.einsum(
+                    "tw,wn,wr->trn", wt_all, oh_all, sb.req,
                     precision=_HI, preferred_element_type=jnp.float32,
                 )
-                dn = jnp.einsum(
-                    "w,wn->n", w_t, oh_all,
+                dn_all = jnp.einsum(
+                    "tw,wn->tn", wt_all, oh_all,
                     precision=_HI, preferred_element_type=jnp.float32,
                 )
-                new_ut.append(carry.used_tier[t] * (1.0 - zmask)[None, :] + du)
-                new_np.append(carry.npods_tier[t] * (1.0 - zmask) + dn)
-            used_tier = jnp.stack(new_ut) if st.Tt else carry.used_tier
-            npods_tier = jnp.stack(new_np) if st.Tt else carry.npods_tier
+                zmask_all = (
+                    preempted & (jnp.arange(st.Tt) < ev_tier)
+                ).astype(jnp.float32)[:, None] * (
+                    iota_n == ev_node
+                ).astype(jnp.float32)[None, :]  # [Tt, N]
+                used_tier = (
+                    carry.used_tier * (1.0 - zmask_all)[:, None, :] + du_all
+                )
+                npods_tier = carry.npods_tier * (1.0 - zmask_all) + dn_all
+            elif st.Tt:
+                new_ut, new_np = [], []
+                for t in range(st.Tt):
+                    zmask = (
+                        preempted & (jnp.asarray(t) < ev_tier)
+                    ).astype(jnp.float32) * (
+                        iota_n == ev_node
+                    ).astype(jnp.float32)
+                    w_t = wv_used * nong * (tiers_w == t).astype(jnp.float32)
+                    du = jnp.einsum(
+                        "w,wn,wr->rn", w_t, oh_all, sb.req,
+                        precision=_HI, preferred_element_type=jnp.float32,
+                    )
+                    dn = jnp.einsum(
+                        "w,wn->n", w_t, oh_all,
+                        precision=_HI, preferred_element_type=jnp.float32,
+                    )
+                    new_ut.append(
+                        carry.used_tier[t] * (1.0 - zmask)[None, :] + du
+                    )
+                    new_np.append(carry.npods_tier[t] * (1.0 - zmask) + dn)
+                used_tier = jnp.stack(new_ut)
+                npods_tier = jnp.stack(new_np)
         mc_dom, anti_dom, pref_dom = carry.mc_dom, carry.anti_dom, carry.pref_dom
         mc_host, anti_host, pref_host = carry.mc_host, carry.anti_host, carry.pref_host
         match_total = carry.match_total

@@ -96,12 +96,23 @@ def fit_population(population: int, per_candidate: int, mesh: Optional[Mesh]) ->
     perturbation sweeps shard. A mesh requires that flat axis to divide
     evenly over devices (WhatIfEngine raises otherwise), so the tuner
     rounds the population UP here and fills the extra rows with fresh
-    samples rather than failing or silently truncating. No-op without a
-    mesh."""
-    population = max(int(population), 1)
+    samples rather than failing or silently truncating — and LOGS the
+    padding (no silent caps): callers surface the requested vs. fitted
+    sizes in their result metadata (TuneResult.population_requested,
+    WhatIfResult.n_devices)."""
+    requested = population = max(int(population), 1)
     if mesh is None:
         return population
     ndev = int(mesh.devices.size)
     while (population * per_candidate) % ndev:
         population += 1
+    if population != requested:
+        from ..utils.metrics import log
+
+        log.info(
+            "fit_population: padded population %d -> %d (+%d rows) so the "
+            "flat axis (%d x %d) divides over %d mesh devices",
+            requested, population, population - requested,
+            population, per_candidate, ndev,
+        )
     return population

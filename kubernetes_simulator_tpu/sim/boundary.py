@@ -459,16 +459,30 @@ class BoundaryOps:
         """Run boundary ``b`` (start time ``t_chunk``). Returns
         ``(releases, binds, evictions)`` as (pods, nodes) int array pairs
         — the device engine turns them into carry-plane deltas; the
-        greedy anchor ignores them (its state IS self.st)."""
-        ec, ep, st = self.ec, self.ep, self.st
-        tel = self.tel
+        greedy anchor ignores them (its state IS self.st).
+
+        Split since round 10 into ``boundary_releases`` (passes 1–2) +
+        ``boundary_retry`` (pass 3): the release passes only read state
+        from chunks ≤ b−2 (the one-chunk slack pins the static mask to
+        ``bind_chunk < b−1`` and pend entries were scheduled ≥ one
+        boundary ahead), so the double-buffered runtime stages them
+        BEFORE folding chunk b−1 — overlapping host release bookkeeping
+        with device compute — while the retry pass, which reads the
+        folded planes through schedule_one, stays after the fold.
+        Composing the two here is byte-for-byte the old single pass."""
+        rel = self.boundary_releases(b, t_chunk)
+        binds, evicts = self.boundary_retry(b, t_chunk)
+        return rel, binds, evicts
+
+    def boundary_releases(self, b: int, t_chunk: float) -> PairArrays:
+        """Passes 1–2 of boundary ``b``: pend + static-bucket releases.
+        Safe to run before chunk b−1's fold (see ``boundary``)."""
+        st = self.st
         if np.isfinite(t_chunk):
             # Retry binds at the trailing (t=inf) boundary record latency
             # clamped to the last finite boundary time — the same
             # boundary-granular envelope the chaos reschedule latency uses.
             self._last_finite_t = float(t_chunk)
-        binds_l: List[Tuple[int, int]] = []
-        evicts_l: List[Tuple[int, int]] = []
         # 1. Pending releases of boundary-placed pods (relb encodes the
         # time comparison already — no finite-t gate).
         rel_pods: List[int] = []
@@ -503,9 +517,19 @@ class BoundaryOps:
             self._plane_op((b, 0), -1.0, rel_p, rel_n)
             st.bound[rel_p] = PAD
             self.released[rel_p] = True
-            rel = (rel_p, rel_n)
-        else:
-            rel = _empty_pairs()
+            return (rel_p, rel_n)
+        return _empty_pairs()
+
+    def boundary_retry(
+        self, b: int, t_chunk: float
+    ) -> Tuple[PairArrays, PairArrays]:
+        """Pass 3 of boundary ``b``: the bounded retry (+ kube
+        preemption) walk and the telemetry occupancy sample. Reads the
+        folded count planes — must run AFTER chunk b−1's fold."""
+        ec, ep, st = self.ec, self.ep, self.st
+        tel = self.tel
+        binds_l: List[Tuple[int, int]] = []
+        evicts_l: List[Tuple[int, int]] = []
         # 3. Bounded retry (+ kube preemption) pass, FIFO order. Victims
         # re-enter the walked queue and are attempted later in the SAME
         # pass — mirroring the CPU event engine, which requeues victims
@@ -618,4 +642,4 @@ class BoundaryOps:
             a = np.asarray(lst, np.int64)
             return a[:, 0], a[:, 1]
 
-        return rel, _pairs(binds_l), _pairs(evicts_l)
+        return _pairs(binds_l), _pairs(evicts_l)

@@ -556,6 +556,7 @@ class JaxReplayEngine:
         retry_buffer: int = 0,
         granularity_guard: bool = True,
         lazy_boundary: bool = True,
+        double_buffer: bool = True,
         telemetry=None,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
@@ -588,6 +589,15 @@ class JaxReplayEngine:
         choices fetch with the next chunk's dispatch; only a scalar
         failure count blocks per chunk. Bit-identical to the eager path
         (set False to force the old per-chunk blocking folds).
+        ``double_buffer`` (round 10, on top of lazy): stage boundary
+        b's RELEASE passes (sim.boundary.boundary_releases) before
+        blocking on chunk b−1's failure scalar — the host release
+        bookkeeping overlaps device compute instead of serializing after
+        the fetch. Exact by the one-chunk slack (the release decision
+        never reads chunk b−1); skipped per-boundary when chaos events
+        are due or series-level telemetry is sampling. Bit-identical
+        results and checkpoint blobs either way (pinned by
+        tests/test_double_buffer.py).
         ``telemetry``: granularity knob (str | sim.telemetry.TelemetryConfig
         | None → "summary"). "summary" never changes any device program
         (latency bookkeeping + phase timers only); "series" adds rejection
@@ -624,6 +634,7 @@ class JaxReplayEngine:
         self.kube = mode == "kube"
         self.retry_buffer = int(retry_buffer)
         self.lazy_boundary = bool(lazy_boundary)
+        self.double_buffer = bool(double_buffer)
         self.completions = completions
         self.granularity_guard = granularity_guard
         self.telemetry_cfg = TelemetryConfig.resolve(telemetry)
@@ -959,11 +970,36 @@ class JaxReplayEngine:
                     bops.fold_chunk(ci_p, rows_p, ch_np)
                 pending = None
 
+        dbuf = self.double_buffer and lazy
         t0 = time.perf_counter()
         try:
             for ci, c0 in enumerate(range(0, idx.shape[0], C)):
                 if ci < start_chunk:
                     continue
+                rel_staged = None
+                if (
+                    dbuf
+                    and pending is not None
+                    and not (tel is not None and tel.cfg.want_series)
+                    and not (
+                        pending_events
+                        and pending_events[0].time <= wave_times[c0]
+                    )
+                ):
+                    # Double-buffer (round 10): run boundary ci's RELEASE
+                    # passes before blocking on chunk ci-1's failure
+                    # scalar — the device is still computing, so this
+                    # host bookkeeping is free. Exact: the release
+                    # decision reads only chunks ≤ ci−2 (one-chunk
+                    # slack), and the op-log's key sort restores eager
+                    # flush order. Skipped when chaos events are due at
+                    # this boundary (eviction must precede the release
+                    # decision) or series telemetry samples (its depth
+                    # series reads post-fold state).
+                    with _tick("boundary_fold"):
+                        rel_staged = bops.boundary_releases(
+                            ci, wave_times[c0]
+                        )
                 if pending is not None and (
                     int(pending[3]) > 0 or bops.retry_q
                 ):
@@ -1014,7 +1050,15 @@ class JaxReplayEngine:
                         pending_events = pending_events[len(due):]
                         ev_applied += len(due)
                 with _tick("boundary_fold"):
-                    rel, binds, evicts = bops.boundary(ci, wave_times[c0])
+                    if rel_staged is not None:
+                        rel = rel_staged
+                        binds, evicts = bops.boundary_retry(
+                            ci, wave_times[c0]
+                        )
+                    else:
+                        rel, binds, evicts = bops.boundary(
+                            ci, wave_times[c0]
+                        )
                 if (
                     rel[0].size or binds[0].size or evicts[0].size or chaos_p
                 ):

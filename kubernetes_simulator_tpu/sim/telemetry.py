@@ -134,6 +134,13 @@ def latency_summary(
     }
 
 
+# Canonical phase-timer names instrumented by the replay engines. Scripts
+# (scripts/northstar.py, bench consumers) key on these strings when
+# attributing wall-clock, so they are API: renaming one is a breaking
+# change pinned by tests/test_telemetry.py.
+PHASE_NAMES = ("dispatch", "device_wait", "boundary_fold", "host_mirror")
+
+
 class PhaseTimers:
     """Accumulating wall-clock phase breakdown. ``tick(phase)`` returns a
     context manager; overhead is two ``perf_counter`` calls per use, so it

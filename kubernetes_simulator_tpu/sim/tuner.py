@@ -209,6 +209,13 @@ class TuneResult:
     cpu_objective: Optional[float] = None  # oracle mean over held-out
     cpu_envelope: Optional[float] = None  # |device − cpu|, None if skipped
     trajectory: List[dict] = field(default_factory=list)
+    # Mesh provenance (round 10, no silent caps): the population the
+    # caller ASKED for — ``population`` above is the fitted size after
+    # parallel.mesh.fit_population rounded it up for mesh divisibility —
+    # plus the device count the sweep actually ran on.
+    population_requested: Optional[int] = None
+    n_devices: int = 1
+    mesh_shape: Optional[dict] = None  # {axis_name: size} or None
 
     def improved(self) -> bool:
         return self.heldout_objective > self.default_heldout_objective
@@ -277,6 +284,7 @@ class PolicyTuner:
         self.mesh = mesh
         from ..parallel.mesh import fit_population
 
+        self.population_requested = int(population)
         self.population = fit_population(population, self.S_t, mesh)
         if self.population != population:
             log.info(
@@ -528,4 +536,16 @@ class PolicyTuner:
             cpu_objective=cpu_obj,
             cpu_envelope=cpu_env,
             trajectory=trajectory,
+            population_requested=self.population_requested,
+            n_devices=(
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            ),
+            mesh_shape=(
+                dict(zip(
+                    self.mesh.axis_names,
+                    (int(d) for d in self.mesh.devices.shape),
+                ))
+                if self.mesh is not None
+                else None
+            ),
         )

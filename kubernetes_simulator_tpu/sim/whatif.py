@@ -36,6 +36,7 @@ from ..parallel.mesh import (
     make_mesh,
     replicate_tree,
     replicated,
+    scenario_sharding,
     shard_scenario_tree,
 )
 from .jax_runtime import StepSpec, make_wave_step
@@ -469,6 +470,11 @@ class WhatIfResult:
     latency_p99: Optional[np.ndarray] = None  # [S] f64
     # Per-scenario ReplayTelemetry (kube batches at series+; else None).
     scenario_telemetry: Optional[list] = None
+    # Mesh provenance (round 10): which parallel configuration produced
+    # the numbers — bench rounds and tuner runs stamp these so results
+    # from different device counts are never silently compared.
+    n_devices: int = 1
+    mesh_shape: Optional[dict] = None  # {axis_name: size} or None
 
 
 class WhatIfEngine:
@@ -761,12 +767,20 @@ class WhatIfEngine:
         dev_ok = False
         if self.engine == "v3":
             s3 = self.static3
+            # Round 10: the device-release path runs UNDER A MESH too —
+            # the bucketed release fns and the vassign fold are
+            # per-scenario programs, so shard_map wraps them like the
+            # chunk program (replicated release tables, sharded
+            # state/vassign). Only label-perturbation DynTables batches
+            # stay off it there: their per-scenario domain-override
+            # corrections would need the override tables threaded through
+            # every bucketed release call's shard specs.
             dev_ok = bool(
-                self.mesh is None
-                and not collect_assignments
+                not collect_assignments
                 and not preemption
                 and not self.kube  # BoundaryOps owns releases in kube mode
                 and fork_checkpoint is None
+                and (self.mesh is None or self._dyn is None)
                 and s3.single_g[s3.mc_h_ids].all()
                 and s3.single_g[s3.anti_h_ids].all()
                 and s3.single_g[s3.pref_h_ids].all()
@@ -838,8 +852,8 @@ class WhatIfEngine:
             # semantics at a measured cost — see COVERAGE.md).
             s3 = self.static3
             why = []
-            if self.mesh is not None:
-                why.append("mesh")
+            if self.mesh is not None and self._dyn is not None:
+                why.append("mesh with label-perturbation DynTables")
             if collect_assignments:
                 why.append("collect_assignments")
             if preemption:
@@ -894,10 +908,10 @@ class WhatIfEngine:
                 # not the device retry pass — no device-release gate.
                 raise ValueError(
                     "retry_buffer requires the device-release completions "
-                    "path (v3 engine, finite durations, no mesh/"
+                    "path (v3 engine, finite durations, no "
                     "collect_assignments/preemption/fork, singleton "
                     "host-scale topologies) without label-perturbation "
-                    "DynTables"
+                    "DynTables (meshes are supported since round 10)"
                 )
         # Host-side completions need per-scenario choices even when the
         # caller only wants counts; the device path never fetches them.
@@ -944,14 +958,20 @@ class WhatIfEngine:
         self._chunk_fn = self._build_chunk_fn()
         # Device-resident slot sources (one upload per engine): the chunk
         # loop then gathers rows on device — see ops.tpu.SlotSource.
+        # Scenario-shared, so under a mesh they replicate ONCE and every
+        # device gathers its chunk rows locally (round 10: the mesh path
+        # stopped host-gathering slots per chunk).
         self._slot_srcs = None
-        if self.mesh is None and self.engine == "v3":
+        if self.engine == "v3":
             from ..ops import tpu3 as V3
 
-            self._slot_srcs = (
+            srcs = (
                 T.SlotSource.build(pods),
                 V3.ExtraSource.build(self.static3, pods.num_pods),
             )
+            if self.mesh is not None:
+                srcs = replicate_tree(self.mesh, srcs)
+            self._slot_srcs = srcs
 
     def set_policies(self, policies) -> None:
         """Swap the per-scenario policy VECTORS without rebuilding the
@@ -977,6 +997,38 @@ class WhatIfEngine:
         collect = self._need_choices
         spec, wave_width = self.spec, self.wave_width
         pol_on = self._policies is not None
+
+        def finalize(fn, axes, donate):
+            """jit the vmapped per-scenario program; under a mesh, wrap
+            it in shard_map first. shard_map, NOT jit-with-shardings: the
+            scenario axis is embarrassingly parallel, and shard_map makes
+            that a compile-time guarantee — each device runs the
+            per-scenario program on its local slice and the partitioner
+            never sees the whole computation. Under GSPMD (jit +
+            in_shardings) sharding propagation is free to "help" by
+            splitting REPLICATED slot-derived intermediates across
+            devices (wave-width-8 axes match the 8-device mesh) and
+            gathering them back — real all-gathers inside the chunk scan,
+            pinned absent by tests/test_mesh_hlo.py. The shard specs
+            derive from the vmap axes one-for-one: mapped (0) arguments
+            shard over the scenario axis, broadcast (None) arguments
+            replicate."""
+            if self.mesh is None:
+                return jax.jit(fn, donate_argnums=donate)
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            sh, rp = P(SCENARIO_AXIS), P()
+            return jax.jit(
+                shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=tuple(sh if a == 0 else rp for a in axes),
+                    out_specs=sh,
+                    check_rep=False,
+                ),
+                donate_argnums=donate,
+            )
 
         if self.engine == "v3":
             from ..ops import tpu3 as V3
@@ -1028,303 +1080,253 @@ class WhatIfEngine:
                 state, outs = jax.lax.scan(step, state, (slots, extra))
                 return state, outs
 
-            if self.mesh is None:
-                # Device-side slot gathers INSIDE the jitted program: one
-                # dispatch per chunk, only indices as per-chunk input
-                # (scenario-shared → gathered once, not per scenario).
-                def per_scenario_src(dc, state, src, xsrc, idx, dyn=None, wvec=None):
-                    slots = T.gather_slots_device(src, idx)
-                    from ..ops import tpu3 as V3m
+            # Device-side slot gathers INSIDE the jitted program: one
+            # dispatch per chunk, only indices as per-chunk input
+            # (scenario-shared → gathered once, not per scenario).
+            def per_scenario_src(dc, state, src, xsrc, idx, dyn=None, wvec=None):
+                slots = T.gather_slots_device(src, idx)
+                from ..ops import tpu3 as V3m
 
-                    extra = V3m.gather_extra_device(xsrc, idx)
-                    return per_scenario(dc, state, slots, extra, dyn, wvec)
+                extra = V3m.gather_extra_device(xsrc, idx)
+                return per_scenario(dc, state, slots, extra, dyn, wvec)
 
-                if self._completions_dev:
-                    def per_scenario_rel(
-                        dc, state, src, xsrc, idx, b, vassign, dyn=None,
-                        wvec=None,
+            if self._completions_dev:
+                def per_scenario_rel(
+                    dc, state, src, xsrc, idx, b, vassign, dyn=None,
+                    wvec=None,
+                ):
+                    # Static releases run in the separate bucketed
+                    # _release_fn BEFORE this call (ordering by data
+                    # dependency on state/vassign). Here: the normal
+                    # chunk scan + the WAVE-ORDER assignment fold —
+                    # a dynamic_update_slice (pure DMA), not a
+                    # [C·W]-index scatter: choices land at their flat
+                    # wave positions, which is exactly how the static
+                    # release lists address them (rel_pos).
+                    state, out = per_scenario_src(
+                        dc, state, src, xsrc, idx, dyn, wvec
+                    )
+                    choices, counts = out
+                    vassign = jax.lax.dynamic_update_slice(
+                        vassign,
+                        choices.reshape(-1),
+                        (b * idx.size,),
+                    )
+                    return state, vassign, counts
+
+                if self.retry_buffer:
+                    RB = self.retry_buffer
+                    RBW = RB // wave_width
+                    BIG = 1 << 30
+
+                    rel_core = self._release_core()
+
+                    def per_scenario_retry(
+                        dc, state, src, xsrc, mgt, antit, preft,
+                        prefwt, durt, tbt,
+                        idx, t_b, b,
+                        vassign, rbuf, rcount,
+                        pend_id, pend_node, pend_relb, rdrop,
                     ):
-                        # Static releases run in the separate bucketed
-                        # _release_fn BEFORE this call (ordering by data
-                        # dependency on state/vassign). Here: the normal
-                        # chunk scan + the WAVE-ORDER assignment fold —
-                        # a dynamic_update_slice (pure DMA), not a
-                        # [C·W]-index scatter: choices land at their flat
-                        # wave positions, which is exactly how the static
-                        # release lists address them (rel_pos).
-                        state, out = per_scenario_src(
-                            dc, state, src, xsrc, idx, dyn, wvec
+                        """The device-release chunk call with the
+                        bounded unschedulable-retry pass (semantics:
+                        sim.greedy.greedy_replay(retry_buffer=...)).
+                        Static releases ran in the separate bucketed
+                        _release_fn before this call. Order here:
+                        pend releases → retry pass → buffer
+                        compaction → main chunk scan (with failure
+                        appends) → assignment fold."""
+                        d = T.Derived.build(dc)
+                        cmasks = V3.class_masks(dc, d, st3, spec, reps)
+                        wave_step = V3.make_wave_step3(
+                            dc, d, sh3, st3, wave_width, spec, cmasks
                         )
-                        choices, counts = out
+                        # 1. releases of retried-placed pods whose
+                        # boundary arrived (relb encodes the f32 time
+                        # comparison already).
+                        due_p = (pend_id >= 0) & (pend_relb <= b)
+                        safe_p = jnp.clip(pend_id, 0)
+                        nd_p = jnp.where(due_p, pend_node, -1)
+                        state = rel_core(
+                            state, nd_p, src.requests[safe_p],
+                            mgt[safe_p], antit[safe_p],
+                            preft[safe_p], prefwt[safe_p],
+                        )
+                        # 2. bounded retry pass: the NORMAL wave step
+                        # over the buffer (empty slots are invalid
+                        # no-ops), FIFO order preserved by the wave
+                        # packing below.
+                        rb_waves = rbuf.reshape(RBW, wave_width)
+                        slots_r = T.gather_slots_device(src, rb_waves)
+                        extra_r = V3.gather_extra_device(xsrc, rb_waves)
+                        state, choices_r = jax.lax.scan(
+                            wave_step, state, (slots_r, extra_r)
+                        )
+                        flat_cr = choices_r.reshape(RB)
+                        placed_r = (flat_cr >= 0) & (rbuf >= 0)
+                        retry_placed = placed_r.sum().astype(jnp.int32)
+                        # 3. pend append (placed pods start NOW: f32
+                        # boundary search, at least b+1) + stable
+                        # compaction, drop-newest on overflow.
+                        dur_r = durt[jnp.clip(rbuf, 0)]
+                        rbn = jnp.searchsorted(
+                            tbt, t_b + dur_r, side="left"
+                        )
+                        relb_new = jnp.where(
+                            placed_r & (rbn < tbt.shape[0]),
+                            jnp.maximum(rbn, b + 1),
+                            BIG,
+                        ).astype(jnp.int32)
+                        add = placed_r & (relb_new < BIG)
+                        keep_old = (pend_id >= 0) & ~due_p
+                        ids_cat = jnp.concatenate([
+                            jnp.where(keep_old, pend_id, -1),
+                            jnp.where(add, rbuf, -1),
+                        ])
+                        node_cat = jnp.concatenate(
+                            [pend_node, flat_cr]
+                        )
+                        relb_cat = jnp.concatenate(
+                            [pend_relb, relb_new]
+                        )
+                        op = jnp.argsort(ids_cat < 0, stable=True)[:RB]
+                        pend_id = jnp.where(
+                            ids_cat[op] >= 0, ids_cat[op], -1
+                        ).astype(jnp.int32)
+                        pend_node = node_cat[op].astype(jnp.int32)
+                        pend_relb = relb_cat[op].astype(jnp.int32)
+                        # 4. rbuf compaction: placed pods leave; the
+                        # rest keep FIFO order.
+                        keep_q = (rbuf >= 0) & (flat_cr < 0)
+                        oq = jnp.argsort(~keep_q, stable=True)
+                        rbuf = jnp.where(
+                            keep_q[oq], rbuf[oq], -1
+                        ).astype(jnp.int32)
+                        rcount = keep_q.sum().astype(jnp.int32)
+                        # 5. main chunk scan with failure appends.
+                        slots = T.gather_slots_device(src, idx)
+                        extra = V3.gather_extra_device(xsrc, idx)
+
+                        def step(carry, xs):
+                            st, rbuf, rcount, rdrop = carry
+                            slots_w, extra_w, rows = xs
+                            st, choices = wave_step(
+                                st, (slots_w, extra_w)
+                            )
+                            placed_w = jnp.sum(
+                                (choices >= 0) & slots_w.valid
+                            ).astype(jnp.int32)
+                            fail = (
+                                (choices < 0)
+                                & slots_w.valid
+                                & (slots_w.group < 0)
+                            )
+                            posk = (
+                                rcount
+                                + jnp.cumsum(fail.astype(jnp.int32))
+                                - 1
+                            )
+                            pos = jnp.where(
+                                fail & (posk < RB), posk, RB
+                            )
+                            rbuf = rbuf.at[pos].set(rows, mode="drop")
+                            nfail = fail.sum().astype(jnp.int32)
+                            # Overflow drops the newest — COUNTED,
+                            # like the host BoundaryOps analogue
+                            # (pend overflow is not: there the pod
+                            # keeps its resources, not dropped).
+                            rdrop = rdrop + jnp.maximum(
+                                rcount + nfail - RB, 0
+                            )
+                            rcount = jnp.minimum(
+                                rcount + nfail, RB
+                            ).astype(jnp.int32)
+                            return (st, rbuf, rcount, rdrop), (
+                                choices, placed_w
+                            )
+
+                        (state, rbuf, rcount, rdrop), (
+                            choices, counts
+                        ) = jax.lax.scan(
+                            step,
+                            (state, rbuf, rcount, rdrop),
+                            (slots, extra, idx),
+                        )
+                        # 6. fold arrival-chunk placements at their
+                        # flat wave positions (retried placements do
+                        # NOT enter vassign: their releases ride pend
+                        # exclusively, and their arrival slot keeps
+                        # PAD so the static entry never fires).
                         vassign = jax.lax.dynamic_update_slice(
                             vassign,
                             choices.reshape(-1),
                             (b * idx.size,),
                         )
-                        return state, vassign, counts
-
-                    if self.retry_buffer:
-                        RB = self.retry_buffer
-                        RBW = RB // wave_width
-                        BIG = 1 << 30
-
-                        rel_core = self._release_core()
-
-                        def per_scenario_retry(
-                            dc, state, src, xsrc, mgt, antit, preft,
-                            prefwt, durt, tbt,
-                            idx, t_b, b,
-                            vassign, rbuf, rcount,
+                        return (
+                            state, vassign, rbuf, rcount,
                             pend_id, pend_node, pend_relb, rdrop,
-                        ):
-                            """The device-release chunk call with the
-                            bounded unschedulable-retry pass (semantics:
-                            sim.greedy.greedy_replay(retry_buffer=...)).
-                            Static releases ran in the separate bucketed
-                            _release_fn before this call. Order here:
-                            pend releases → retry pass → buffer
-                            compaction → main chunk scan (with failure
-                            appends) → assignment fold."""
-                            d = T.Derived.build(dc)
-                            cmasks = V3.class_masks(dc, d, st3, spec, reps)
-                            wave_step = V3.make_wave_step3(
-                                dc, d, sh3, st3, wave_width, spec, cmasks
-                            )
-                            # 1. releases of retried-placed pods whose
-                            # boundary arrived (relb encodes the f32 time
-                            # comparison already).
-                            due_p = (pend_id >= 0) & (pend_relb <= b)
-                            safe_p = jnp.clip(pend_id, 0)
-                            nd_p = jnp.where(due_p, pend_node, -1)
-                            state = rel_core(
-                                state, nd_p, src.requests[safe_p],
-                                mgt[safe_p], antit[safe_p],
-                                preft[safe_p], prefwt[safe_p],
-                            )
-                            # 2. bounded retry pass: the NORMAL wave step
-                            # over the buffer (empty slots are invalid
-                            # no-ops), FIFO order preserved by the wave
-                            # packing below.
-                            rb_waves = rbuf.reshape(RBW, wave_width)
-                            slots_r = T.gather_slots_device(src, rb_waves)
-                            extra_r = V3.gather_extra_device(xsrc, rb_waves)
-                            state, choices_r = jax.lax.scan(
-                                wave_step, state, (slots_r, extra_r)
-                            )
-                            flat_cr = choices_r.reshape(RB)
-                            placed_r = (flat_cr >= 0) & (rbuf >= 0)
-                            retry_placed = placed_r.sum().astype(jnp.int32)
-                            # 3. pend append (placed pods start NOW: f32
-                            # boundary search, at least b+1) + stable
-                            # compaction, drop-newest on overflow.
-                            dur_r = durt[jnp.clip(rbuf, 0)]
-                            rbn = jnp.searchsorted(
-                                tbt, t_b + dur_r, side="left"
-                            )
-                            relb_new = jnp.where(
-                                placed_r & (rbn < tbt.shape[0]),
-                                jnp.maximum(rbn, b + 1),
-                                BIG,
-                            ).astype(jnp.int32)
-                            add = placed_r & (relb_new < BIG)
-                            keep_old = (pend_id >= 0) & ~due_p
-                            ids_cat = jnp.concatenate([
-                                jnp.where(keep_old, pend_id, -1),
-                                jnp.where(add, rbuf, -1),
-                            ])
-                            node_cat = jnp.concatenate(
-                                [pend_node, flat_cr]
-                            )
-                            relb_cat = jnp.concatenate(
-                                [pend_relb, relb_new]
-                            )
-                            op = jnp.argsort(ids_cat < 0, stable=True)[:RB]
-                            pend_id = jnp.where(
-                                ids_cat[op] >= 0, ids_cat[op], -1
-                            ).astype(jnp.int32)
-                            pend_node = node_cat[op].astype(jnp.int32)
-                            pend_relb = relb_cat[op].astype(jnp.int32)
-                            # 4. rbuf compaction: placed pods leave; the
-                            # rest keep FIFO order.
-                            keep_q = (rbuf >= 0) & (flat_cr < 0)
-                            oq = jnp.argsort(~keep_q, stable=True)
-                            rbuf = jnp.where(
-                                keep_q[oq], rbuf[oq], -1
-                            ).astype(jnp.int32)
-                            rcount = keep_q.sum().astype(jnp.int32)
-                            # 5. main chunk scan with failure appends.
-                            slots = T.gather_slots_device(src, idx)
-                            extra = V3.gather_extra_device(xsrc, idx)
-
-                            def step(carry, xs):
-                                st, rbuf, rcount, rdrop = carry
-                                slots_w, extra_w, rows = xs
-                                st, choices = wave_step(
-                                    st, (slots_w, extra_w)
-                                )
-                                placed_w = jnp.sum(
-                                    (choices >= 0) & slots_w.valid
-                                ).astype(jnp.int32)
-                                fail = (
-                                    (choices < 0)
-                                    & slots_w.valid
-                                    & (slots_w.group < 0)
-                                )
-                                posk = (
-                                    rcount
-                                    + jnp.cumsum(fail.astype(jnp.int32))
-                                    - 1
-                                )
-                                pos = jnp.where(
-                                    fail & (posk < RB), posk, RB
-                                )
-                                rbuf = rbuf.at[pos].set(rows, mode="drop")
-                                nfail = fail.sum().astype(jnp.int32)
-                                # Overflow drops the newest — COUNTED,
-                                # like the host BoundaryOps analogue
-                                # (pend overflow is not: there the pod
-                                # keeps its resources, not dropped).
-                                rdrop = rdrop + jnp.maximum(
-                                    rcount + nfail - RB, 0
-                                )
-                                rcount = jnp.minimum(
-                                    rcount + nfail, RB
-                                ).astype(jnp.int32)
-                                return (st, rbuf, rcount, rdrop), (
-                                    choices, placed_w
-                                )
-
-                            (state, rbuf, rcount, rdrop), (
-                                choices, counts
-                            ) = jax.lax.scan(
-                                step,
-                                (state, rbuf, rcount, rdrop),
-                                (slots, extra, idx),
-                            )
-                            # 6. fold arrival-chunk placements at their
-                            # flat wave positions (retried placements do
-                            # NOT enter vassign: their releases ride pend
-                            # exclusively, and their arrival slot keeps
-                            # PAD so the static entry never fires).
-                            vassign = jax.lax.dynamic_update_slice(
-                                vassign,
-                                choices.reshape(-1),
-                                (b * idx.size,),
-                            )
-                            return (
-                                state, vassign, rbuf, rcount,
-                                pend_id, pend_node, pend_relb, rdrop,
-                                (counts, retry_placed),
-                            )
-
-                        vmapped_retry = jax.vmap(
-                            per_scenario_retry,
-                            in_axes=(
-                                0, 0, None, None, None, None, None,
-                                None, None, None,
-                                None, None, None,
-                                0, 0, 0, 0, 0, 0, 0,
-                            ),
-                        )
-                        return jax.jit(
-                            vmapped_retry,
-                            donate_argnums=(1, 13, 14, 15, 16, 17, 18, 19),
+                            (counts, retry_placed),
                         )
 
-                    # vmap matches in_axes against the args actually
-                    # passed; with policies on, a literal None rides the
-                    # dyn slot (no leaves — its axis spec is inert) and
-                    # the [S, K] policy matrix maps on axis 0.
-                    axes_rel = [0, 0, None, None, None, None, 0]
-                    if dyn_on:
-                        axes_rel.append(0)
-                    elif pol_on:
-                        axes_rel.append(None)
-                    if pol_on:
-                        axes_rel.append(0)
-                    vmapped_rel = jax.vmap(
-                        per_scenario_rel, in_axes=tuple(axes_rel)
+                    axes_retry = (
+                        0, 0, None, None, None, None, None,
+                        None, None, None,
+                        None, None, None,
+                        0, 0, 0, 0, 0, 0, 0,
                     )
-                    return jax.jit(vmapped_rel, donate_argnums=(1, 6))
-                # vmap matches in_axes against the args actually passed,
-                # so the defaulted dyn arg needs no wrapper.
-                axes_src = [0, 0, None, None, None]
+                    vmapped_retry = jax.vmap(
+                        per_scenario_retry, in_axes=axes_retry
+                    )
+                    return finalize(
+                        vmapped_retry, axes_retry,
+                        (1, 13, 14, 15, 16, 17, 18, 19),
+                    )
+
+                # vmap matches in_axes against the args actually
+                # passed; with policies on, a literal None rides the
+                # dyn slot (no leaves — its axis spec is inert) and
+                # the [S, K] policy matrix maps on axis 0.
+                axes_rel = [0, 0, None, None, None, None, 0]
                 if dyn_on:
-                    axes_src.append(0)
+                    axes_rel.append(0)
                 elif pol_on:
-                    axes_src.append(None)
+                    axes_rel.append(None)
                 if pol_on:
-                    axes_src.append(0)
-                vmapped_src = jax.vmap(
-                    per_scenario_src, in_axes=tuple(axes_src)
+                    axes_rel.append(0)
+                vmapped_rel = jax.vmap(
+                    per_scenario_rel, in_axes=tuple(axes_rel)
                 )
-                return jax.jit(vmapped_src, donate_argnums=(1,))
-
-            axes_plain = [0, 0, None, None]
+                return finalize(vmapped_rel, tuple(axes_rel), (1, 6))
+            # vmap matches in_axes against the args actually passed,
+            # so the defaulted dyn arg needs no wrapper.
+            axes_src = [0, 0, None, None, None]
             if dyn_on:
-                axes_plain.append(0)
+                axes_src.append(0)
             elif pol_on:
-                axes_plain.append(None)
+                axes_src.append(None)
             if pol_on:
-                axes_plain.append(0)
-            vmapped = jax.vmap(per_scenario, in_axes=tuple(axes_plain))
-        else:
-            def per_scenario(dc, state, slots, wvec=None):
-                d = T.Derived.build(dc)
-                wave_step = make_wave_step(dc, d, wave_width, spec, wvec=wvec)
-
-                def step(st, slot_batch):
-                    st, choices = wave_step(st, slot_batch)
-                    placed_w = jnp.sum((choices >= 0) & slot_batch.valid).astype(jnp.int32)
-                    out = choices if collect else placed_w
-                    return st, out
-
-                state, outs = jax.lax.scan(step, state, slots)
-                return state, outs
-
-            vmapped = jax.vmap(
-                per_scenario,
-                in_axes=(0, 0, None, 0) if pol_on else (0, 0, None),
+                axes_src.append(0)
+            vmapped_src = jax.vmap(
+                per_scenario_src, in_axes=tuple(axes_src)
             )
+            return finalize(vmapped_src, tuple(axes_src), (1,))
 
-        if self.mesh is None:
-            return jax.jit(vmapped, donate_argnums=(1,))
+        def per_scenario(dc, state, slots, wvec=None):
+            d = T.Derived.build(dc)
+            wave_step = make_wave_step(dc, d, wave_width, spec, wvec=wvec)
 
-        # Mesh path: shard_map, NOT jit-with-shardings. The scenario axis
-        # is embarrassingly parallel, and shard_map makes that a
-        # compile-time guarantee — each device runs the per-scenario
-        # program on its local slice and the partitioner never sees the
-        # whole computation. Under GSPMD (jit + in_shardings) sharding
-        # propagation is free to "help" by splitting REPLICATED
-        # slot-derived intermediates across devices (wave-width-8 axes
-        # match the 8-device mesh) and gathering them back — real
-        # all-gathers inside the chunk scan, pinned absent by
-        # tests/test_mesh_hlo.py.
-        from jax.experimental.shard_map import shard_map
-        from jax.sharding import PartitionSpec as P
+            def step(st, slot_batch):
+                st, choices = wave_step(st, slot_batch)
+                placed_w = jnp.sum((choices >= 0) & slot_batch.valid).astype(jnp.int32)
+                out = choices if collect else placed_w
+                return st, out
 
-        sh, rp = P(SCENARIO_AXIS), P()
-        in_specs = [sh, sh, rp]
-        if self.engine == "v3":
-            in_specs.append(rp)
-            if self._dyn_dev is not None:
-                in_specs.append(sh)
-            elif pol_on:
-                in_specs.append(rp)  # literal None in the dyn slot
-        if pol_on:
-            # The policy population rides the scenario axis: each device
-            # evaluates its local slice of [S, K] candidate vectors.
-            in_specs.append(sh)
-        return jax.jit(
-            shard_map(
-                vmapped,
-                mesh=self.mesh,
-                in_specs=tuple(in_specs),
-                out_specs=(sh, sh),
-                check_rep=False,
-            ),
-            donate_argnums=(1,),
-        )
+            state, outs = jax.lax.scan(step, state, slots)
+            return state, outs
+
+        axes_v2 = (0, 0, None, 0) if pol_on else (0, 0, None)
+        vmapped = jax.vmap(per_scenario, in_axes=axes_v2)
+        return finalize(vmapped, axes_v2, (1,))
 
     def _release_core(self):
         """Shared device release-update core (cached): subtract a K-list
@@ -1521,17 +1523,28 @@ class WhatIfEngine:
                 )
             return state._replace(**new)
 
-        fn = jax.jit(
-            jax.vmap(
-                rel_one,
-                in_axes=(
-                    (0, 0, None, None, None, None, None, None, 0, 0, 0)
-                    if dyn_mode
-                    else (0, 0, None, None, None, None, None, None)
-                ),
-            ),
-            donate_argnums=(0,),
+        axes = (
+            (0, 0, None, None, None, None, None, None, 0, 0, 0)
+            if dyn_mode
+            else (0, 0, None, None, None, None, None, None)
         )
+        fn_v = jax.vmap(rel_one, in_axes=axes)
+        if self.mesh is not None:
+            # Same shard_map discipline as the chunk program (round 10):
+            # sharded state/vassign, replicated release tables — each
+            # device rewinds its local scenarios, no collectives.
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            sh, rp = P(SCENARIO_AXIS), P()
+            fn_v = shard_map(
+                fn_v,
+                mesh=self.mesh,
+                in_specs=tuple(sh if a == 0 else rp for a in axes),
+                out_specs=sh,
+                check_rep=False,
+            )
+        fn = jax.jit(fn_v, donate_argnums=(0,))
         self._rel_fn_cache[key] = fn
         return fn
 
@@ -2030,8 +2043,13 @@ class WhatIfEngine:
             stg = self._dev_rel_stage
             rel_calls, b_c = stg["rel_calls"], stg["b_c"]
             # vassign is donated through the chunk calls — fresh per run.
-            vassign_d = jax.jit(
-                lambda a: jnp.broadcast_to(a[None], (self.S,) + a.shape)
+            # Under a mesh it materializes SHARDED (each device holds its
+            # scenarios' buffer; the broadcast never builds a global copy).
+            _bc = lambda a: jnp.broadcast_to(a[None], (self.S,) + a.shape)
+            vassign_d = (
+                jax.jit(_bc, out_shardings=scenario_sharding(self.mesh))
+                if self.mesh is not None
+                else jax.jit(_bc)
             )(stg["va"])
             if self.retry_buffer:
                 RB = self.retry_buffer
@@ -2040,15 +2058,22 @@ class WhatIfEngine:
                     stg["antit"], stg["preft"], stg["prefwt"]
                 )
                 tbt_d, tb_c = stg["tbt"], stg["tb_c"]
-                zs = lambda fill, dt: jnp.full(
-                    (self.S, RB), fill, dtype=dt
+                sh_s = (
+                    (lambda a: jax.device_put(
+                        a, scenario_sharding(self.mesh)
+                    ))
+                    if self.mesh is not None
+                    else (lambda a: a)
                 )
+                zs = lambda fill, dt: sh_s(jnp.full(
+                    (self.S, RB), fill, dtype=dt
+                ))
                 rbuf_d = zs(PAD, jnp.int32)
-                rcount_d = jnp.zeros(self.S, jnp.int32)
+                rcount_d = sh_s(jnp.zeros(self.S, jnp.int32))
                 pend_id_d = zs(PAD, jnp.int32)
                 pend_node_d = zs(PAD, jnp.int32)
                 pend_relb_d = zs(0, jnp.int32)
-                rdrop_d = jnp.zeros(self.S, jnp.int32)
+                rdrop_d = sh_s(jnp.zeros(self.S, jnp.int32))
         pending_fold = None  # (rows, choices) of the not-yet-folded chunk
         if comp_on:
             from .jax_runtime import wave_start_times
@@ -2487,9 +2512,11 @@ class WhatIfEngine:
                 if pol_d is not None:
                     args = args + (pol_d,)
                 states, vassign_d, out = self._chunk_fn(*args)
-            elif self.mesh is None and self.engine == "v3" and srcs is not None:
+            elif self.engine == "v3":
                 # Fused device-side gather + wave scan: one dispatch per
-                # chunk, indices pre-staged (ops.tpu.SlotSource).
+                # chunk, indices pre-staged (ops.tpu.SlotSource). Under a
+                # mesh the sources are replicated once per engine and
+                # every device gathers its chunk rows locally.
                 args = (dc, states, srcs[0], srcs[1], idx_chunks[ci])
                 if dyn_sharded is not None:
                     args = args + (dyn_sharded,)
@@ -2502,25 +2529,10 @@ class WhatIfEngine:
                 slots = T.gather_slots(self.pods, idx[c0 : c0 + C])
                 if self.mesh is not None:
                     slots = replicate_tree(self.mesh, slots)
-                if self.engine == "v3":
-                    from ..ops import tpu3 as V3
-
-                    extra = V3.gather_extra(self.static3, idx[c0 : c0 + C])
-                    if self.mesh is not None:
-                        extra = replicate_tree(self.mesh, extra)
-                    args = (dc, states, slots, extra)
-                    if dyn_sharded is not None:
-                        args = args + (dyn_sharded,)
-                    elif pol_d is not None:
-                        args = args + (None,)  # dyn slot
-                    if pol_d is not None:
-                        args = args + (pol_d,)
-                    states, out = self._chunk_fn(*args)
-                else:
-                    args = (dc, states, slots)
-                    if pol_d is not None:
-                        args = args + (pol_d,)
-                    states, out = self._chunk_fn(*args)
+                args = (dc, states, slots)
+                if pol_d is not None:
+                    args = args + (pol_d,)
+                states, out = self._chunk_fn(*args)
             if pre_comp:
                 # Deferred eviction-aware fold (round 6): fetch only the
                 # [S] eviction summary now; the previous chunk resolves
@@ -2763,6 +2775,17 @@ class WhatIfEngine:
             latency_p90=sc_lat_p90,
             latency_p99=sc_lat_p99,
             scenario_telemetry=sc_telemetry,
+            n_devices=(
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            ),
+            mesh_shape=(
+                dict(zip(
+                    self.mesh.axis_names,
+                    (int(d) for d in self.mesh.devices.shape),
+                ))
+                if self.mesh is not None
+                else None
+            ),
         )
 
 

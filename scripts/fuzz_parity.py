@@ -80,7 +80,27 @@ def run_fuzz(trials: int, master: int, quick: bool = False):
           d = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width, chunk_waves=C,
                               dmax_coarse=dmax, preemption=preempt,
                               granularity_guard=False).replay()
-          if not preempt:
+          if preempt:
+              # Round 10: the fused tier-preemption program vs the
+              # retained pre-fusion program — sampled (each variant
+              # compiles its own program) and BIT-exact when it runs.
+              if rng.random() < (1.0 if quick else 0.4):
+                  from kubernetes_simulator_tpu.ops import tpu3 as V3
+
+                  old_f = V3.FUSED_PREEMPT
+                  V3.FUSED_PREEMPT = not old_f
+                  try:
+                      d_alt = JaxReplayEngine(
+                          ec, ep, cfg, wave_width=wave_width, chunk_waves=C,
+                          dmax_coarse=dmax, preemption=True,
+                          granularity_guard=False).replay()
+                  finally:
+                      V3.FUSED_PREEMPT = old_f
+                  assert (d_alt.assignments == d.assignments).all(), (
+                      f"fused/prefusion mismatch trial={trial} seed={seed}")
+                  assert d_alt.placed == d.placed
+                  assert d_alt.preemptions == d.preemptions
+          else:
               v2 = JaxReplayEngine(ec, ep, cfg, wave_width=wave_width,
                                    chunk_waves=C, engine="v2",
                                    granularity_guard=False).replay()

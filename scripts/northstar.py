@@ -1,6 +1,11 @@
-"""North-star per-chip slice (BASELINE.json): Borg-shaped 10k nodes x 1M
-tasks x S what-if scenarios on one chip. The v5e-8 projection is this slice
-at S_total = 8 x S with scenario data-parallelism over the mesh.
+"""North-star slice (BASELINE.json): Borg-shaped 10k nodes x 1M tasks x
+S what-if scenarios. Round 10: the batch what-if runs are MESH-DEFAULT —
+with >1 visible device the engine shard_maps the scenario axis over the
+whole slice and the scenario count scales to S x n_devices (the former
+"v5e-8 projection" is now just the default run); per-device AND
+aggregate placements/s are printed. NS_MESH=0 forces the old single-chip
+slice, NS_MESH=1 forces a mesh. The NS_PREEMPT probe keeps the r05
+single-chip shape (its boundary eviction walks are host-side mirrors).
 
 Since round 4 the protocol reports BOTH semantics:
 - completions ON (the HEADLINE: the framework's default-on L4 semantics —
@@ -38,19 +43,24 @@ from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
 
 
 def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
-             preempt=False):
+             preempt=False, mesh=None):
     kw = dict(retry_buffer=retry) if retry else {}
     if preempt:
         kw["preemption"] = True
+    if mesh is not None:
+        kw["mesh"] = mesh
     eng = WhatIfEngine(
         ec, ep, scenarios, FrameworkConfig(), wave_width=wave,
         chunk_waves=chunk, completions=completions, **kw,
     )
+    ndev = int(mesh.devices.size) if mesh is not None else 1
     tag = "completions" if completions else "arrivals-only"
     if preempt:
         tag = "preempt-x-" + tag
     if retry:
         tag += f"+retry{retry}"
+    if ndev > 1:
+        tag += f"@mesh{ndev}"
     print(f"[{tag}] engine: {eng.engine}", flush=True)
     if os.environ.get("NS_WARMUP", "1") not in ("", "0"):
         t0 = time.perf_counter()
@@ -64,11 +74,14 @@ def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
     wall = time.perf_counter() - t0
     placed = int(res.placed.sum())
     attempts = S * tasks
+    per_dev = (
+        f" per-device={placed / wall / ndev / 1e6:.3f}M" if ndev > 1 else ""
+    )
     print(
-        f"[{tag}] S={S} N={ec.num_nodes} P={tasks} W={wave} C={chunk}: "
-        f"wall={wall:.1f}s placed={placed} "
+        f"[{tag}] S={S} N={ec.num_nodes} P={tasks} W={wave} C={chunk} "
+        f"ndev={ndev}: wall={wall:.1f}s placed={placed} "
         f"attempts/s={attempts / wall / 1e6:.3f}M "
-        f"placements/s={placed / wall / 1e6:.3f}M "
+        f"placements/s={placed / wall / 1e6:.3f}M{per_dev} "
         f"completions_on={res.completions_on}",
         flush=True,
     )
@@ -186,13 +199,31 @@ def main():
             )
     if mode == "skip":
         return
-    scenarios = uniform_scenarios(ec, S, seed=0)
+
+    # Mesh-default (round 10): scale scenarios to the device count and
+    # shard them; the preemption probe stays single-chip (host-side
+    # boundary walks — the r05 comparison shape).
+    import jax
+
+    from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+
+    ndev = len(jax.devices())
+    mesh_env = os.environ.get("NS_MESH", "auto")
+    use_mesh = (ndev > 1) if mesh_env == "auto" else mesh_env == "1"
+    mesh = make_mesh() if use_mesh else None
+
+    def _run(completions, retry_=0, preempt_=False):
+        m = None if preempt_ else mesh
+        S_run = S * ndev if m is not None else S
+        run_mode(
+            ec, ep, uniform_scenarios(ec, S_run, seed=0), S_run, tasks,
+            wave, chunk, completions, retry_, preempt_, mesh=m,
+        )
 
     if mode in ("both", "completions"):
-        run_mode(ec, ep, scenarios, S, tasks, wave, chunk, True, retry,
-                 preempt)
+        _run(True, retry, preempt)
     if mode in ("both", "arrivals"):
-        run_mode(ec, ep, scenarios, S, tasks, wave, chunk, False)
+        _run(False)
 
 
 if __name__ == "__main__":

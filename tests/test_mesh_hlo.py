@@ -6,15 +6,26 @@ would die. SURVEY §5 asserts "collectives appear only at metric-gather
 time"; this lowers the actual program on the virtual 8-device CPU mesh
 (conftest forces XLA_FLAGS=--xla_force_host_platform_device_count=8) and
 string-matches the optimized, SPMD-partitioned HLO. No TPU needed: the
-partitioner that would insert collectives runs at compile time."""
+partitioner that would insert collectives runs at compile time.
 
+Round 10 made the mesh the DEFAULT headline configuration (bench.py runs
+8 devices × 1024 scenarios) and moved the mesh chunk program to the
+device-gather src signature with device-side releases — so this suite
+now lowers those exact programs, at the headline scenario count as well
+as the small smoke shape, plus the bucketed release program."""
+
+import jax
+import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.models.encode import PAD, encode
-from kubernetes_simulator_tpu.ops import tpu as T
-from kubernetes_simulator_tpu.ops import tpu3 as V3
-from kubernetes_simulator_tpu.parallel.mesh import make_mesh, replicate_tree, shard_scenario_tree
+from kubernetes_simulator_tpu.parallel.mesh import (
+    make_mesh,
+    scenario_sharding,
+    shard_scenario_tree,
+)
 from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
 from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
 
@@ -39,8 +50,6 @@ def test_detector_catches_real_collective():
     the no-collectives assertions below would be vacuous (they were,
     until the mesh size guard: a 1-device mesh compiles everything
     collective-free)."""
-    import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from kubernetes_simulator_tpu.parallel.mesh import SCENARIO_AXIS
@@ -56,36 +65,61 @@ def test_detector_catches_real_collective():
     assert "all-reduce" in txt
 
 
-def _compiled_chunk_hlo(with_durations: bool) -> str:
+def _mesh_engine(S: int, with_durations: bool) -> WhatIfEngine:
     cluster = make_cluster(12, seed=21, taint_fraction=0.2)
+    # Durations short enough (and the pod stream long enough) that at
+    # least one static release bucket lands inside the chunk horizon —
+    # the release program below must have something to lower.
     pods, _ = make_workload(
-        48, seed=21, with_affinity=True, with_spread=True,
-        with_tolerations=True,
-        duration_mean=30.0 if with_durations else None,
+        96 if with_durations else 48, seed=21, with_affinity=True,
+        with_spread=True, with_tolerations=True,
+        duration_mean=10.0 if with_durations else None,
     )
     ec, ep = encode(cluster, pods)
-    scen = uniform_scenarios(ec, 8, seed=21, p_capacity=0.5, p_taint=0.3)
+    scen = uniform_scenarios(ec, S, seed=21, p_capacity=0.5, p_taint=0.3)
     mesh = make_mesh()
     assert mesh.devices.size == 8, "virtual 8-device mesh missing"
-    eng = WhatIfEngine(
+    return WhatIfEngine(
         ec, ep, scen, FrameworkConfig(), mesh=mesh, chunk_waves=4
     )
-    # Reproduce run()'s first-chunk argument assembly (the mesh branch:
-    # host-gathered slots replicated, dc/states scenario-sharded).
+
+
+def _chunk_args(eng: WhatIfEngine, with_durations: bool):
+    """Reproduce run()'s first-chunk argument assembly for the mesh src
+    path (round 10: device-gathered slots, device-side releases when
+    durations are on) — dc/states scenario-sharded, sources replicated."""
     idx = eng.waves.idx
     C = min(eng.chunk_waves, max(idx.shape[0], 1))
-    rows = idx[:C]
-    if rows.shape[0] < C:
-        rows = np.concatenate(
-            [rows, np.full((C - rows.shape[0], rows.shape[1]), PAD, np.int32)]
+    pad_to = ((idx.shape[0] + C - 1) // C) * C
+    if pad_to != idx.shape[0]:
+        idx = np.concatenate(
+            [idx, np.full((pad_to - idx.shape[0], idx.shape[1]), PAD, np.int32)]
         )
     dc = shard_scenario_tree(eng.mesh, eng.sset.dc)
     states = shard_scenario_tree(eng.mesh, eng._init_states())
-    slots = replicate_tree(eng.mesh, T.gather_slots(ep, rows))
-    args = [dc, states, slots]
-    if eng.engine == "v3":
-        args.append(replicate_tree(eng.mesh, V3.gather_extra(eng.static3, rows)))
-    return eng._chunk_fn.lower(*args).compile().as_text()
+    srcs = eng._slot_srcs
+    assert srcs is not None, "v3 mesh engine should pre-stage slot sources"
+    idx0 = jnp.asarray(idx[:C])
+    if not with_durations:
+        return (dc, states, srcs[0], srcs[1], idx0), None
+    # Completions-on (the north-star semantics): since round 10 the mesh
+    # takes the DEVICE-release path — releases must not push the chunk
+    # program into host folds, and must themselves stay collective-free.
+    assert eng._completions_dev, (
+        "device-release path should engage under a mesh (round 10)"
+    )
+    stg = eng._stage_dev_rel(idx, C)
+    vassign = jax.jit(
+        lambda a: jnp.broadcast_to(a[None], (eng.S,) + a.shape),
+        out_shardings=scenario_sharding(eng.mesh),
+    )(stg["va"])
+    args = (dc, states, srcs[0], srcs[1], idx0, stg["b_c"][0], vassign)
+    rel = None
+    for rc in stg["rel_calls"]:
+        if rc is not None:
+            rel = (states, vassign) + rc
+            break
+    return args, rel
 
 
 def _assert_no_collectives(txt: str) -> None:
@@ -104,12 +138,24 @@ def _assert_no_collectives(txt: str) -> None:
     )
 
 
-def test_mesh_chunk_program_has_no_collectives():
-    _assert_no_collectives(_compiled_chunk_hlo(with_durations=False))
+# 8 = smoke shape; 1024 = the bench.py headline (8 devices × 128
+# scenarios/device). The partitioner runs at compile time, so this pins
+# the SHIPPED configuration collective-free, not just a toy.
+@pytest.mark.parametrize("S", [8, 1024])
+def test_mesh_chunk_program_has_no_collectives(S):
+    eng = _mesh_engine(S, with_durations=False)
+    args, _ = _chunk_args(eng, with_durations=False)
+    _assert_no_collectives(eng._chunk_fn.lower(*args).compile().as_text())
 
 
-def test_mesh_chunk_program_no_collectives_with_completions():
-    """The completions-on shape (the north-star semantics): releases are
-    host-fold deltas under mesh, so the chunk program must still be
-    collective-free."""
-    _assert_no_collectives(_compiled_chunk_hlo(with_durations=True))
+@pytest.mark.parametrize("S", [8, 1024])
+def test_mesh_chunk_program_no_collectives_with_completions(S):
+    """The completions-on shape (the north-star semantics): releases run
+    on-device under mesh since round 10, so both the chunk program and
+    the bucketed release program must be collective-free."""
+    eng = _mesh_engine(S, with_durations=True)
+    args, rel = _chunk_args(eng, with_durations=True)
+    _assert_no_collectives(eng._chunk_fn.lower(*args).compile().as_text())
+    assert rel is not None, "expected at least one static release bucket"
+    rel_fn = eng._release_fn(rel[2].shape[0])
+    _assert_no_collectives(rel_fn.lower(*rel).compile().as_text())

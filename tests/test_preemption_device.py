@@ -252,6 +252,106 @@ def test_preemption_completions_parity_random(seed):
     assert (off.assignments != a.assignments).any()
 
 
+def _replay_with_fusion(ec, ep, cfg, fused, **kw):
+    """Build + replay inside a FUSED_PREEMPT patch window — the flag is
+    read at trace time, so the program variant is picked here."""
+    from kubernetes_simulator_tpu.ops import tpu3 as V3
+
+    old = V3.FUSED_PREEMPT
+    V3.FUSED_PREEMPT = fused
+    try:
+        return JaxReplayEngine(ec, ep, cfg, preemption=True, **kw).replay()
+    finally:
+        V3.FUSED_PREEMPT = old
+
+
+# Tier mixes for the fused-program parity sweep (round 10): tier count
+# drives the packed-prefix width AND the batched-commit einsum shapes, so
+# sweep sparse/dense/skewed priority populations.
+TIER_MIXES = [
+    (0, 100),
+    (0, 50, 100),
+    (0, 10, 100, 1000),
+    (0, 0, 0, 1000),  # skewed: one hot tier over a deep low-tier pool
+]
+
+
+@pytest.mark.parametrize("tiers", TIER_MIXES, ids=lambda t: "x".join(map(str, t)))
+def test_fused_tier_mix_parity(tiers):
+    """Fused preempt-select (ops.tpu3.FUSED_PREEMPT) vs the retained
+    pre-fusion program vs the CPU anchor: bit-identical assignments,
+    placement counts, eviction counts, and usage planes across tier
+    mixes. Priorities ramp upward over arrival time so later tiers
+    actually preempt earlier ones (non-vacuous: asserts evictions)."""
+    n_pods = 72
+    nodes = [
+        Node(f"n{i}", capacity={"cpu": 4.0, "memory": 8 * 2**30, "pods": 12})
+        for i in range(6)
+    ]
+    pods = [
+        Pod(
+            f"p{i}", labels={"app": f"a{i % 3}"},
+            requests={"cpu": [0.5, 1.0, 2.0][i % 3]},
+            priority=tiers[min(len(tiers) - 1, (i * len(tiers)) // n_pods)],
+            arrival_time=float(i),
+        )
+        for i in range(n_pods)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig()
+    a = greedy_replay(ec, ep, cfg, preemption=True)
+    fused = _replay_with_fusion(ec, ep, cfg, True)
+    pre = _replay_with_fusion(ec, ep, cfg, False)
+    np.testing.assert_array_equal(fused.assignments, a.assignments)
+    np.testing.assert_array_equal(fused.assignments, pre.assignments)
+    assert fused.placed == a.placed == pre.placed
+    assert fused.preemptions == a.preemptions == pre.preemptions
+    assert fused.preemptions > 0  # the mix must actually exercise eviction
+    np.testing.assert_array_equal(
+        np.asarray(fused.state.used), np.asarray(pre.state.used)
+    )
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_fused_matches_prefusion_random(seed):
+    """Randomized over-committed traces (gangs, spread, tolerations):
+    the fused and pre-fusion device programs must be BIT-identical —
+    assignments and f32 usage planes. One seed here (tier-1 budget);
+    the fuzz_quick slice flips the flag on every preempt trial."""
+    ec, ep = _tight_case(seed, with_spread=True, gang_fraction=0.1,
+                         gang_size=3)
+    cfg = FrameworkConfig()
+    fused = _replay_with_fusion(ec, ep, cfg, True)
+    pre = _replay_with_fusion(ec, ep, cfg, False)
+    np.testing.assert_array_equal(fused.assignments, pre.assignments)
+    assert fused.placed == pre.placed
+    assert fused.preemptions == pre.preemptions
+    np.testing.assert_array_equal(
+        np.asarray(fused.state.used), np.asarray(pre.state.used)
+    )
+
+
+def test_masked_argmin_matches_reference():
+    """The fused victim-select helper must pick exactly what the
+    argmax(where(mask, -score, -inf)) + any(mask) pair picked — including
+    lowest-index tie-breaks and the all-masked-out case."""
+    import jax.numpy as jnp
+
+    from kubernetes_simulator_tpu.ops import tpu as T
+
+    rng = np.random.default_rng(0)
+    for _ in range(25):
+        s = rng.integers(0, 5, 32).astype(np.float32)  # dense ties
+        m = rng.random(32) < 0.4
+        choice, ok = T.masked_argmin(jnp.asarray(s), jnp.asarray(m))
+        if m.any():
+            assert bool(ok)
+            assert int(choice) == int(np.argmax(np.where(m, -s, -np.inf)))
+        else:
+            assert not bool(ok)
+            assert int(choice) == PAD
+
+
 def test_gang_completion_does_not_corrupt_tier_planes():
     """A completed GANG pod must not be subtracted from the tier planes
     (which never accumulate gang pods — gangs are not evictable): the

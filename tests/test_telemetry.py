@@ -21,6 +21,7 @@ from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
 from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
 from kubernetes_simulator_tpu.sim.synthetic import make_chaos_timeline
 from kubernetes_simulator_tpu.sim.telemetry import (
+    PHASE_NAMES,
     TelemetryConfig,
     latency_summary,
     write_chrome_trace,
@@ -98,6 +99,23 @@ def test_default_summary_attached_both_engines():
         assert t.reasons is None  # series-only signal
         assert t.phases  # timers ran
         assert "telemetry" in res.summary()
+
+
+def test_phase_timer_names_stable():
+    """The instrumented phase names are API — scripts/northstar.py and
+    bench consumers attribute wall-clock by these exact strings. The
+    canonical tuple is PHASE_NAMES; a boundary-mode device replay must
+    emit exactly that set (a rename or a new un-registered phase fails
+    here first)."""
+    assert PHASE_NAMES == (
+        "dispatch", "device_wait", "boundary_fold", "host_mirror"
+    )
+    ec, ep = _light_trace(duration=10.0)  # releases fire inside the run
+    res = JaxReplayEngine(
+        ec, ep, FIT_ONLY(), wave_width=1, chunk_waves=1, preemption="kube",
+        retry_buffer=64,
+    ).replay()
+    assert set(res.telemetry.phases) == set(PHASE_NAMES)
 
 
 # -- rejection attribution parity (plain path, in-scan counters) ----------
