@@ -55,6 +55,14 @@ def main():
     # several chunk boundaries carry real release work.
     dur_mean = float(os.environ.get("BENCH_DURATION_MEAN", 50.0))
 
+    # DCN headline mode (round 11): under scripts/dcn_launch.py this
+    # joins the coordinator (enabling the compile cache FIRST, per the
+    # documented ordering); otherwise it is a no-op and the single-host
+    # protocol below is unchanged.
+    from kubernetes_simulator_tpu.parallel import dcn
+
+    dcn.maybe_init_from_env()
+
     from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
 
     _cc()
@@ -71,7 +79,8 @@ def main():
     # Mesh-default headline (round 10): shard the scenario axis over every
     # visible device; scenario count scales with the device count so each
     # device keeps the r05 per-chip shape (weak-scaling protocol).
-    ndev = len(jax.devices())
+    ndev = len(jax.devices())  # GLOBAL under DCN (all processes' devices)
+    nproc = jax.process_count()
     mesh = make_mesh() if ndev > 1 else None
     S_head = S * ndev if mesh is not None else S
     mesh_shape = (
@@ -132,8 +141,29 @@ def main():
     # rate over that. Strong: the SAME total scenario count on one device
     # — speedup is the headline rate over that. References get fewer
     # timed runs (they exist for the ratio, not the headline).
+    # DCN-scaling block (round 11): per-process and aggregate pps next to
+    # the PR-6 weak/strong block. The weak/strong/continuity/tuner
+    # anchors are SINGLE-PROCESS references — under DCN they would be
+    # silently re-shaped by the scenario slicing, so they are skipped
+    # here and stay comparable by running bench.py without the launcher.
+    dcn_block = {}
+    if nproc > 1:
+        dcn_block = {
+            "dcn_scaling": {
+                "process_count": nproc,
+                "local_devices": ndev // nproc,
+                "aggregate_pps": round(value, 1),
+                "per_process_pps": round(value / nproc, 1),
+                "local_wall_median_s": round(med_wall, 3),
+                "single_process_reference": (
+                    "run bench.py without dcn_launch.py for the "
+                    "weak/strong + continuity anchors"
+                ),
+            }
+        }
+
     scaling = {}
-    if mesh is not None:
+    if mesh is not None and nproc == 1:
         runs_ref = max(1, int(os.environ.get("BENCH_REF_RUNS", 2)))
         res_w, med_w, _ = _timed(
             WhatIfEngine(
@@ -181,7 +211,7 @@ def main():
     # Deliberately single-chip at the per-device scenario count: this is
     # the cross-round anchor, so its configuration never moves.
     cont = {}
-    if dur_mean:
+    if dur_mean and nproc == 1:
         ec_c, ep_c = encode(cluster, _make_pods(None))
         eng_c = WhatIfEngine(
             ec_c, ep_c, uniform_scenarios(ec_c, S, seed=0), cfg,
@@ -208,7 +238,7 @@ def main():
     tune_sweep = {}
     P_t = int(os.environ.get("BENCH_TUNE_POP", 16))
     S_t = int(os.environ.get("BENCH_TUNE_SCEN", 4))
-    if P_t > 0:
+    if P_t > 0 and nproc == 1:
         from kubernetes_simulator_tpu.ops import tpu as T
 
         rng = np.random.default_rng(0)
@@ -240,8 +270,7 @@ def main():
             }
         }
 
-    print(
-        json.dumps(
+    line = json.dumps(
             {
                 "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, %s, %d device%s)"
                 % (
@@ -256,10 +285,12 @@ def main():
                 "vs_baseline": round(vs, 2),
                 # Top-level provenance (round 10): rounds are only
                 # comparable within a configuration — stamp it where the
-                # round-over-round diff tooling looks first.
+                # round-over-round diff tooling looks first. Round 11
+                # adds process_count (1 = the single-host protocol).
                 "n_devices": ndev,
                 "mesh_shape": mesh_shape,
                 "scenarios": S_head,
+                "process_count": nproc,
                 "detail": {
                     "jax_wall_median_s": round(med_wall, 3),
                     "jax_wall_min_s": round(walls[0], 3),
@@ -272,13 +303,17 @@ def main():
                     "cpu_default_path_pps": round(cpu_pps, 1),
                     "scenario0_placed": int(res.placed[0]),
                     "device": _device_kind(),
+                    **dcn_block,
                     **scaling,
                     **cont,
                     **tune_sweep,
                 },
             }
         )
-    )
+    # One JSON line per fleet: every process computes the identical
+    # gathered result, only process 0 speaks.
+    if jax.process_index() == 0:
+        print(line)
 
 
 def _device_kind() -> str:

@@ -15,6 +15,7 @@ import os
 import sys
 
 from .framework.registry import get_strategy
+from .parallel import dcn
 from .utils.config import SimConfig, build_encoded_case
 from .utils.metrics import (
     JsonlWriter,
@@ -103,7 +104,8 @@ def cmd_run(args) -> int:
         log.info("chaos: injecting %d node events", len(events))
     # The writer owns the output file for the whole command: a failing
     # replay still closes (and flushes) whatever was written.
-    with JsonlWriter(cfg.output, context=_writer_context(cfg, args.config)) as out:
+    out_path = dcn.output_path_for_process(cfg.output)
+    with JsonlWriter(out_path, context=_writer_context(cfg, args.config)) as out:
         with device_trace(args.profile_dir):
             res = engine.replay(node_events=events) if events else engine.replay()
         out.write(replay_row(f"replay-{cfg.strategy}", res, {"config": args.config}))
@@ -169,13 +171,18 @@ def cmd_whatif(args) -> int:
         retry_buffer=cfg.whatif.retry_buffer,
         telemetry=cfg.telemetry.granularity,
     )
-    with JsonlWriter(cfg.output, context=_writer_context(cfg, args.config)) as out:
+    # DCN: every process assembles the identical gathered result; each
+    # writes its own sink (process 0 keeps the configured path, which is
+    # the file the parity bar compares against a single-process run).
+    out_path = dcn.output_path_for_process(cfg.output)
+    with JsonlWriter(out_path, context=_writer_context(cfg, args.config)) as out:
         with device_trace(args.profile_dir):
             res = eng.run()
         for row in whatif_rows(res, {"config": args.config, "mesh": bool(mesh)}):
             out.write(row)
     log.info(
-        "what-if: %d scenarios, %d placements in %.3fs (%.0f placements/sec aggregate)",
+        "what-if: %d scenarios, %d placements in %.3fs (%.0f placements/sec aggregate)"
+        + (f" across {res.process_count} processes" if res.process_count > 1 else ""),
         len(scen),
         res.total_placed,
         res.wall_clock_s,
@@ -220,7 +227,7 @@ def cmd_tune(args) -> int:
         mesh=mesh,
         cpu_oracle=tu.cpu_oracle, cpu_envelope=tu.cpu_envelope,
     )
-    out_path = tu.output or cfg.output
+    out_path = dcn.output_path_for_process(tu.output or cfg.output)
     with JsonlWriter(out_path, context=_writer_context(cfg, args.config)) as out:
         with device_trace(args.profile_dir):
             res = tuner.run(writer=out)
@@ -475,6 +482,12 @@ def main(argv=None) -> int:
             )
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
+    # Multi-host DCN bring-up (round 11): a no-op without the
+    # KSIM_DCN_* env set by scripts/dcn_launch.py. Enables the compile
+    # cache BEFORE jax.distributed.initialize (documented ordering).
+    if dcn.maybe_init_from_env():
+        nproc, pid = dcn.process_info()
+        log.info("DCN: process %d/%d up", pid, nproc)
     return args.fn(args)
 
 

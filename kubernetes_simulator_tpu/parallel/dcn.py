@@ -1,0 +1,202 @@
+"""Multi-host DCN replay (round 11): process-local execution, ONE
+end-of-replay gather.
+
+The scenario axis is the framework's data-parallel axis (parallel.mesh);
+across hosts it splits the same way: each process owns the CONTIGUOUS
+``jax.process_index()`` block of the scenario list and runs the entire
+chunk loop on it **locally** — a mesh is restricted to the process's own
+devices (:func:`localize_mesh`), the boundary-mode host mirrors exist
+only for local scenarios, and ``WhatIfEngine._fetch``/``_fold`` touch
+only addressable shards. By construction there are ZERO cross-process
+collectives inside the chunk loop; the processes meet exactly once per
+replay, at result assembly, through :func:`gather` — a host-side gather
+over the ``jax.distributed`` coordination (KV-store) service, the SURVEY
+§5 "one collective per replay" contract realized over DCN.
+
+Why host-side rather than psum/all_gather: the result tensors are tiny
+([S] counters and quantiles), and routing them through the coordination
+service keeps the compiled chunk programs bit-identical to the
+single-process mesh programs — which is what makes the 2-process parity
+bar (byte-identical placements, JSONL, checkpoint blobs) attainable. It
+also runs on jaxlib CPU builds whose runtime rejects cross-process XLA
+computations outright, so the path is exercised in CI without TPU hosts
+(scripts/dcn_launch.py spawns the coordinator + workers on one machine).
+
+``GATHER_COUNT`` is module-global so tests can pin the "exactly one
+gather per replay" contract. Gathers are SPMD-disciplined: every process
+must call :func:`gather` the same number of times with the same ``name``
+(the per-call sequence number is part of the KV key).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+# Cross-process gathers performed by this process since import. Tests
+# diff it around a replay to pin "one gather per replay, zero per chunk".
+GATHER_COUNT = 0
+_seq = 0
+
+# The coordination service speaks gRPC with a 4 MiB default message cap —
+# payloads are chunked well below it.
+_KV_CHUNK = 2 * 1024 * 1024
+
+
+def maybe_init_from_env() -> bool:
+    """Join the ``jax.distributed`` coordinator described by
+    ``KSIM_DCN_COORD`` / ``KSIM_DCN_NPROC`` / ``KSIM_DCN_PID`` (set by
+    scripts/dcn_launch.py; the bare ``DCN_*`` spellings of the test
+    harness are honored too). Returns True when a multi-process setup was
+    initialized.
+
+    Ordering contract: the persistent compile cache is configured FIRST —
+    ``compile_cache.enable()`` must precede ``jax.distributed.initialize``
+    (it reads config/env only, never initializes the backend; pinned by
+    tests/test_dcn_units.py)."""
+    coord = os.environ.get("KSIM_DCN_COORD") or os.environ.get("DCN_COORD")
+    nproc = int(
+        os.environ.get("KSIM_DCN_NPROC") or os.environ.get("DCN_NPROC") or 0
+    )
+    if not coord or nproc <= 1:
+        return False
+    from ..utils.compile_cache import enable as _cc
+
+    _cc()  # BEFORE initialize — see docstring
+    pid = int(
+        os.environ.get("KSIM_DCN_PID") or os.environ.get("DCN_PID") or 0
+    )
+    from .mesh import init_distributed
+
+    init_distributed(
+        coordinator_address=coord, num_processes=nproc, process_id=pid
+    )
+    return True
+
+
+def active() -> bool:
+    """True in a multi-process (DCN) run."""
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_info() -> tuple:
+    import jax
+
+    return jax.process_count(), jax.process_index()
+
+
+def local_slice(n_global: int) -> slice:
+    """This process's contiguous block of a length-``n_global`` leading
+    axis (requires ``n_global % process_count == 0``). The block order
+    matches a global ``make_mesh()`` scenario sharding: ``jax.devices()``
+    orders devices by process, so process p's local shards hold exactly
+    rows ``[p*n/np, (p+1)*n/np)`` — which is what makes the sliced run's
+    concatenated results bit-identical to the single-process mesh run."""
+    nproc, pid = process_info()
+    per = n_global // nproc
+    return slice(pid * per, (pid + 1) * per)
+
+
+def localize_mesh(mesh):
+    """Restrict a (possibly cross-process) mesh to THIS process's devices,
+    preserving axis names. Identity for None / already-local meshes.
+
+    This is the heart of the round-11 DCN design: the engine slices the
+    scenario axis per process and runs the same shard_map chunk programs
+    over a LOCAL mesh — every shard addressable, per-chunk device→host
+    traffic process-local, no cross-process XLA computation anywhere."""
+    from .mesh import spans_processes
+
+    if mesh is None or not spans_processes(mesh):
+        return mesh
+    import jax
+    from jax.sharding import Mesh
+
+    me = jax.process_index()
+    mine = [d for d in mesh.devices.flat if d.process_index == me]
+    if not mine:
+        raise ValueError(
+            "mesh has no devices addressable from process "
+            f"{me} — every process must contribute devices to a DCN mesh"
+        )
+    return Mesh(np.array(mine), mesh.axis_names)
+
+
+def _client():
+    from jax._src import distributed
+
+    c = distributed.global_state.client
+    if c is None:
+        raise RuntimeError(
+            "jax.distributed is not initialized — call "
+            "parallel.mesh.init_distributed (or run under "
+            "scripts/dcn_launch.py) before gathering"
+        )
+    return c
+
+
+def _timeout_ms() -> int:
+    return int(float(os.environ.get("KSIM_DCN_TIMEOUT_S", "300")) * 1000)
+
+
+def gather(name: str, payload) -> list:
+    """THE cross-process gather: publish this process's ``payload`` and
+    return every process's, in process order. Called at most once per
+    replay (result assembly); the chunk loop never reaches it.
+
+    Payloads are pickled (numpy arrays, dataclasses — trusted sibling
+    processes of the same program), base64-encoded and chunked under the
+    coordination service's gRPC message cap. Keys carry a monotonically
+    increasing sequence number, so repeated replays in one process
+    lifetime never collide — provided every process gathers in the same
+    order (SPMD discipline, same as collectives)."""
+    global GATHER_COUNT, _seq
+    nproc, pid = process_info()
+    _seq += 1
+    GATHER_COUNT += 1
+    c = _client()
+    tmo = _timeout_ms()
+    blob = base64.b64encode(
+        pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    ).decode("ascii")
+    chunks = [
+        blob[i : i + _KV_CHUNK] for i in range(0, len(blob), _KV_CHUNK)
+    ] or [""]
+    prefix = f"ksim/gather/{_seq}/{name}"
+    for j, ch in enumerate(chunks):
+        c.key_value_set(f"{prefix}/{pid}/{j}", ch)
+    c.key_value_set(f"{prefix}/{pid}/n", str(len(chunks)))
+    out = []
+    for p in range(nproc):
+        if p == pid:
+            out.append(payload)
+            continue
+        n = int(c.blocking_key_value_get(f"{prefix}/{p}/n", tmo))
+        out.append(
+            pickle.loads(
+                base64.b64decode(
+                    "".join(
+                        c.blocking_key_value_get(f"{prefix}/{p}/{j}", tmo)
+                        for j in range(n)
+                    )
+                )
+            )
+        )
+    return out
+
+
+def output_path_for_process(path: Optional[str]) -> Optional[str]:
+    """Per-process JSONL/checkpoint sink: process 0 keeps the configured
+    path (its file is the one the parity bar compares byte-for-byte
+    against a single-process run); siblings write ``<path>.p<pid>`` so
+    concurrent workers on one machine never interleave writes."""
+    if path is None:
+        return None
+    _, pid = process_info()
+    return path if pid == 0 else f"{path}.p{pid}"

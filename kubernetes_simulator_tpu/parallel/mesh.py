@@ -7,9 +7,13 @@ local scenarios. Collectives (the XLA-compiled equivalents of the
 reference-world's NCCL) appear only at metric-gather time — one ``psum`` /
 ``all_gather`` over ICI per replay, exactly as SURVEY.md §5 prescribes.
 
-Multi-host (DCN) scaling uses the same code path: ``init_distributed()``
-brings up ``jax.distributed`` and the mesh simply spans all processes'
-devices.
+Multi-host (DCN) scaling (round 11, parallel.dcn) localizes rather than
+spans: ``init_distributed()`` brings up ``jax.distributed``, the engine
+slices the scenario axis into contiguous per-process blocks and runs the
+chunk loop over a process-LOCAL mesh (``dcn.localize_mesh``), and the
+processes combine results exactly once per replay via a host-side gather
+over the coordination service — still one collective per replay, now with
+zero DCN traffic inside the chunk loop.
 """
 
 from __future__ import annotations
@@ -48,6 +52,19 @@ def make_mesh(num_devices: Optional[int] = None, axis: str = SCENARIO_AXIS) -> M
     return Mesh(np.array(devs), (axis,))
 
 
+def spans_processes(mesh: Optional[Mesh]) -> bool:
+    """True when ``mesh`` contains devices this process cannot address —
+    i.e. it is a cross-process (DCN) mesh. The engine localizes such
+    meshes (parallel.dcn.localize_mesh) before the chunk loop; result
+    paths branch on this instead of the blunt ``process_count() > 1``
+    (a local mesh inside a multi-process run is the common round-11
+    case and needs no global-array plumbing)."""
+    if mesh is None:
+        return False
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
 def scenario_sharding(mesh: Mesh, axis: str = SCENARIO_AXIS) -> NamedSharding:
     """Shard the leading (scenario) dimension; replicate the rest."""
     return NamedSharding(mesh, P(axis))
@@ -74,14 +91,14 @@ def shard_scenario_tree(mesh: Mesh, tree, axis: str = SCENARIO_AXIS):
     global arrays — device_put from a single-device array to a sharding
     spanning non-addressable devices is not defined."""
     sh = scenario_sharding(mesh, axis)
-    if jax.process_count() > 1:
+    if spans_processes(mesh):
         return jax.tree.map(lambda a: _global_put(a, sh), tree)
     return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
 
 
 def replicate_tree(mesh: Mesh, tree):
     sh = replicated(mesh)
-    if jax.process_count() > 1:
+    if spans_processes(mesh):
         return jax.tree.map(lambda a: _global_put(a, sh), tree)
     return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
 
@@ -99,11 +116,33 @@ def fit_population(population: int, per_candidate: int, mesh: Optional[Mesh]) ->
     samples rather than failing or silently truncating — and LOGS the
     padding (no silent caps): callers surface the requested vs. fitted
     sizes in their result metadata (TuneResult.population_requested,
-    WhatIfResult.n_devices)."""
+    WhatIfResult.n_devices).
+
+    DCN case (round 11): the flat axis must divide
+    ``process_count × local_devices`` — each process takes a contiguous
+    1/process_count block of the flat axis, and its LOCAL slice must in
+    turn divide its local mesh devices. A mesh that already spans
+    processes counts its devices once; a process-local mesh in a
+    multi-process run is scaled by ``process_count``; even a mesh-less
+    DCN sweep must divide ``process_count`` for the slicing to be even.
+    The padding log names the DCN factorization so operators see why the
+    population grew."""
     requested = population = max(int(population), 1)
+    nproc = jax.process_count()
     if mesh is None:
-        return population
-    ndev = int(mesh.devices.size)
+        if nproc <= 1:
+            return population
+        ndev, label = nproc, f"{nproc} processes (no mesh)"
+    elif spans_processes(mesh):
+        ndev = int(mesh.devices.size)
+        label = f"{ndev} mesh devices across {nproc} processes"
+    elif nproc > 1:
+        local = int(mesh.devices.size)
+        ndev = local * nproc
+        label = f"{nproc} processes x {local} local mesh devices = {ndev}"
+    else:
+        ndev = int(mesh.devices.size)
+        label = f"{ndev} mesh devices"
     while (population * per_candidate) % ndev:
         population += 1
     if population != requested:
@@ -111,8 +150,8 @@ def fit_population(population: int, per_candidate: int, mesh: Optional[Mesh]) ->
 
         log.info(
             "fit_population: padded population %d -> %d (+%d rows) so the "
-            "flat axis (%d x %d) divides over %d mesh devices",
+            "flat axis (%d x %d) divides over %s",
             requested, population, population - requested,
-            population, per_candidate, ndev,
+            population, per_candidate, label,
         )
     return population

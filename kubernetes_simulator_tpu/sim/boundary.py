@@ -387,6 +387,19 @@ class BoundaryOps:
         for p in ids[~placed]:
             self.offer_failure(int(p))
 
+    def counters(self) -> tuple:
+        """Per-scenario result counters in one tuple — (preemptions,
+        retry_dropped, evictions, evict_rescheduled, evict_stranded,
+        evict_latency_mean). The exact fields the what-if engine stacks
+        per scenario at result assembly; keeping the list HERE means the
+        round-11 end-of-replay DCN gather and the single-process oracle
+        can never drift on which counters a boundary mirror reports."""
+        return (
+            self.preemptions, self.retry_dropped, self.evictions,
+            self.evict_rescheduled, self.evict_stranded,
+            self.evict_latency_mean,
+        )
+
     # -- chaos eviction (node_down NoExecute) -------------------------------
 
     @property

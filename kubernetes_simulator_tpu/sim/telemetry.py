@@ -176,7 +176,13 @@ class PhaseTimers:
 
 @dataclass
 class ReplayTelemetry:
-    """Telemetry attached to ``ReplayResult.telemetry`` (None at ``off``)."""
+    """Telemetry attached to ``ReplayResult.telemetry`` (None at ``off``).
+
+    Leaves are plain picklable data (dicts/lists/ints/floats) end to
+    end, NEVER device arrays — round 11 ships per-scenario instances
+    through the host-side DCN gather (parallel.dcn.gather) at what-if
+    result assembly, and the single-process oracle must see identical
+    objects after the pickle round-trip (pinned in tests/test_dcn.py)."""
 
     granularity: str
     # Latency histogram (see latency_summary); None when nothing bound.

@@ -39,6 +39,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from ..framework.framework import FrameworkConfig
@@ -216,6 +217,12 @@ class TuneResult:
     population_requested: Optional[int] = None
     n_devices: int = 1
     mesh_shape: Optional[dict] = None  # {axis_name: size} or None
+    # DCN provenance (round 11): processes that contributed candidate
+    # blocks. The sweep engine gathers objectives exactly once per run()
+    # (WhatIfEngine's end-of-replay gather), so every process scores the
+    # identical full population and the search trajectory is
+    # process-count-independent.
+    process_count: int = 1
 
     def improved(self) -> bool:
         return self.heldout_objective > self.default_heldout_objective
@@ -548,4 +555,5 @@ class PolicyTuner:
                 if self.mesh is not None
                 else None
             ),
+            process_count=jax.process_count(),
         )

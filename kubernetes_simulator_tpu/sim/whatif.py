@@ -31,6 +31,7 @@ from ..models.core import Effect
 from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import init_state
 from ..ops import tpu as T
+from ..parallel import dcn
 from ..parallel.mesh import (
     SCENARIO_AXIS,
     make_mesh,
@@ -38,6 +39,7 @@ from ..parallel.mesh import (
     replicated,
     scenario_sharding,
     shard_scenario_tree,
+    spans_processes,
 )
 from .jax_runtime import StepSpec, make_wave_step
 from .waves import pack_waves
@@ -475,6 +477,11 @@ class WhatIfResult:
     # from different device counts are never silently compared.
     n_devices: int = 1
     mesh_shape: Optional[dict] = None  # {axis_name: size} or None
+    # DCN provenance (round 11): how many processes contributed scenario
+    # blocks. >1 means run() gathered per-process results exactly once at
+    # assembly; n_devices/mesh_shape then describe the GLOBAL device
+    # footprint (process_count × local devices).
+    process_count: int = 1
 
 
 class WhatIfEngine:
@@ -586,6 +593,78 @@ class WhatIfEngine:
                     "same rule as the single-replay engine"
                 )
         preemption = pmode == "tier"
+        # ---- Multi-host DCN replay (round 11, parallel.dcn) ----
+        # Each process takes the contiguous ``jax.process_index()`` block
+        # of the scenario axis and runs the ENTIRE chunk loop on it
+        # process-locally (the mesh is localized below, the boundary host
+        # mirrors exist only for local scenarios, _fetch/_fold touch only
+        # addressable shards); the processes meet exactly once per replay,
+        # in run()'s end-of-replay gather. Engine-level gates the
+        # single-process oracle derives from the FULL scenario list
+        # (taint-score enable, bf16 host-plane exactness) are computed
+        # here from the full list BEFORE slicing, so the compiled chunk
+        # programs — and therefore the results — stay bit-identical
+        # across process counts.
+        scenarios = list(scenarios)
+        self.S_global = len(scenarios)
+        self._dcn_sliced = False
+        self._proc_lo = 0
+        self._dcn_prefer_taint = False
+        self._dcn_scales_pods = False
+        # Full-tensor replications performed by _fetch this run — the
+        # round-11 contract pins this at ZERO inside the chunk loop
+        # (tests/test_dcn.py): replication may happen at most once per
+        # replay, at result assembly, never per chunk.
+        self._replicate_count = 0
+        nproc = jax.process_count()
+        if nproc > 1 and self.S_global:
+            if any(
+                pt.op == "set_label"
+                for sc in scenarios
+                for pt in sc.perturbations
+            ):
+                raise ValueError(
+                    "set_label perturbations are not supported in "
+                    "multi-process (DCN) runs: labels_dirty batches "
+                    "derive per-scenario domain tables and the engine "
+                    "choice from the WHOLE batch, which would diverge "
+                    "across process-local slices. Run label sweeps "
+                    "single-process, or split them into their own batch."
+                )
+            if self.S_global % nproc == 0:
+                self._dcn_prefer_taint = any(
+                    pt.op == "add_taint"
+                    and int(Effect.parse(pt.effect))
+                    == int(Effect.PREFER_NO_SCHEDULE)
+                    for sc in scenarios
+                    for pt in sc.perturbations
+                )
+                self._dcn_scales_pods = any(
+                    pt.op == "scale_capacity"
+                    and pt.resource == "pods"
+                    and pt.factor > 1
+                    for sc in scenarios
+                    for pt in sc.perturbations
+                )
+                sl = dcn.local_slice(self.S_global)
+                scenarios = scenarios[sl]
+                self._proc_lo = sl.start
+                self._dcn_sliced = True
+                if policies is not None:
+                    pol_g = np.asarray(policies)
+                    if pol_g.ndim == 2 and pol_g.shape[0] == self.S_global:
+                        policies = pol_g[sl]
+            else:
+                from ..utils.metrics import log
+
+                log.warning(
+                    "DCN: %d scenarios do not divide over %d processes — "
+                    "running fully replicated (every process computes "
+                    "all scenarios; no gather). Pad the batch to a "
+                    "multiple of the process count to scale.",
+                    self.S_global, nproc,
+                )
+        mesh = dcn.localize_mesh(mesh)
         # Per-scenario timed failure/recovery timelines (chaos campaigns,
         # round 7): applied through the per-scenario host mirrors at
         # chunk boundaries — which only exist in kube mode.
@@ -619,11 +698,17 @@ class WhatIfEngine:
         self.wave_width = wave_width = 8 if wave_width == "auto" else wave_width
         self.chunk_waves = chunk_waves
         self.mesh = mesh
+        # Always False after localize_mesh above; result paths branch on
+        # this instead of process_count (a local mesh in a DCN run needs
+        # no global-array plumbing).
+        self._mesh_spans_procs = spans_processes(mesh)
         self.collect_assignments = collect_assignments
         self.fork_checkpoint = fork_checkpoint
         self.sset = ScenarioSet(ec, scenarios, keep_host_stacks=self.kube)
         self.S = self.sset.num_scenarios
-        if self.sset.injected_prefer_taint and not self.spec.taint_score:
+        if (
+            self.sset.injected_prefer_taint or self._dcn_prefer_taint
+        ) and not self.spec.taint_score:
             self.spec = dc_replace(self.spec, taint_score=True)
         if mesh is not None:
             ndev = mesh.devices.size
@@ -710,7 +795,7 @@ class WhatIfEngine:
 
             # Perturbations that scale the "pods" capacity can exceed the
             # bf16 host-plane exactness bound.
-            scales_pods = any(
+            scales_pods = self._dcn_scales_pods or any(
                 pt.op == "scale_capacity" and pt.resource == "pods" and pt.factor > 1
                 for sc in scenarios
                 for pt in sc.perturbations
@@ -736,6 +821,7 @@ class WhatIfEngine:
         else:
             self._dyn_dev = None
         self._replicate_fn = None
+        self._sub_jit = None
         if self._dyn is not None and self.spec.sp_norm_f32:
             # Per-scenario spread weights (appended domains) can exceed the
             # bound under which the f32 normalize division is exactly the
@@ -985,6 +1071,16 @@ class WhatIfEngine:
                 "at construction to enable the policy axis"
             )
         pol = np.asarray(policies, dtype=np.float32)
+        # DCN: callers hand the GLOBAL [S_global, K] population; every
+        # process slices its own contiguous block (same rows the engine
+        # took at construction).
+        if (
+            self._dcn_sliced
+            and pol.ndim == 2
+            and pol.shape[0] == self.S_global
+            and self.S_global != self.S
+        ):
+            pol = pol[self._proc_lo : self._proc_lo + self.S]
         if pol.shape != self._policies.shape:
             raise ValueError(
                 f"policies shape {pol.shape} must match the engine's "
@@ -1708,7 +1804,20 @@ class WhatIfEngine:
         )
         if self.mesh is not None:
             delta = shard_scenario_tree(self.mesh, delta)
-        return jax.tree.map(jnp.subtract, states, delta)
+        return self._donated_subtract(states, delta)
+
+    def _donated_subtract(self, states, delta):
+        """Subtract a delta tree from the carried chunk-loop states with
+        the STATES buffers donated (round 11 donation audit): the eager
+        ``jax.tree.map(jnp.subtract, ...)`` here allocated a second full
+        state copy per release/boundary chunk. Cached on the engine — jit
+        caches by function identity."""
+        if self._sub_jit is None:
+            self._sub_jit = jax.jit(
+                lambda s, d: jax.tree.map(jnp.subtract, s, d),
+                donate_argnums=(0,),
+            )
+        return self._sub_jit(states, delta)
 
     def _apply_stacked_boundary_delta(self, states, subs, adds):
         """Per-scenario (pods, nodes) array pairs from the kube boundary
@@ -1878,13 +1987,16 @@ class WhatIfEngine:
             host_assign[:, rows[v]] = ch.reshape((self.S,) + rows.shape)[:, v]
 
     def _fetch(self, x) -> np.ndarray:
-        """Device→host for a result tensor. On a multi-process (DCN) mesh
-        the array is replicated first — the end-of-replay all_gather that
-        SURVEY §5 names as the replay's one collective — since host
-        conversion needs every shard addressable. The jitted replicator is
-        cached on the engine (jit caches by function identity; a fresh
-        lambda per call would recompile per tensor per chunk)."""
-        if self.mesh is not None and jax.process_count() > 1:
+        """Device→host for a result tensor. Round 11: under DCN the
+        engine's mesh is process-LOCAL (localize_mesh in __init__), every
+        shard is addressable, and this is a plain local copy — the
+        per-chunk cross-process replication that used to live here (the
+        round-10 ``process_count() > 1`` branch) is gone; processes meet
+        once per replay in run()'s gather instead. The replication branch
+        survives only for a caller handing in a genuinely cross-process
+        mesh, and counts itself so tests can pin it at zero."""
+        if self._mesh_spans_procs:
+            self._replicate_count += 1
             if self._replicate_fn is None:
                 self._replicate_fn = jax.jit(
                     lambda a: a, out_shardings=replicated(self.mesh)
@@ -2015,6 +2127,9 @@ class WhatIfEngine:
         return stg
 
     def run(self) -> WhatIfResult:
+        # Per-run counter for the round-11 contract test: full-tensor
+        # cross-process replication in _fetch must be 0 for this replay.
+        self._replicate_count = 0
         states = self._init_states()  # sets fork bookkeeping first
         idx = self.waves.idx
         if self._fork_waves_done:
@@ -2621,22 +2736,15 @@ class WhatIfEngine:
             placed = (
                 (host_k[:, scheduled] >= 0).sum(axis=1).astype(np.int32)
             )
-            kube_preempt = np.asarray(
-                [b.preemptions for b in kbops], np.int32
-            )
-            kube_dropped = np.asarray(
-                [b.retry_dropped for b in kbops], np.int32
-            )
-            kube_evict = np.asarray([b.evictions for b in kbops], np.int32)
-            kube_resched = np.asarray(
-                [b.evict_rescheduled for b in kbops], np.int32
-            )
-            kube_stranded = np.asarray(
-                [b.evict_stranded for b in kbops], np.int32
-            )
-            kube_lat = np.asarray(
-                [b.evict_latency_mean for b in kbops], np.float64
-            )
+            # One counter tuple per mirror (BoundaryOps.counters owns the
+            # field list — result assembly and the DCN gather can't drift).
+            cnt = np.asarray([b.counters() for b in kbops], np.float64)
+            kube_preempt = cnt[:, 0].astype(np.int32)
+            kube_dropped = cnt[:, 1].astype(np.int32)
+            kube_evict = cnt[:, 2].astype(np.int32)
+            kube_resched = cnt[:, 3].astype(np.int32)
+            kube_stranded = cnt[:, 4].astype(np.int32)
+            kube_lat = cnt[:, 5]
             if self.telemetry_cfg.enabled:
                 stel = [t.result() for t in ktel]
                 lat_q = np.full((3, self.S), np.nan, np.float64)
@@ -2749,12 +2857,64 @@ class WhatIfEngine:
             util = self._fetch(
                 jax.jit(_util)(states.used, self.sset.dc.allocatable)
             )
-        total = int(placed.sum())
         dropped = kube_dropped
         if dropped is None and dev_rel and self.retry_buffer:
             # The device retry path counts overflow drops in-scan now
             # (round 6): every drop-capable engine reports them.
             dropped = np.asarray(self._fetch(rdrop_d)).astype(np.int32)
+        # ---- THE end-of-replay gather (round 11, parallel.dcn) ----
+        # The one point per replay where processes exchange data: every
+        # per-scenario result array is concatenated across the contiguous
+        # per-process blocks, in process order — bit-identical to what the
+        # single-process mesh run assembles. Everything above this line
+        # (the whole chunk loop, the boundary passes, the result fetches)
+        # was process-local.
+        process_count = 1
+        if self._dcn_sliced:
+            parts = dcn.gather(
+                "whatif",
+                dict(
+                    placed=placed,
+                    assignments=assignments,
+                    util=util,
+                    preemptions=kube_preempt,
+                    dropped=dropped,
+                    evictions=kube_evict,
+                    resched=kube_resched,
+                    stranded=kube_stranded,
+                    evict_lat=kube_lat,
+                    lat50=sc_lat_p50,
+                    lat90=sc_lat_p90,
+                    lat99=sc_lat_p99,
+                    telemetry=sc_telemetry,
+                ),
+            )
+
+            def _cat(k):
+                if parts[0][k] is None:
+                    return None
+                return np.concatenate([p[k] for p in parts], axis=0)
+
+            placed = _cat("placed")
+            assignments = _cat("assignments")
+            util = _cat("util")
+            kube_preempt = _cat("preemptions")
+            dropped = _cat("dropped")
+            kube_evict = _cat("evictions")
+            kube_resched = _cat("resched")
+            kube_stranded = _cat("stranded")
+            kube_lat = _cat("evict_lat")
+            sc_lat_p50 = _cat("lat50")
+            sc_lat_p90 = _cat("lat90")
+            sc_lat_p99 = _cat("lat99")
+            sc_telemetry = (
+                None
+                if parts[0]["telemetry"] is None
+                else [t for p in parts for t in p["telemetry"]]
+            )
+            process_count = jax.process_count()
+        total = int(placed.sum())
+        ndev_local = int(self.mesh.devices.size) if self.mesh is not None else 1
         return WhatIfResult(
             placed=placed,
             unschedulable=(to_schedule - placed).astype(np.int32),
@@ -2775,17 +2935,22 @@ class WhatIfEngine:
             latency_p90=sc_lat_p90,
             latency_p99=sc_lat_p99,
             scenario_telemetry=sc_telemetry,
-            n_devices=(
-                int(self.mesh.devices.size) if self.mesh is not None else 1
-            ),
+            # Global footprint: process_count × local devices when the
+            # scenario axis was DCN-sliced (the local mesh is 1/nproc of
+            # the fleet that produced the gathered result).
+            n_devices=ndev_local * process_count,
             mesh_shape=(
                 dict(zip(
                     self.mesh.axis_names,
-                    (int(d) for d in self.mesh.devices.shape),
+                    (
+                        int(d) * process_count
+                        for d in self.mesh.devices.shape
+                    ),
                 ))
                 if self.mesh is not None
                 else None
             ),
+            process_count=process_count,
         )
 
 

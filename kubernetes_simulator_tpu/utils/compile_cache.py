@@ -19,6 +19,17 @@ out-of-bounds node ids and occasional segfaults, while every cold
 compile of the same program was correct. Until the upstream
 serialization is sound, correctness wins over warm-start time on CPU;
 ``KSIM_COMPILE_CACHE=1`` forces it back on for local experiments.
+
+Concurrent DCN workers (round 11): N processes on one machine share the
+cache directory, and jax 0.4.x's ``LRUCache.put`` writes entries with a
+bare ``write_bytes`` — no lock when eviction is off (the default) — so a
+reader can observe a half-written executable. ``enable()`` therefore
+patches the put path to write a per-process temp file and ``os.replace``
+it into place (atomic on POSIX): concurrent writers of the same
+content-addressed key each land a complete file, last rename wins with
+identical bytes. Ordering stays as documented: ``enable()`` must run
+BEFORE ``jax.distributed.initialize`` (parallel.dcn.maybe_init_from_env
+does this by construction; pinned by tests/test_dcn_units.py).
 """
 
 from __future__ import annotations
@@ -28,6 +39,50 @@ from pathlib import Path
 
 _DEFAULT_DIR = "~/.cache/ksim_tpu_xla"
 _configured_dir: str | None = None
+_atomic_patched = False
+
+
+def patch_atomic_writes() -> bool:
+    """Replace ``jax._src.lru_cache.LRUCache.put``'s unlocked
+    ``write_bytes`` with temp-then-``os.replace`` so concurrent DCN
+    workers sharing one cache directory never expose partial entries.
+    Returns True when the patch is in place (idempotent); False when the
+    jax internals moved (the cache then stays stock — slower under
+    contention, never broken worse than upstream)."""
+    global _atomic_patched
+    if _atomic_patched:
+        return True
+    try:
+        import time
+
+        from jax._src import lru_cache as _lru
+
+        suffix_c = _lru._CACHE_SUFFIX
+        suffix_a = _lru._ATIME_SUFFIX
+        orig_put = _lru.LRUCache.put
+
+        def _atomic_put(self, key, val):
+            if getattr(self, "eviction_enabled", False):
+                # The eviction path serializes through a file lock
+                # upstream — keep it.
+                return orig_put(self, key, val)
+            if not key:
+                raise ValueError("key cannot be empty")
+            cache_path = self.path / f"{key}{suffix_c}"
+            if cache_path.exists():
+                return
+            tmp = self.path / f"{key}.tmp.{os.getpid()}"
+            tmp.write_bytes(val)
+            os.replace(str(tmp), str(cache_path))
+            (self.path / f"{key}{suffix_a}").write_bytes(
+                time.time_ns().to_bytes(8, "little")
+            )
+
+        _lru.LRUCache.put = _atomic_put
+    except Exception:  # noqa: BLE001 — never fatal
+        return False
+    _atomic_patched = True
+    return True
 
 
 def enable(cache_dir: str | None = None) -> str | None:
@@ -80,5 +135,6 @@ def enable(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:  # noqa: BLE001 — a broken cache must never be fatal
         return None
+    patch_atomic_writes()
     _configured_dir = str(path)
     return _configured_dir

@@ -9,9 +9,21 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import os
 import sys
 import time
 from typing import IO, Iterable, Optional
+
+
+def deterministic_jsonl() -> bool:
+    """``KSIM_DETERMINISTIC_JSONL=1`` zeroes every wall-clock-derived
+    JSONL field (``ts``, ``wall_clock_s``, ``placements_per_sec``) while
+    keeping the fields PRESENT as numbers, so v2-schema rows stay valid.
+    This is what makes the round-11 DCN parity bar byte-for-byte testable:
+    a 2-process replay and its single-process oracle differ only in
+    timing, never in results — with timing zeroed, the JSONL files must
+    be identical down to the byte (tests/test_dcn.py)."""
+    return os.environ.get("KSIM_DETERMINISTIC_JSONL", "") == "1"
 
 log = logging.getLogger("k8sim")
 if not log.handlers:
@@ -55,7 +67,11 @@ class JsonlWriter:
     def write(self, row: dict, stamp_ts: bool = True) -> None:
         # stamp_ts=False drops the wall-clock stamp — the policy tuner's
         # trajectory rows must be byte-identical across same-seed runs.
-        stamp = {"ts": time.time()} if stamp_ts else {}
+        stamp = (
+            {"ts": 0.0 if deterministic_jsonl() else time.time()}
+            if stamp_ts
+            else {}
+        )
         row = {**stamp, "schema": SCHEMA_VERSION, **self.context, **row}
         line = json.dumps(row)
         if self._f:
@@ -77,16 +93,26 @@ class JsonlWriter:
         return False
 
 
+def _scrub_timing(row: dict) -> dict:
+    """Zero wall-clock-derived fields under KSIM_DETERMINISTIC_JSONL
+    (fields stay present as numbers — schema v2 requires them)."""
+    if deterministic_jsonl():
+        for k in ("wall_clock_s", "placements_per_sec"):
+            if k in row:
+                row[k] = 0.0
+    return row
+
+
 def replay_row(kind: str, res, extra: Optional[dict] = None) -> dict:
     row = {"kind": kind, **res.summary()} if hasattr(res, "summary") else {"kind": kind}
     if extra:
         row.update(extra)
-    return row
+    return _scrub_timing(row)
 
 
 def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
     base = extra or {}
-    yield {
+    yield _scrub_timing({
         "kind": "whatif-aggregate",
         "scenarios": int(res.placed.shape[0]),
         "total_placed": res.total_placed,
@@ -95,7 +121,7 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
         "completions_on": bool(res.completions_on),
         "engine": res.engine,
         **base,
-    }
+    })
     pre = getattr(res, "preemptions", None)
     drop = getattr(res, "retry_dropped", None)
     evi = getattr(res, "evictions", None)

@@ -66,6 +66,15 @@ _OPTIONAL = {
     "telemetry": dict,
     "config": str,
     "mesh": bool,
+    # Round 11 (multi-host DCN): provenance fields stamped by bench.py
+    # and DCN-aware writers. Whatif/replay ROWS deliberately do NOT gain
+    # a process_count — their bytes must match the single-process oracle
+    # (the parity bar) — but top-level bench JSON and future row
+    # variants may carry them.
+    "process_count": int,
+    "n_devices": int,
+    "mesh_shape": (dict, type(None)),
+    "dcn_scaling": dict,
 }
 
 _TEL_GRANULARITIES = ("summary", "series", "timeline")

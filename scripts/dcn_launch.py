@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Multi-host DCN harness (round 11): spawn N coordinator+worker
+processes ON ONE MACHINE and run the same command in each.
+
+    python scripts/dcn_launch.py --nproc 2 -- \
+        python -m kubernetes_simulator_tpu what-if examples/whatif.yaml
+
+    python scripts/dcn_launch.py --nproc 2 -- python bench.py --dcn
+
+Each child gets ``KSIM_DCN_COORD`` / ``KSIM_DCN_NPROC`` / ``KSIM_DCN_PID``
+(consumed by ``parallel.dcn.maybe_init_from_env`` — the CLI, bench.py and
+scripts/northstar.py all call it on startup), plus
+``--xla_force_host_platform_device_count`` so every process exposes
+``--devices-per-proc`` virtual CPU devices — the same mechanism real
+multi-host TPU uses, minus the hardware, so the DCN code path runs in CI.
+Process 0's output streams through; siblings are captured and replayed on
+failure. Any child failing kills the rest (a DCN replay cannot complete
+with a hole in the scenario axis).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_env(pid: int, nproc: int, port: int, devices_per_proc: int) -> dict:
+    env = dict(os.environ)
+    env["KSIM_DCN_COORD"] = f"127.0.0.1:{port}"
+    env["KSIM_DCN_NPROC"] = str(nproc)
+    env["KSIM_DCN_PID"] = str(pid)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument(
+        "--devices-per-proc", type=int, default=4,
+        help="virtual CPU devices per process (default 4: 2 procs "
+             "reproduce the 8-device single-host mesh)",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=900.0,
+        help="kill the fleet after this many seconds",
+    )
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to run in every process (after --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (append: -- python -m ... )")
+    if args.nproc < 1:
+        ap.error("--nproc must be >= 1")
+
+    port = free_port()
+    procs, tails = [], []
+    for pid in range(args.nproc):
+        env = child_env(pid, args.nproc, port, args.devices_per_proc)
+        if pid == 0:
+            p = subprocess.Popen(cmd, env=env)
+            tails.append(None)
+        else:
+            p = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            buf: list = []
+            tails.append(buf)
+
+            def drain(proc=p, sink=buf):
+                for line in proc.stdout:
+                    sink.append(line)
+
+            threading.Thread(target=drain, daemon=True).start()
+        procs.append(p)
+
+    deadline = time.monotonic() + args.timeout
+    rc = 0
+    try:
+        pending = set(range(args.nproc))
+        while pending:
+            if time.monotonic() > deadline:
+                print(
+                    f"dcn_launch: timeout after {args.timeout}s",
+                    file=sys.stderr,
+                )
+                rc = 124
+                break
+            for i in sorted(pending):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                pending.discard(i)
+                if r != 0 and rc == 0:
+                    rc = r
+                    print(
+                        f"dcn_launch: process {i} exited {r} — "
+                        "killing the fleet", file=sys.stderr,
+                    )
+                    if tails[i]:
+                        sys.stderr.writelines(
+                            f"[p{i}] {line}" for line in tails[i][-50:]
+                        )
+            if rc:
+                break
+            time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,13 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+from kubernetes_simulator_tpu.parallel import dcn as _dcn
+
+# DCN (round 11): under scripts/dcn_launch.py this joins the coordinator
+# (and enables the compile cache first); single-process runs fall through
+# to the plain enable below (idempotent).
+_dcn.maybe_init_from_env()
+
 from kubernetes_simulator_tpu.utils.compile_cache import enable as _cc
 
 _cc()  # persistent XLA cache: a restart at the same shape compiles in ~s
@@ -61,6 +68,10 @@ def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
         tag += f"+retry{retry}"
     if ndev > 1:
         tag += f"@mesh{ndev}"
+    import jax as _jax
+
+    if _jax.process_count() > 1:
+        tag += f"@dcn{_jax.process_count()}"
     print(f"[{tag}] engine: {eng.engine}", flush=True)
     if os.environ.get("NS_WARMUP", "1") not in ("", "0"):
         t0 = time.perf_counter()

@@ -1,14 +1,28 @@
 """Perf probe: how does per-pod step cost scale with S (scenarios) and N
-(nodes)? Finds whether the wave scan is latency- or compute-bound."""
+(nodes)? Finds whether the wave scan is latency- or compute-bound.
+
+``--dcn`` (round 11) adds the process-count axis to the trajectory: the
+probe re-runs ITSELF under scripts/dcn_launch.py for each process count,
+so the scaling record holds device-count sweeps (the default sweep below)
+and DCN process-count sweeps side by side. Inside a DCN fleet every
+process prints its local wall; read process 0's line (the others carry a
+[pN] prefix only on failure).
+"""
 
 import os as _os
 import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+import argparse
+import subprocess
 import time
 
 import numpy as np
+
+from kubernetes_simulator_tpu.parallel import dcn as _dcn
+
+_dcn.maybe_init_from_env()
 
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.models.encode import encode
@@ -16,7 +30,7 @@ from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
 from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
 
 
-def probe(nodes, pods_n, S, chunk_waves=256):
+def probe(nodes, pods_n, S, chunk_waves=256, mesh=None):
     cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
     pods, _ = make_workload(
         pods_n, seed=0, with_affinity=True, with_spread=True, with_tolerations=True,
@@ -24,21 +38,76 @@ def probe(nodes, pods_n, S, chunk_waves=256):
     )
     ec, ep = encode(cluster, pods)
     scenarios = uniform_scenarios(ec, S, seed=0)
-    eng = WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=chunk_waves)
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), chunk_waves=chunk_waves,
+        mesh=mesh,
+    )
     eng.run()  # warmup
     t0 = time.perf_counter()
     res = eng.run()
     wall = time.perf_counter() - t0
     per_pod_us = wall / pods_n * 1e6
+    tag = f" nproc={res.process_count}" if res.process_count > 1 else ""
     print(
         f"S={S:4d} N={nodes:5d} P={pods_n:6d} G={ec.num_groups:3d} "
         f"wall={wall:6.2f}s agg={res.placements_per_sec/1e3:8.1f}k/s "
-        f"us/pod-step={per_pod_us:7.1f}"
+        f"us/pod-step={per_pod_us:7.1f}{tag}"
     , flush=True)
 
 
-if __name__ == "__main__":
+def default_sweep():
     for S in (8, 32, 128, 256):
         probe(2000, 10_000, S)
     probe(10_000, 10_000, 32)
     probe(10_000, 10_000, 128)
+
+
+def dcn_sweep(proc_counts, S, nodes, pods_n):
+    """Re-launch this probe under scripts/dcn_launch.py once per process
+    count — the DCN axis of the scaling trajectory (device-count sweeps
+    stay in the default sweep)."""
+    here = _os.path.abspath(__file__)
+    launcher = _os.path.join(_os.path.dirname(here), "dcn_launch.py")
+    for nproc in proc_counts:
+        print(f"--- dcn axis: {nproc} process(es) ---", flush=True)
+        cmd = [
+            _sys.executable, launcher, "--nproc", str(nproc),
+            "--devices-per-proc", "2", "--",
+            _sys.executable, here, "--inner",
+            "--scenarios", str(S), "--nodes", str(nodes),
+            "--pods", str(pods_n),
+        ]
+        rc = subprocess.call(cmd)
+        if rc != 0:
+            print(f"dcn axis: nproc={nproc} FAILED rc={rc}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dcn", nargs="?", const="1,2", default=None,
+                    help="comma list of process counts to sweep "
+                         "(default '1,2')")
+    ap.add_argument("--inner", action="store_true",
+                    help="(internal) run one probe inside a DCN fleet")
+    ap.add_argument("--scenarios", type=int, default=32)
+    ap.add_argument("--nodes", type=int, default=2000)
+    ap.add_argument("--pods", type=int, default=10_000)
+    args = ap.parse_args()
+    if args.inner:
+        from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+
+        import jax
+
+        mesh = make_mesh() if len(jax.devices()) > 1 else None
+        probe(args.nodes, args.pods, args.scenarios, mesh=mesh)
+    elif args.dcn is not None:
+        dcn_sweep(
+            [int(x) for x in args.dcn.split(",") if x],
+            args.scenarios, args.nodes, args.pods,
+        )
+    else:
+        default_sweep()
+
+
+if __name__ == "__main__":
+    main()

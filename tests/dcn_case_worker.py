@@ -1,0 +1,376 @@
+"""Worker + shared case builders for the round-11 DCN parity suite
+(tests/test_dcn.py).
+
+Each builder constructs a deterministic workload, runs it, and reduces the
+result to a JSON-serializable dict of exact values and content hashes. The
+PARENT TEST imports the same builders to compute the single-process oracle,
+so any drift between a 2-process DCN run and the single-process mesh run is
+a bit-level diff of identical code paths — the parity bar of ISSUE round
+11 (process-local folds, one end-of-replay gather).
+
+As a script it is one of KSIM_DCN_NPROC worker processes: it joins the
+coordinator through the PRODUCTION entry point (``dcn.maybe_init_from_env``
+— the same enable-cache-then-initialize path scripts/dcn_launch.py
+children take), runs the cases named in KSIM_DCN_CASES, pins the round-11
+counters (zero ``_fetch`` replications, exactly ONE gather per what-if
+replay) and prints everything as one JSON line.
+
+Platform env (JAX_PLATFORMS=cpu, --xla_force_host_platform_device_count)
+must be set by the parent BEFORE jax import.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _arr_sha(a) -> str:
+    """Content hash of an array: dtype + shape + raw little-endian bytes —
+    equal hashes ⇔ bit-identical arrays."""
+    import numpy as np
+
+    a = np.ascontiguousarray(a)
+    return _sha(
+        f"{a.dtype.str}:{a.shape}:".encode() + a.tobytes()
+    )
+
+
+def _deterministic_jsonl():
+    """Context manager forcing KSIM_DETERMINISTIC_JSONL=1 (builders run it
+    on BOTH sides so worker and oracle bytes are comparable)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        old = os.environ.get("KSIM_DETERMINISTIC_JSONL")
+        os.environ["KSIM_DETERMINISTIC_JSONL"] = "1"
+        try:
+            yield
+        finally:
+            if old is None:
+                del os.environ["KSIM_DETERMINISTIC_JSONL"]
+            else:
+                os.environ["KSIM_DETERMINISTIC_JSONL"] = old
+
+    return _cm()
+
+
+# -- case builders (importable by the oracle) ------------------------------
+
+
+def case_plain():
+    """Mesh-sharded what-if with collected assignments, plus the full
+    JSONL surface written under KSIM_DETERMINISTIC_JSONL — placed counts,
+    assignment matrix, and the JSONL file bytes must all match the
+    single-process mesh run. (Boundary retry rides the kube chaos case —
+    it is exclusive with collect_assignments.)"""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+    from kubernetes_simulator_tpu.sim.synthetic import (
+        make_cluster,
+        make_workload,
+    )
+    from kubernetes_simulator_tpu.sim.whatif import (
+        WhatIfEngine,
+        uniform_scenarios,
+    )
+    from kubernetes_simulator_tpu.utils.metrics import JsonlWriter, whatif_rows
+
+    cluster = make_cluster(12, seed=21, taint_fraction=0.2)
+    pods, _ = make_workload(
+        48, seed=21, with_affinity=True, with_spread=True,
+        with_tolerations=True,
+    )
+    ec, ep = encode(cluster, pods)
+    scenarios = uniform_scenarios(ec, 8, seed=21, p_capacity=0.5, p_taint=0.3)
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), mesh=make_mesh(),
+        chunk_waves=4, collect_assignments=True,
+    )
+    res = eng.run()
+
+    with _deterministic_jsonl():
+        fd, path = tempfile.mkstemp(suffix=".jsonl")
+        os.close(fd)
+        try:
+            ctx = {"seed": 21, "engine": "v3", "config_hash": "dcn-parity"}
+            with JsonlWriter(path, context=ctx) as out:
+                for row in whatif_rows(res, {"mesh": True}):
+                    out.write(row)
+            jsonl = open(path, "rb").read()
+        finally:
+            os.unlink(path)
+
+    return eng, {
+        "placed": res.placed.tolist(),
+        "unschedulable": res.unschedulable.tolist(),
+        "total_placed": int(res.total_placed),
+        "assignments_sha": _arr_sha(res.assignments),
+        "jsonl_sha": _sha(jsonl),
+        "jsonl_rows": len(jsonl.splitlines()),
+    }
+
+
+def case_chaos():
+    """Kube boundary mode with per-scenario chaos timelines and series
+    telemetry on the no-mesh path — exercises the process-LOCAL host
+    mirrors and the telemetry leg of the gather payload (per-scenario
+    ReplayTelemetry instances ride the pickle; only their
+    virtual-time-derived fields are compared — phase timers are
+    wall-clock)."""
+    import math
+
+    import numpy as np
+
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    nodes = [Node(f"n{i}", {"cpu": 8.0}) for i in range(5)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=30.0)
+        for i in range(28)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    evs = [
+        NodeEvent(time=8.0, kind="node_down", node=0),
+        NodeEvent(time=18.0, kind="node_up", node=0),
+        NodeEvent(time=24.0, kind="node_down", node=1),
+    ]
+    scenarios = [
+        Scenario(),
+        Scenario(events=evs),
+        Scenario(events=[NodeEvent(time=25.0, kind="node_down", node=0)]),
+        Scenario(events=[NodeEvent(time=4.0, kind="node_down", node=2)]),
+    ]
+    eng = WhatIfEngine(
+        ec, ep, scenarios, cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=64, collect_assignments=True,
+        telemetry="series",
+    )
+    res = eng.run()
+    tel = [
+        None if t is None else {
+            "granularity": t.granularity,
+            "latency": t.latency,
+            "reasons": t.reasons,
+            "rejection_attempts": t.rejection_attempts,
+            "zero_latency_binds": t.zero_latency_binds,
+            "bind_latency": {
+                str(k): v for k, v in (t.bind_latency or {}).items()
+            },
+        }
+        for t in (res.scenario_telemetry or [])
+    ]
+    return eng, {
+        "placed": res.placed.tolist(),
+        "evictions": res.evictions.tolist(),
+        "evict_rescheduled": res.evict_rescheduled.tolist(),
+        "evict_stranded": res.evict_stranded.tolist(),
+        "evict_latency_mean": [
+            float(x) for x in np.asarray(res.evict_latency_mean)
+        ],
+        "latency_p50": [
+            None if math.isnan(x) else float(x)
+            for x in np.asarray(res.latency_p50, np.float64)
+        ],
+        "assignments_sha": _arr_sha(res.assignments),
+        "scenario_count": len(tel),
+        "telemetry_sha": _sha(
+            json.dumps(tel, sort_keys=True).encode()
+        ),
+    }
+
+
+def case_tuner():
+    """A small CEM policy search over the mesh — every sweep is a what-if
+    replay that gathers objectives once, so the full trajectory (every
+    candidate score, every round) must be process-count-independent."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.parallel.mesh import make_mesh
+    from kubernetes_simulator_tpu.sim.tuner import PolicyTuner
+
+    nodes = [Node(f"n{i}", capacity={"cpu": 4.0, "memory": 16.0})
+             for i in range(4)]
+    pods = [
+        Pod(f"small-{i}", requests={"cpu": 1.0, "memory": 1.0},
+            arrival_time=float(i))
+        for i in range(8)
+    ] + [
+        Pod(f"large-{i}", requests={"cpu": 4.0, "memory": 4.0},
+            arrival_time=float(8 + i))
+        for i in range(2)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    res = PolicyTuner(
+        ec, ep, FrameworkConfig(),
+        algo="cem", population=4, rounds=2, seed=0,
+        # Flat axes must divide the mesh: train = 4x2 = 8 rows, held-out
+        # = 4x2 (winner + default) = 8 rows — both divide 8 devices
+        # single-process and 4 local devices per DCN process.
+        train_scenarios=2, heldout_scenarios=4, scenario_seed=1,
+        p_node_down=0.0, p_capacity=0.25, p_taint=0.0,
+        chunk_waves=4, mesh=make_mesh(), cpu_oracle=False,
+    ).run()
+    return None, {
+        "best_policy": res.best_policy,
+        "best_vector_sha": _arr_sha(res.best_vector),
+        "train_objective": float(res.train_objective),
+        "heldout_objective": float(res.heldout_objective),
+        "default_heldout_objective": float(res.default_heldout_objective),
+        "evaluations": int(res.evaluations),
+        "trajectory_sha": _sha(
+            json.dumps(res.trajectory, sort_keys=True).encode()
+        ),
+    }
+
+
+def case_ckpt():
+    """Single-replay kube/chaos run with mid-trace checkpointing: the
+    checkpoint BLOB CONTENT (every array, bit-for-bit) and the final
+    assignments must match the single-process run. Content hashes rather
+    than file bytes: .npz is a zip whose member headers carry wall-clock
+    mtimes."""
+    import numpy as np
+
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+
+    nodes = [Node(f"n{i}", {"cpu": 8.0}) for i in range(5)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=30.0)
+        for i in range(28)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    evs = [
+        NodeEvent(time=8.0, kind="node_down", node=0),
+        NodeEvent(time=18.0, kind="node_up", node=0),
+    ]
+    fd, ck = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    os.unlink(ck)
+    try:
+        res = JaxReplayEngine(
+            ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+            retry_buffer=64,
+        ).replay(node_events=evs, checkpoint_path=ck, checkpoint_every=8)
+        with np.load(ck) as z:
+            blob_sha = _sha(
+                b"".join(
+                    k.encode() + b":" + _arr_sha(z[k]).encode()
+                    for k in sorted(z.files)
+                )
+            )
+    finally:
+        if os.path.exists(ck):
+            os.unlink(ck)
+    return None, {
+        "checkpoint_sha": blob_sha,
+        "placed": int(res.placed),
+        "evictions": int(res.evictions),
+        "assignments_sha": _arr_sha(res.assignments),
+    }
+
+
+def case_odd():
+    """A batch that does NOT divide over the processes (S=7, nproc=2):
+    the engine warns and runs fully replicated — every process computes
+    all scenarios, no gather fires, ``process_count`` stays 1 — and the
+    results still match the single-process run."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.synthetic import (
+        make_cluster,
+        make_workload,
+    )
+    from kubernetes_simulator_tpu.sim.whatif import (
+        WhatIfEngine,
+        uniform_scenarios,
+    )
+
+    cluster = make_cluster(8, seed=5)
+    pods, _ = make_workload(32, seed=5)
+    ec, ep = encode(cluster, pods)
+    scenarios = uniform_scenarios(ec, 7, seed=5, p_capacity=0.5, p_taint=0.2)
+    eng = WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=4)
+    res = eng.run()
+    assert not eng._dcn_sliced
+    assert eng._replicate_count == 0
+    assert res.process_count == 1
+    return None, {
+        "placed": res.placed.tolist(),
+        "unschedulable": res.unschedulable.tolist(),
+        "total_placed": int(res.total_placed),
+    }
+
+
+CASES = {
+    "plain": case_plain,
+    "chaos": case_chaos,
+    "tuner": case_tuner,
+    "ckpt": case_ckpt,
+    "odd": case_odd,
+}
+
+
+def run_cases(names, expect_dcn: bool):
+    """Run the named cases in order, pinning the round-11 counters:
+    zero cross-process ``_fetch`` replications ever, and under DCN exactly
+    ONE gather per what-if replay (the tuner runs one replay per sweep)."""
+    from kubernetes_simulator_tpu.parallel import dcn
+
+    out = {}
+    for name in names:
+        g0 = dcn.GATHER_COUNT
+        eng, payload = CASES[name]()
+        delta = dcn.GATHER_COUNT - g0
+        if eng is not None:
+            assert eng._replicate_count == 0, (
+                f"{name}: cross-process _fetch replication in chunk loop"
+            )
+            want = 1 if expect_dcn else 0
+            assert delta == want, (
+                f"{name}: {delta} gathers per replay, want {want}"
+            )
+        elif not expect_dcn:
+            assert delta == 0, f"{name}: gathered in single-process run"
+        out[name] = payload
+    return out
+
+
+def main() -> None:
+    import jax
+
+    from kubernetes_simulator_tpu.parallel import dcn
+
+    assert dcn.maybe_init_from_env(), "KSIM_DCN_* env not set"
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    nproc, pid = dcn.process_info()
+    assert nproc == int(os.environ["KSIM_DCN_NPROC"]), nproc
+    assert jax.device_count() == len(jax.local_devices()) * nproc
+
+    names = os.environ["KSIM_DCN_CASES"].split(",")
+    out = run_cases(names, expect_dcn=True)
+    print("DCN_CASES_RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

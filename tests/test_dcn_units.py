@@ -1,0 +1,295 @@
+"""Single-process unit tests for the round-11 DCN plumbing: slicing
+arithmetic, mesh localization, per-process output paths, DCN-aware
+population fitting, the concurrent-safe compile cache, the
+enable-before-initialize ordering contract, deterministic JSONL, and the
+schema checker's round-11 fields — everything that doesn't need a real
+2-process fleet (tests/test_dcn.py covers that)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.parallel import dcn
+from kubernetes_simulator_tpu.parallel.mesh import (
+    fit_population,
+    make_mesh,
+    spans_processes,
+)
+
+# -- slicing / mesh localization -------------------------------------------
+
+
+def test_local_slice_contiguous_blocks(monkeypatch):
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 0))
+    assert dcn.local_slice(8) == slice(0, 4)
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 1))
+    assert dcn.local_slice(8) == slice(4, 8)
+    monkeypatch.setattr(dcn, "process_info", lambda: (4, 2))
+    assert dcn.local_slice(8) == slice(4, 6)
+
+
+def test_local_slice_identity_single_process():
+    assert dcn.local_slice(8) == slice(0, 8)
+
+
+def test_spans_processes_and_localize_identity():
+    """Single-process meshes never span; localize_mesh is the identity for
+    them and for None (the production call sits unconditionally in
+    WhatIfEngine.__init__, so the identity path IS the common path)."""
+    mesh = make_mesh()
+    assert not spans_processes(None)
+    assert not spans_processes(mesh)
+    assert dcn.localize_mesh(None) is None
+    assert dcn.localize_mesh(mesh) is mesh
+
+
+def test_output_path_for_process(monkeypatch):
+    assert dcn.output_path_for_process(None) is None
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 0))
+    assert dcn.output_path_for_process("out.jsonl") == "out.jsonl"
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 1))
+    assert dcn.output_path_for_process("out.jsonl") == "out.jsonl.p1"
+
+
+def test_gather_requires_initialized_coordinator():
+    with pytest.raises(RuntimeError, match="not initialized"):
+        dcn.gather("never", {"x": 1})
+
+
+def test_maybe_init_noop_without_env(monkeypatch):
+    for k in ("KSIM_DCN_COORD", "DCN_COORD", "KSIM_DCN_NPROC", "DCN_NPROC"):
+        monkeypatch.delenv(k, raising=False)
+    assert dcn.maybe_init_from_env() is False
+
+
+def test_enable_cache_before_initialize_ordering(monkeypatch):
+    """The regression pin for the round-11 ordering contract:
+    ``maybe_init_from_env`` must configure the persistent compile cache
+    BEFORE ``jax.distributed.initialize`` (a cache enabled after the
+    backend exists misses the very compiles the DCN workers share)."""
+    import kubernetes_simulator_tpu.parallel.mesh as mesh_mod
+    import kubernetes_simulator_tpu.utils.compile_cache as cc
+
+    calls = []
+    monkeypatch.setattr(cc, "enable", lambda *a, **k: calls.append("cache"))
+    monkeypatch.setattr(
+        mesh_mod, "init_distributed",
+        lambda **kw: calls.append(("init", kw["num_processes"],
+                                   kw["process_id"])),
+    )
+    monkeypatch.setenv("KSIM_DCN_COORD", "127.0.0.1:1")
+    monkeypatch.setenv("KSIM_DCN_NPROC", "2")
+    monkeypatch.setenv("KSIM_DCN_PID", "1")
+    assert dcn.maybe_init_from_env() is True
+    assert calls == ["cache", ("init", 2, 1)]
+
+
+# -- engine-level slicing (process count faked; construction only) ---------
+
+
+def _tiny_batch(S):
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.synthetic import (
+        make_cluster,
+        make_workload,
+    )
+    from kubernetes_simulator_tpu.sim.whatif import uniform_scenarios
+
+    cluster = make_cluster(6, seed=3)
+    pods, _ = make_workload(16, seed=3)
+    ec, ep = encode(cluster, pods)
+    return ec, ep, uniform_scenarios(ec, S, seed=3, p_capacity=0.5)
+
+
+def test_engine_slices_scenarios_per_process(monkeypatch):
+    """With a faked 2-process world the engine keeps only its contiguous
+    half of the scenario axis (construction only — running would need the
+    real coordinator)."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine
+
+    ec, ep, scenarios = _tiny_batch(8)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    eng = WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=4)
+    assert eng._dcn_sliced
+    assert eng.S_global == 8 and eng.S == 4
+    assert eng._proc_lo == 4
+
+
+def test_engine_replicates_on_uneven_batch(monkeypatch):
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine
+
+    ec, ep, scenarios = _tiny_batch(7)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    eng = WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=4)
+    assert not eng._dcn_sliced
+    assert eng.S == 7
+
+
+def test_engine_rejects_set_label_under_dcn(monkeypatch):
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.sim.whatif import (
+        Perturbation,
+        Scenario,
+        WhatIfEngine,
+    )
+
+    ec, ep, _ = _tiny_batch(2)
+    scenarios = [
+        Scenario(),
+        Scenario([Perturbation(
+            "set_label", nodes=np.array([0]),
+            key="topology.kubernetes.io/zone", value="zz",
+        )]),
+    ]
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="set_label"):
+        WhatIfEngine(ec, ep, scenarios, FrameworkConfig(), chunk_waves=4)
+
+
+def test_single_process_run_untouched_by_dcn_paths():
+    """The common case: no DCN env, no slicing, no gather, result stamps
+    process_count=1 — and the replication counter stays zero (the
+    local-mesh chunk loop never round-trips full tensors)."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine
+
+    ec, ep, scenarios = _tiny_batch(8)
+    g0 = dcn.GATHER_COUNT
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), mesh=make_mesh(),
+        chunk_waves=4,
+    )
+    res = eng.run()
+    assert not eng._dcn_sliced
+    assert eng._replicate_count == 0
+    assert dcn.GATHER_COUNT == g0
+    assert res.process_count == 1
+    assert res.n_devices == 8
+
+
+# -- fit_population: DCN factorizations ------------------------------------
+
+
+def test_fit_population_single_process_mesh():
+    mesh = make_mesh()  # 8 devices (conftest forces 8 virtual CPUs)
+    assert fit_population(5, 3, mesh) == 8  # 8*3 first multiple of 8
+    assert fit_population(5, 8, mesh) == 5  # already divides
+    assert fit_population(1, 1, None) == 1
+
+
+def test_fit_population_dcn_no_mesh(monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # Mesh-less DCN sweep: the flat axis must still divide the process
+    # count for the per-process slices to be even.
+    assert fit_population(5, 3, None) == 6  # 6*3 even, 5*3 odd
+
+
+def test_fit_population_dcn_local_mesh(monkeypatch):
+    mesh = make_mesh()  # local 8 devices; x2 processes = 16 global
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert fit_population(5, 3, mesh) == 16  # 16*3 = 48 divides 16
+    assert fit_population(4, 4, mesh) == 4  # 16 divides 16 already
+
+
+# -- compile cache: atomic writes + ordering -------------------------------
+
+
+def test_atomic_put_writes_whole_entries(tmp_path):
+    """The monkeypatched LRUCache.put goes through a per-process temp file
+    + os.replace: the entry appears complete, no temp droppings remain,
+    and a second put of the same key is a no-op (first writer wins)."""
+    from jax._src import lru_cache as _lru
+
+    from kubernetes_simulator_tpu.utils.compile_cache import (
+        patch_atomic_writes,
+    )
+
+    assert patch_atomic_writes() is True
+    cache = _lru.LRUCache(str(tmp_path), max_size=-1)
+    cache.put("entry", b"x" * 1024)
+    assert cache.get("entry") == b"x" * 1024
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert "entry-cache" in files
+    assert not [f for f in files if ".tmp." in f], files
+    cache.put("entry", b"y" * 1024)  # concurrent-sibling replay: kept
+    assert cache.get("entry") == b"x" * 1024
+    with pytest.raises(ValueError, match="empty"):
+        cache.put("", b"z")
+
+
+# -- deterministic JSONL ---------------------------------------------------
+
+
+def test_deterministic_jsonl_zeroes_wall_clock(tmp_path, monkeypatch):
+    """KSIM_DETERMINISTIC_JSONL=1 pins ts/wall_clock_s/placements_per_sec
+    to 0.0 (fields stay present as numbers — schema v2 requires them), so
+    DCN parity runs can compare JSONL bytes."""
+    from kubernetes_simulator_tpu.utils.metrics import (
+        JsonlWriter,
+        deterministic_jsonl,
+        whatif_rows,
+    )
+
+    monkeypatch.delenv("KSIM_DETERMINISTIC_JSONL", raising=False)
+    assert not deterministic_jsonl()
+    monkeypatch.setenv("KSIM_DETERMINISTIC_JSONL", "1")
+    assert deterministic_jsonl()
+
+    class _Res:
+        placed = np.array([3, 4], np.int32)
+        unschedulable = np.array([1, 0], np.int32)
+        total_placed = 7
+        wall_clock_s = 1.25
+        placements_per_sec = 5.6
+        completions_on = True
+        engine = "v3"
+        utilization_cpu = None
+
+    path = tmp_path / "d.jsonl"
+    with JsonlWriter(str(path), context={"seed": 0}) as out:
+        for row in whatif_rows(_Res()):
+            out.write(row)
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert all(r["ts"] == 0.0 for r in rows)
+    assert rows[0]["wall_clock_s"] == 0.0
+    assert rows[0]["placements_per_sec"] == 0.0
+    # identical rows ⇒ identical bytes, run to run
+    with JsonlWriter(str(tmp_path / "e.jsonl"), context={"seed": 0}) as out:
+        for row in whatif_rows(_Res()):
+            out.write(row)
+    assert (tmp_path / "e.jsonl").read_bytes() == path.read_bytes()
+
+
+# -- schema checker: round-11 fields ---------------------------------------
+
+
+def test_schema_accepts_dcn_fields():
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", "scripts")
+        ),
+    )
+    from check_metrics_schema import validate_row
+
+    row = {
+        "ts": 0.0, "schema": 2, "seed": 0, "engine": "v3",
+        "config_hash": "h", "kind": "whatif-aggregate",
+        "scenarios": 8, "total_placed": 100, "wall_clock_s": 0.0,
+        "placements_per_sec": 0.0, "completions_on": True,
+        "process_count": 2, "n_devices": 8,
+        "mesh_shape": {"scenario": 8},
+        "dcn_scaling": {"process_count": 2},
+    }
+    assert validate_row(row) == []
+    assert validate_row({**row, "process_count": "2"})
+    assert validate_row({**row, "dcn_scaling": 3})

@@ -1,0 +1,121 @@
+"""Donation audit for the chunk-dispatch hot path (round 11 satellite):
+the per-chunk state updates (release folds, boundary deltas) donate the
+outgoing state buffers, so steady-state replay re-uses allocations
+instead of doubling them.
+
+Two pins, on both engines that own a subtract-fold:
+
+* no donation warnings — a donated buffer that XLA cannot re-use makes
+  jax emit "Some donated buffers were not usable"; any such warning means
+  the donation audit regressed (layout mismatch, an alias kept alive);
+* stable live-buffer count — a second replay on the same engine must not
+  grow ``jax.live_arrays()``: leaked per-chunk buffers accumulate there
+  long before they show up as OOM at Borg scale.
+"""
+
+import gc
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+
+
+def _trace(num_pods=24, num_nodes=5):
+    nodes = [Node(f"n{i}", {"cpu": 8.0}) for i in range(num_nodes)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=20.0)
+        for i in range(num_pods)
+    ]
+    return encode(Cluster(nodes=nodes), pods)
+
+
+def _live_count() -> int:
+    gc.collect()
+    return len(jax.live_arrays())
+
+
+def _assert_no_donation_warnings(record):
+    bad = [str(w.message) for w in record if "donat" in str(w.message).lower()]
+    assert not bad, f"donation warnings: {bad}"
+
+
+def test_whatif_completions_chunk_loop_donates_cleanly():
+    """The what-if release fold (``_subtract_stacked_planes`` →
+    ``_donated_subtract``) across several chunk boundaries: no donation
+    warnings, and a replay on a warm engine leaves the live-buffer count
+    where it was."""
+    ec, ep = _trace()
+    scenarios = uniform_scenarios(ec, 4, seed=1, p_capacity=0.5)
+    eng = WhatIfEngine(
+        ec, ep, scenarios, FrameworkConfig(), wave_width=4, chunk_waves=2,
+    )
+    placed = []
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for _ in range(3):
+            res = eng.run()
+            placed.append(np.array(res.placed, copy=True))
+            # Results hold zero-copy views of small fetched device
+            # buffers (utilization) — drop them so the count below sees
+            # only the ENGINE's steady state.
+            del res
+        baseline = _live_count()
+        res = eng.run()
+        placed.append(np.array(res.placed, copy=True))
+        del res
+        after = _live_count()
+    _assert_no_donation_warnings(rec)
+    for p in placed[1:]:
+        np.testing.assert_array_equal(placed[0], p)
+    assert after <= baseline, (
+        f"live buffers grew across replays: {baseline} -> {after}"
+    )
+
+
+def test_replay_boundary_deltas_donate_cleanly():
+    """The single-replay twins (``_apply_release`` /
+    ``_apply_boundary_delta``) under the kube boundary mode with retry:
+    same two pins on JaxReplayEngine."""
+    ec, ep = _trace()
+    eng = JaxReplayEngine(
+        ec, ep, FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}]),
+        wave_width=1, chunk_waves=4, preemption="kube", retry_buffer=16,
+    )
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        first = eng.replay()
+        second = eng.replay()  # warm: every lazy jit now built
+        baseline = _live_count()
+        third = eng.replay()
+        after = _live_count()
+    _assert_no_donation_warnings(rec)
+    np.testing.assert_array_equal(first.assignments, second.assignments)
+    np.testing.assert_array_equal(first.assignments, third.assignments)
+    assert after <= baseline, (
+        f"live buffers grew across replays: {baseline} -> {after}"
+    )
+
+
+def test_donated_subtract_matches_eager():
+    """The donated fold is arithmetic-identical to the eager tree-map it
+    replaced (and donation actually consumed the argument)."""
+    ec, ep = _trace(num_pods=8, num_nodes=3)
+    eng = WhatIfEngine(
+        ec, ep, uniform_scenarios(ec, 2, seed=0),
+        FrameworkConfig(), wave_width=4, chunk_waves=2,
+    )
+    a = {"u": jax.numpy.arange(12.0).reshape(3, 4)}
+    b = {"u": jax.numpy.ones((3, 4))}
+    out = eng._donated_subtract(a, b)
+    np.testing.assert_allclose(
+        np.asarray(out["u"]), np.arange(12.0).reshape(3, 4) - 1.0
+    )
+    assert a["u"].is_deleted(), "donated input survived — donation inert"
