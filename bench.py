@@ -34,18 +34,32 @@ detail headline: candidate-policies/sec through the policy tuner's
 batched sweep — the config2 search space, i.e. the full default plugin
 set's 5 Score weights plus the NodeResourcesFit strategy selector; 0
 population disables).
+
+Round 12: ``--profile`` (or ``KSIM_PROFILE_DIR=<dir>``) wraps the timed
+headline runs in ``jax.profiler.trace`` with TraceAnnotation markers on
+the PHASE_NAMES phases and chunk dispatch (utils.profiling) — load the
+trace dir in TensorBoard/Perfetto; results are bit-identical with
+profiling on or off. ``detail`` gains the engine-level wall-clock
+``phases`` breakdown (from the fleet-merged telemetry, keys
+``p<pid>/<phase>``) and a ``live_buffers`` watermark gauge
+(``jax.live_arrays()`` count/bytes + backend peak bytes where reported).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
 
 def main():
+    if "--profile" in sys.argv[1:]:
+        os.environ.setdefault(
+            "KSIM_PROFILE_DIR", os.path.join(os.getcwd(), "ksim_profile")
+        )
     nodes = int(os.environ.get("BENCH_NODES", 2000))
     pods_n = int(os.environ.get("BENCH_PODS", 20_000))
     S = int(os.environ.get("BENCH_SCENARIOS", 128))
@@ -126,13 +140,26 @@ def main():
         ws = sorted(r.wall_clock_s for r in rs)
         return rs[0], float(np.median(ws)), ws
 
-    res, med_wall, walls = _timed(
-        WhatIfEngine(
-            ec, ep, uniform_scenarios(ec, S_head, seed=0), cfg,
-            chunk_waves=512, mesh=mesh,
-        ),
-        runs,
+    # Device-profiler hooks (round 12): the per-process trace lands in
+    # KSIM_PROFILE_DIR (siblings suffix .p<pid> like every other sink).
+    from kubernetes_simulator_tpu.utils.profiling import (
+        device_trace,
+        live_buffer_stats,
+        profile_dir,
     )
+
+    prof_dir = profile_dir()
+    eng_head = WhatIfEngine(
+        ec, ep, uniform_scenarios(ec, S_head, seed=0), cfg,
+        chunk_waves=512, mesh=mesh,
+    )
+    if prof_dir:
+        # Compile outside the trace: a multi-second first dispatch fills
+        # the profiler's event buffer and truncates the annotations the
+        # trace exists for.
+        eng_head.run()
+    with device_trace(dcn.output_path_for_process(prof_dir)):
+        res, med_wall, walls = _timed(eng_head, runs)
     value = res.total_placed / med_wall if med_wall > 0 else 0.0
     vs = value / cpu_pps if cpu_pps > 0 else 0.0
 
@@ -303,6 +330,18 @@ def main():
                     "cpu_default_path_pps": round(cpu_pps, 1),
                     "scenario0_placed": int(res.placed[0]),
                     "device": _device_kind(),
+                    # Round 12: engine wall-clock phase shares (fleet-
+                    # merged, "p<pid>/<phase>" keys) + live-buffer/memory
+                    # watermark after the timed runs.
+                    "phases": (
+                        dict(res.fleet_telemetry.phases)
+                        if res.fleet_telemetry is not None
+                        else {}
+                    ),
+                    "live_buffers": live_buffer_stats(),
+                    **(
+                        {"profile_dir": prof_dir} if prof_dir else {}
+                    ),
                     **dcn_block,
                     **scaling,
                     **cont,

@@ -31,9 +31,11 @@ must call :func:`gather` the same number of times with the same ``name``
 from __future__ import annotations
 
 import base64
+import json
 import os
 import pickle
-from typing import Optional
+import time
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -145,6 +147,209 @@ def _timeout_ms() -> int:
     return int(float(os.environ.get("KSIM_DCN_TIMEOUT_S", "300")) * 1000)
 
 
+# -- liveness heartbeats (round 12) -----------------------------------------
+#
+# Each process overwrites ONE key (``ksim/hb/<pid>``) with a small JSON
+# progress beacon on a chunk cadence. Plain KV puts — no barrier, no
+# blocking read, never counted by GATHER_COUNT — so the "one gather per
+# replay" contract is untouched. Readers (the attributed gather timeout
+# below, and out-of-fleet monitors via the KSIM_DCN_HB_DIR file mirror)
+# see at most one stale beacon per process, never a backlog.
+
+HB_PREFIX = "ksim/hb"
+
+
+def heartbeat_every() -> int:
+    """Chunk cadence for :func:`heartbeat` publication
+    (``KSIM_DCN_HEARTBEAT_EVERY``, default every chunk; 0 disables)."""
+    return int(os.environ.get("KSIM_DCN_HEARTBEAT_EVERY", "1"))
+
+
+def _stall_s() -> float:
+    """Beacon age beyond which a silent sibling is presumed dead
+    (``KSIM_DCN_STALL_S``). The default is generous relative to the
+    per-chunk cadence: a chunk that takes a minute of wall clock without
+    a beat means the process is gone, not slow."""
+    return float(os.environ.get("KSIM_DCN_STALL_S", "60"))
+
+
+def _poll_s() -> float:
+    """Inner poll interval of the attributed gather wait
+    (``KSIM_DCN_POLL_S``)."""
+    return float(os.environ.get("KSIM_DCN_POLL_S", "2"))
+
+
+def heartbeat(
+    chunk: int,
+    total: Optional[int] = None,
+    block: Optional[tuple] = None,
+    wall_s: Optional[float] = None,
+    phases: Optional[Dict[str, float]] = None,
+    state: str = "run",
+    extra: Optional[dict] = None,
+) -> bool:
+    """Publish this process's progress beacon: last completed ``chunk``
+    (−1 before the first), global scenario ``block`` ``(lo, hi)``,
+    wall-clock seconds, a phase-timer snapshot, and a live-buffer gauge.
+    Defensive by design — a heartbeat failure must never kill a replay —
+    and a no-op outside multi-process runs. Returns True when published."""
+    try:
+        nproc, pid = process_info()
+    except Exception:
+        return False
+    if nproc <= 1:
+        return False
+    beat: dict = {
+        "pid": int(pid),
+        "chunk": int(chunk),
+        "state": str(state),
+        "t": time.time(),
+    }
+    if total is not None:
+        beat["total_chunks"] = int(total)
+    if block is not None:
+        beat["block"] = [int(block[0]), int(block[1])]
+    if wall_s is not None:
+        beat["wall_s"] = round(float(wall_s), 3)
+    if phases:
+        beat["phases"] = {k: round(float(v), 6) for k, v in phases.items()}
+    try:  # live-buffer gauge (cheap count; bytes are the bench's job)
+        import jax
+
+        beat["live_buffers"] = len(jax.live_arrays())
+    except Exception:
+        pass
+    if extra:
+        beat.update(extra)
+    blob = json.dumps(beat, sort_keys=True)
+    hb_dir = os.environ.get("KSIM_DCN_HB_DIR")
+    if hb_dir:
+        # File mirror for monitors OUTSIDE the fleet (dcn_launch --watch):
+        # the launcher parent never joins the coordination service, so it
+        # tails these instead. Atomic replace — readers never see a torn
+        # write.
+        try:
+            tmp = os.path.join(hb_dir, f".p{pid}.tmp")
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(hb_dir, f"p{pid}.json"))
+        except OSError:
+            pass
+    try:
+        _client().key_value_set(
+            f"{HB_PREFIX}/{pid}", blob, allow_overwrite=True
+        )
+    except Exception:
+        return False
+    return True
+
+
+def maybe_heartbeat(chunk_done: int, every: Optional[int] = None, **kw) -> bool:
+    """Cadence gate for :func:`heartbeat`: publish when ``chunk_done + 1``
+    is a multiple of ``every`` (so the ``chunk_done=-1`` start-of-replay
+    beacon always publishes, and every=1 beats on every chunk)."""
+    if every is None:
+        every = heartbeat_every()
+    if every <= 0:
+        return False
+    if (int(chunk_done) + 1) % every:
+        return False
+    return heartbeat(chunk_done, **kw)
+
+
+def read_heartbeats() -> Dict[int, dict]:
+    """All published beacons, ``{pid: beat}``. Empty on any failure —
+    callers treat a missing beacon as \"no evidence\", not as death."""
+    try:
+        entries = _client().key_value_dir_get(HB_PREFIX)
+    except Exception:
+        return {}
+    out: Dict[int, dict] = {}
+    for key, val in entries:
+        tail = str(key).rsplit("/", 1)[-1]
+        try:
+            out[int(tail)] = json.loads(val)
+        except (ValueError, TypeError):
+            continue
+    return out
+
+
+class DcnGatherTimeout(RuntimeError):
+    """gather() abandoned: a sibling never published its payload. Carries
+    the missing pids and the heartbeat table for programmatic use."""
+
+    def __init__(self, msg, missing=None, heartbeats=None):
+        super().__init__(msg)
+        self.missing = list(missing or [])
+        self.heartbeats = dict(heartbeats or {})
+
+
+def _describe_process(p: int, hb: Dict[int, dict], now: float) -> str:
+    b = hb.get(p)
+    if b is None:
+        return f"process {p}: no heartbeat ever received"
+    age = max(0.0, now - float(b.get("t", now)))
+    parts = [f"process {p}: last heartbeat {age:.1f}s ago"]
+    chunk = b.get("chunk", "?")
+    total = b.get("total_chunks")
+    parts.append(
+        f"last completed chunk {chunk}"
+        + (f"/{total}" if total is not None else "")
+    )
+    parts.append(f"state={b.get('state', '?')}")
+    if "block" in b:
+        lo, hi = b["block"]
+        parts.append(f"scenario block [{lo}, {hi})")
+    return ", ".join(parts)
+
+
+def _get_attributed(c, key: str, p: int, name: str):
+    """``blocking_key_value_get`` as a short poll loop: each expiry
+    inspects sibling heartbeats. A sibling whose beacon has gone stale
+    past KSIM_DCN_STALL_S while we sit in the gather is presumed dead and
+    the wait is abandoned IMMEDIATELY with an attributed
+    :class:`DcnGatherTimeout` — instead of the anonymous hang to the full
+    KSIM_DCN_TIMEOUT_S. A sibling with a fresh beacon (or none at all —
+    heartbeats may be disabled) keeps the round-11 semantics: wait to the
+    full deadline, then raise with whatever attribution exists."""
+    deadline = time.monotonic() + _timeout_ms() / 1000.0
+    poll_ms = max(int(_poll_s() * 1000), 50)
+    stall = _stall_s()
+    while True:
+        remaining_ms = int((deadline - time.monotonic()) * 1000)
+        if remaining_ms <= 0:
+            hb = read_heartbeats()
+            raise DcnGatherTimeout(
+                f"gather({name!r}): timed out after "
+                f"KSIM_DCN_TIMEOUT_S={_timeout_ms() / 1000:g}s waiting for "
+                f"{_describe_process(p, hb, time.time())}. The fleet must "
+                "be restarted together (scripts/dcn_launch.py).",
+                missing=[p],
+                heartbeats=hb,
+            )
+        try:
+            return c.blocking_key_value_get(key, min(poll_ms, remaining_ms))
+        except Exception:
+            hb = read_heartbeats()
+            b = hb.get(p)
+            if b is not None and (
+                time.time() - float(b.get("t", 0.0))
+            ) > stall:
+                raise DcnGatherTimeout(
+                    f"gather({name!r}): process {p} looks DEAD — "
+                    f"{_describe_process(p, hb, time.time())}; its beacon "
+                    "stopped advancing for more than "
+                    f"KSIM_DCN_STALL_S={stall:g}s while this process is "
+                    "already in the end-of-replay gather. The scenario "
+                    "axis has a hole; restart the fleet together "
+                    "(scripts/dcn_launch.py).",
+                    missing=[p],
+                    heartbeats=hb,
+                )
+            # Fresh beacon (sibling alive but slower) or no beacon at all
+            # (heartbeats disabled) — keep waiting toward the deadline.
+
+
 def gather(name: str, payload) -> list:
     """THE cross-process gather: publish this process's ``payload`` and
     return every process's, in process order. Called at most once per
@@ -161,7 +366,6 @@ def gather(name: str, payload) -> list:
     _seq += 1
     GATHER_COUNT += 1
     c = _client()
-    tmo = _timeout_ms()
     blob = base64.b64encode(
         pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
     ).decode("ascii")
@@ -177,12 +381,12 @@ def gather(name: str, payload) -> list:
         if p == pid:
             out.append(payload)
             continue
-        n = int(c.blocking_key_value_get(f"{prefix}/{p}/n", tmo))
+        n = int(_get_attributed(c, f"{prefix}/{p}/n", p, name))
         out.append(
             pickle.loads(
                 base64.b64decode(
                     "".join(
-                        c.blocking_key_value_get(f"{prefix}/{p}/{j}", tmo)
+                        _get_attributed(c, f"{prefix}/{p}/{j}", p, name)
                         for j in range(n)
                     )
                 )

@@ -55,6 +55,43 @@ class _NullCtx:
 
 _NULL_CTX = _NullCtx()
 
+
+def _make_tick(tel):
+    """Phase-tick factory shared by both replay paths: the telemetry
+    phase timer when collecting, stacked under a
+    ``jax.profiler.TraceAnnotation`` when ``KSIM_PROFILE_DIR`` is armed
+    (round 12 device-profiler hooks) — the annotation names the
+    PHASE_NAMES phase in XLA traces. ``profiling_active`` is consulted
+    ONCE per replay, here; with profiling off the returned callable is
+    exactly the pre-round-12 lambda."""
+    base = (
+        (lambda name: tel.phases.tick(name))
+        if tel is not None
+        else (lambda name: _NULL_CTX)
+    )
+    from ..utils.profiling import annotate, profiling_active
+
+    if not profiling_active():
+        return base
+    import contextlib
+
+    @contextlib.contextmanager
+    def _tick(name):
+        with annotate(name), base(name):
+            yield
+
+    return _tick
+
+
+def _chunk_ann(ci: int):
+    """Chunk-dispatch annotation: ``chunk:<ci>`` marker in device traces
+    when profiling is armed, else the shared no-op context."""
+    from ..utils.profiling import annotate, profiling_active
+
+    if not profiling_active():
+        return _NULL_CTX
+    return annotate(f"chunk:{ci}")
+
 DEFAULT_PLUGINS = (
     "NodeResourcesFit",
     "TaintToleration",
@@ -887,11 +924,7 @@ class JaxReplayEngine:
             if self.telemetry_cfg.enabled
             else None
         )
-        _tick = (
-            (lambda name: tel.phases.tick(name))
-            if tel is not None
-            else (lambda name: _NULL_CTX)
-        )
+        _tick = _make_tick(tel)
         bops = BoundaryOps(
             self.ec, self.pods, fw,
             WaveBatch(idx=idx, wave_width=self.wave_width),
@@ -1084,7 +1117,7 @@ class JaxReplayEngine:
                             ),
                             binds,
                         )
-                with _tick("dispatch"):
+                with _tick("dispatch"), _chunk_ann(ci):
                     if self.engine == "v3":
                         state, choices = self.chunk_fn(
                             self.dc, state, self._slot_src, self._extra_src,
@@ -1314,11 +1347,7 @@ class JaxReplayEngine:
             if self.telemetry_cfg.enabled
             else None
         )
-        _tick = (
-            (lambda name: tel.phases.tick(name))
-            if tel is not None
-            else (lambda name: _NULL_CTX)
-        )
+        _tick = _make_tick(tel)
         # In-scan rejection attribution (series+): thread a [K] i32 reject
         # counter through the scan carry via the instrumented reference
         # chunk program — one extra fetch per REPLAY, never per pod. The
@@ -1486,7 +1515,7 @@ class JaxReplayEngine:
                                 as_v2=use_rej,
                             )
                         released[due_p] = True
-            with _tick("dispatch"):
+            with _tick("dispatch"), _chunk_ann(ci):
                 if use_rej:
                     state, rej_dev, choices = self._chunk_fn_rej(
                         self.dc, state, rej_dev,

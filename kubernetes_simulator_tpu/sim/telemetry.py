@@ -217,6 +217,97 @@ class ReplayTelemetry:
             out["timeline_events"] = len(self.events)
         return out
 
+    @classmethod
+    def merge(
+        cls,
+        parts: Sequence[Optional["ReplayTelemetry"]],
+        process_ids: Optional[Sequence[int]] = None,
+    ) -> Optional["ReplayTelemetry"]:
+        """Merge telemetries over disjoint pod/scenario populations into
+        one fleet view (round 12). The merge is EXACT, order-normalized
+        and associative where the semantics allow:
+
+        * latency — recomputed by :func:`latency_summary` over the union
+          of raw first-bind latencies (the summary sorts before every
+          reduction), so a 2-process merge bit-matches the single-process
+          oracle over the same multiset;
+        * ``reasons`` / ``rejection_attempts`` — key-wise integer sums
+          (None only when absent from every part);
+        * ``series`` / ``events`` — concatenated in part order (parts
+          arrive in process order off the DCN gather, which is global
+          scenario order);
+        * ``phases`` — wall clocks of different hosts never sum
+          meaningfully, so with ``process_ids`` given (one per part,
+          aligned) part *i*'s timers land under ``p<pid>/<phase>`` and
+          stay distinct; without, parts are same-process and timers are
+          key-wise summed. Keys already containing ``/`` are assumed
+          scoped and pass through (re-merging a merge never
+          double-prefixes).
+
+        Raw ``bind_latency`` values are re-keyed by running index: merged
+        parts span scenarios, so original pod ids collide and are not
+        preserved. ``None`` parts (telemetry off) are skipped; returns
+        None when nothing remains."""
+        if process_ids is not None and len(process_ids) != len(parts):
+            raise ValueError(
+                f"process_ids ({len(process_ids)}) must align 1:1 with "
+                f"parts ({len(parts)})"
+            )
+        keep = [(i, p) for i, p in enumerate(parts) if p is not None]
+        if not keep:
+            return None
+        gran = keep[0][1].granularity
+        for _, p in keep:
+            if p.granularity != gran:
+                raise ValueError(
+                    "cannot merge telemetries of different granularity: "
+                    f"{p.granularity!r} vs {gran!r}"
+                )
+        zero = sum(int(p.zero_latency_binds) for _, p in keep)
+        vals: List[float] = []
+        for _, p in keep:
+            vals.extend(float(v) for v in p.bind_latency.values())
+
+        def _sum_counters(attr: str) -> Optional[Dict[str, int]]:
+            present = [
+                getattr(p, attr) for _, p in keep
+                if getattr(p, attr) is not None
+            ]
+            if not present:
+                return None
+            out: Dict[str, int] = {}
+            for d in present:
+                for k, v in d.items():
+                    out[k] = out.get(k, 0) + int(v)
+            return out
+
+        series: Optional[Dict[str, List[float]]] = None
+        if any(p.series is not None for _, p in keep):
+            series = {}
+            for _, p in keep:
+                for k, v in (p.series or {}).items():
+                    series.setdefault(k, []).extend(v)
+        phases: Dict[str, float] = {}
+        for i, p in keep:
+            prefix = (
+                "" if process_ids is None else f"p{process_ids[i]}/"
+            )
+            for k, v in p.phases.items():
+                key = k if "/" in k else f"{prefix}{k}"
+                phases[key] = round(phases.get(key, 0.0) + float(v), 6)
+        tel = cls(
+            granularity=gran,
+            latency=latency_summary(zero, vals),
+            phases=phases,
+            bind_latency={i: v for i, v in enumerate(vals)},
+            zero_latency_binds=zero,
+            events=[e for _, p in keep for e in p.events],
+        )
+        tel.reasons = _sum_counters("reasons")
+        tel.rejection_attempts = _sum_counters("rejection_attempts")
+        tel.series = series
+        return tel
+
 
 class TelemetryCollector:
     """Mutable per-replay accumulator. Engines call the record hooks (all
@@ -342,34 +433,35 @@ def first_reject_counts_host(
 # -- Chrome-trace (Perfetto) export --------------------------------------
 
 
-def write_chrome_trace(
-    path: str,
+def _trace_events(
     res,
     arrival: Optional[np.ndarray] = None,
     duration: Optional[np.ndarray] = None,
-) -> int:
-    """Export the SIMULATED cluster timeline as a Chrome trace JSON
-    (load in Perfetto / chrome://tracing). Virtual seconds map to trace
-    microseconds. Rows (tids) are nodes under pid 0 ("cluster"); chaos
-    node_down→node_up windows render as spans under pid 1 ("chaos").
-
-    Pod spans are drawn from each pod's FIRST bind (arrival + recorded
-    latency) to its completion (or the makespan); disruptions (preempt /
-    evict / boundary re-binds) appear as instant events on the node row.
-    Returns the number of trace events written."""
+    process_id: Optional[int] = None,
+) -> List[dict]:
+    """Trace events for ONE result. With ``process_id`` None (the
+    single-process export) pids are 0 ("cluster") / 1 ("chaos") exactly
+    as before round 12; with ``process_id`` p, the pair becomes one track
+    GROUP per process — pids 2p / 2p+1 named "cluster (p<p>)" /
+    "chaos (p<p>)" — so merged fleet traces render side by side in one
+    Perfetto timeline."""
     tel = getattr(res, "telemetry", None)
     assignments = np.asarray(res.assignments)
     makespan = float(getattr(res, "virtual_makespan", 0.0))
+    p_ = process_id
+    pid_cluster = 0 if p_ is None else 2 * int(p_)
+    pid_chaos = pid_cluster + 1
+    suffix = "" if p_ is None else f" (p{int(p_)})"
     ev: List[dict] = [
-        {"name": "process_name", "ph": "M", "pid": 0,
-         "args": {"name": "cluster"}},
-        {"name": "process_name", "ph": "M", "pid": 1,
-         "args": {"name": "chaos"}},
+        {"name": "process_name", "ph": "M", "pid": pid_cluster,
+         "args": {"name": f"cluster{suffix}"}},
+        {"name": "process_name", "ph": "M", "pid": pid_chaos,
+         "args": {"name": f"chaos{suffix}"}},
     ]
     used_nodes = sorted({int(n) for n in assignments if n >= 0})
     for n in used_nodes:
-        ev.append({"name": "thread_name", "ph": "M", "pid": 0, "tid": n,
-                   "args": {"name": f"node{n}"}})
+        ev.append({"name": "thread_name", "ph": "M", "pid": pid_cluster,
+                   "tid": n, "args": {"name": f"node{n}"}})
     lat = tel.bind_latency if tel is not None else {}
     if arrival is not None:
         placed = np.nonzero(assignments >= 0)[0]
@@ -379,7 +471,7 @@ def write_chrome_trace(
             if duration is not None and np.isfinite(duration[p]):
                 end = min(end, start + float(duration[p]))
             ev.append({
-                "name": f"pod{p}", "ph": "X", "pid": 0,
+                "name": f"pod{p}", "ph": "X", "pid": pid_cluster,
                 "tid": int(assignments[p]),
                 "ts": start * 1e6, "dur": max(end - start, 0.0) * 1e6,
             })
@@ -389,20 +481,56 @@ def write_chrome_trace(
             down_at[node] = t
         elif kind == "node_up":
             t0 = down_at.pop(node, t)
-            ev.append({"name": f"node{node} down", "ph": "X", "pid": 1,
-                       "tid": node, "ts": t0 * 1e6,
+            ev.append({"name": f"node{node} down", "ph": "X",
+                       "pid": pid_chaos, "tid": node, "ts": t0 * 1e6,
                        "dur": max(t - t0, 0.0) * 1e6})
         else:
             ev.append({
-                "name": kind, "ph": "i", "s": "t", "pid": 0,
+                "name": kind, "ph": "i", "s": "t", "pid": pid_cluster,
                 "tid": node if node >= 0 else 0, "ts": t * 1e6,
                 "args": ({"pod": pod} if pod >= 0 else {}),
             })
     for node, t0 in sorted(down_at.items()):
         # Unrecovered failure: span runs to the makespan.
-        ev.append({"name": f"node{node} down", "ph": "X", "pid": 1,
+        ev.append({"name": f"node{node} down", "ph": "X", "pid": pid_chaos,
                    "tid": node, "ts": t0 * 1e6,
                    "dur": max(makespan - t0, 0.0) * 1e6})
+    return ev
+
+
+def write_chrome_trace(
+    path: str,
+    res,
+    arrival: Optional[np.ndarray] = None,
+    duration: Optional[np.ndarray] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Export the SIMULATED cluster timeline as a Chrome trace JSON
+    (load in Perfetto / chrome://tracing). Virtual seconds map to trace
+    microseconds. Rows (tids) are nodes under the "cluster" process;
+    chaos node_down→node_up windows render as spans under "chaos".
+
+    Pod spans are drawn from each pod's FIRST bind (arrival + recorded
+    latency) to its completion (or the makespan); disruptions (preempt /
+    evict / boundary re-binds) appear as instant events on the node row.
+    ``process_id`` scopes the track group for multi-process exports (see
+    :func:`_trace_events`); the default keeps the round-7 pid 0/1 layout.
+    Returns the number of trace events written."""
+    ev = _trace_events(res, arrival, duration, process_id)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
+    return len(ev)
+
+
+def write_chrome_trace_merged(path: str, parts: Sequence[tuple]) -> int:
+    """Merge per-process timelines into ONE Chrome trace (round 12): each
+    element of ``parts`` is ``(res, arrival, duration)`` in process order,
+    and process *i*'s events land in its own track group ("cluster (pi)" /
+    "chaos (pi)"), so a 2-process DCN replay renders as a single Perfetto
+    timeline. Returns the number of trace events written."""
+    ev: List[dict] = []
+    for i, (res, arrival, duration) in enumerate(parts):
+        ev.extend(_trace_events(res, arrival, duration, process_id=i))
     with open(path, "w") as f:
         json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
     return len(ev)

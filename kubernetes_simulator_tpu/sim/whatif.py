@@ -472,6 +472,14 @@ class WhatIfResult:
     latency_p99: Optional[np.ndarray] = None  # [S] f64
     # Per-scenario ReplayTelemetry (kube batches at series+; else None).
     scenario_telemetry: Optional[list] = None
+    # Fleet-merged ReplayTelemetry (round 12): every process's partial
+    # telemetry merged via ReplayTelemetry.merge — it rides the ONE
+    # end-of-replay gather, never adds a collective. Phase timers are
+    # kept distinct per process ("p<pid>/<phase>"); latency/rejection
+    # aggregates are exact merges, so the 2-process fleet view bit-matches
+    # the single-process oracle (tests/test_dcn.py). None at telemetry
+    # granularity "off".
+    fleet_telemetry: Optional["ReplayTelemetry"] = None
     # Mesh provenance (round 10): which parallel configuration produced
     # the numbers — bench rounds and tuner runs stamp these so results
     # from different device counts are never silently compared.
@@ -2351,9 +2359,12 @@ class WhatIfEngine:
                 nonlocal kpending
                 if kpending is not None:
                     ci_p, rows_p, out_p, _nf = kpending
-                    ch = jax.device_get(out_p)
-                    for s in range(self.S):
-                        kbops[s].fold_chunk(ci_p, rows_p, ch[s])
+                    # run_phases is bound later in run() — always before
+                    # the first call site (the chunk loop).
+                    with run_phases.tick("host_mirror"):
+                        ch = jax.device_get(out_p)
+                        for s in range(self.S):
+                            kbops[s].fold_chunk(ci_p, rows_p, ch[s])
                     kpending = None
 
             # Per-scenario timed timelines (chaos campaigns, round 7).
@@ -2485,8 +2496,38 @@ class WhatIfEngine:
                     lambda evn: (evn >= 0).any(axis=1)
                 )
         outs = []
+        # Engine-level wall-clock phase breakdown (round 12): the what-if
+        # chunk loop gets the same PHASE_NAMES timers the single-replay
+        # paths carry, feeding heartbeats, the fleet telemetry merge, and
+        # the bench `phases` detail.
+        from .telemetry import PhaseTimers, ReplayTelemetry
+        from ..utils.profiling import annotate as _prof_ann
+        from ..utils.profiling import profiling_active as _prof_on
+
+        run_phases = PhaseTimers()
+        import contextlib as _ctxlib
+
+        _null = _ctxlib.nullcontext()
+        _prof = _prof_on()
+        _cann = (
+            (lambda i: _prof_ann(f"chunk:{i}")) if _prof else (lambda i: _null)
+        )
+        _pann = _prof_ann if _prof else (lambda name: _null)
+        n_chunks = len(range(0, idx.shape[0], C))
+        # Liveness heartbeats (round 12): one overwritten KV beacon per
+        # process on a chunk cadence — plain puts, never a gather.
+        hb_on = self._dcn_sliced and dcn.heartbeat_every() > 0
+        hb_block = (self._proc_lo, self._proc_lo + self.S)
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
+            if hb_on:
+                dcn.maybe_heartbeat(
+                    ci - 1,
+                    total=n_chunks,
+                    block=hb_block,
+                    wall_s=time.perf_counter() - t0,
+                    phases=run_phases.acc,
+                )
             if kbops is not None:
                 t_now = kube_wave_t[c0]
                 due_any = khas_events and any(
@@ -2574,9 +2615,12 @@ class WhatIfEngine:
                     subs.append(sub)
                     adds.append(binds)
                 if any_bdelta:
-                    states = self._apply_stacked_boundary_delta(
-                        states, subs, adds
-                    )
+                    with run_phases.tick("boundary_fold"), _pann(
+                        "boundary_fold"
+                    ):
+                        states = self._apply_stacked_boundary_delta(
+                            states, subs, adds
+                        )
             if comp_on and ci < rel_bkt[2]:
                 cand_b = rel_bkt[0][rel_bkt[1][ci] : rel_bkt[1][ci + 1]]
                 if cand_b.size:
@@ -2586,9 +2630,10 @@ class WhatIfEngine:
                         # release); quiet scenarios' folds stay deferred —
                         # their ci-1 binds are not candidates here.
                         _pre_walk()
-                    states = self._apply_releases(
-                        states, host_assign, released, cand_b
-                    )
+                    with run_phases.tick("boundary_fold"):
+                        states = self._apply_releases(
+                            states, host_assign, released, cand_b
+                        )
             if dev_rel:
                 # Static releases first (the bucketed fn; ordering is by
                 # data dependency on states/vassign), then the chunk.
@@ -2603,7 +2648,15 @@ class WhatIfEngine:
                             self._dyn_dev.ov_gdom,
                             self._dyn_dev.ov_old,
                         )
-                    states = self._release_fn(rc[0].shape[0])(*args)
+                    with run_phases.tick("boundary_fold"):
+                        states = self._release_fn(rc[0].shape[0])(*args)
+            # Dispatch phase (the chunk-fn if/elif chain below runs exactly
+            # one branch): timed via add() rather than a context manager so
+            # the chain's indentation is untouched; the profiler chunk
+            # marker brackets it the same way.
+            _ann = _cann(ci)
+            _ann.__enter__()
+            _t_disp = time.perf_counter()
             if dev_rel and self.retry_buffer:
                 (
                     states, vassign_d, rbuf_d, rcount_d,
@@ -2648,6 +2701,8 @@ class WhatIfEngine:
                 if pol_d is not None:
                     args = args + (pol_d,)
                 states, out = self._chunk_fn(*args)
+            run_phases.add("dispatch", time.perf_counter() - _t_disp)
+            _ann.__exit__(None, None, None)
             if pre_comp:
                 # Deferred eviction-aware fold (round 6): fetch only the
                 # [S] eviction summary now; the previous chunk resolves
@@ -2689,7 +2744,8 @@ class WhatIfEngine:
                 # boundary b only ever sees chunks ≤ b−2 (one-chunk slack,
                 # shared with JaxReplayEngine and the greedy anchor).
                 if pending_fold is not None:
-                    self._fold(host_assign, *pending_fold)
+                    with run_phases.tick("host_mirror"):
+                        self._fold(host_assign, *pending_fold)
                 if hasattr(out, "copy_to_host_async"):
                     out.copy_to_host_async()  # overlap D2H with the chunk
                 pending_fold = (idx[c0 : c0 + C], out)
@@ -2714,21 +2770,26 @@ class WhatIfEngine:
                 subs.append(sub)
                 adds.append(binds)
             if any_bdelta:
-                states = self._apply_stacked_boundary_delta(
-                    states, subs, adds
-                )
+                with run_phases.tick("boundary_fold"), _pann(
+                    "boundary_fold"
+                ):
+                    states = self._apply_stacked_boundary_delta(
+                        states, subs, adds
+                    )
             if khas_events:
                 # The stack rows were mutated in lockstep with the
                 # mirrors — restore the t=0 view so the engine (and its
                 # ScenarioSet) stays reusable.
                 hs["alloc"][...] = ksaved_alloc
-        jax.block_until_ready(states)
+        with run_phases.tick("device_wait"), _pann("device_wait"):
+            jax.block_until_ready(states)
         wall = time.perf_counter() - t0
 
         to_schedule = int((idx >= 0).sum())
         kube_preempt = kube_dropped = None
         kube_evict = kube_resched = kube_stranded = kube_lat = None
         sc_lat_p50 = sc_lat_p90 = sc_lat_p99 = sc_telemetry = None
+        stel = None
         if kbops is not None:
             host_k = np.stack([b.assignments for b in kbops])
             assignments = host_k if self.collect_assignments else None
@@ -2862,6 +2923,21 @@ class WhatIfEngine:
             # The device retry path counts overflow drops in-scan now
             # (round 6): every drop-capable engine reports them.
             dropped = np.asarray(self._fetch(rdrop_d)).astype(np.int32)
+        # This process's partial fleet telemetry (round 12): per-scenario
+        # collectors merged same-process (phases key-wise summed would be
+        # wrong here — the fleet view wants the ENGINE's wall clocks, so
+        # they are overwritten below), shipped through the one gather.
+        fleet_local = None
+        if self.telemetry_cfg.enabled:
+            fleet_local = (
+                ReplayTelemetry.merge(stel) if stel is not None else None
+            )
+            if fleet_local is None:
+                fleet_local = ReplayTelemetry(
+                    granularity=self.telemetry_cfg.granularity
+                )
+            fleet_local.phases = run_phases.summary()
+        fleet_tel = None
         # ---- THE end-of-replay gather (round 11, parallel.dcn) ----
         # The one point per replay where processes exchange data: every
         # per-scenario result array is concatenated across the contiguous
@@ -2871,6 +2947,18 @@ class WhatIfEngine:
         # was process-local.
         process_count = 1
         if self._dcn_sliced:
+            if hb_on:
+                # Final beacon before blocking in the gather: siblings'
+                # attributed-timeout diagnostics see "state=gather" rather
+                # than a stale mid-replay chunk.
+                dcn.heartbeat(
+                    n_chunks - 1,
+                    total=n_chunks,
+                    block=hb_block,
+                    wall_s=wall,
+                    phases=run_phases.acc,
+                    state="gather",
+                )
             parts = dcn.gather(
                 "whatif",
                 dict(
@@ -2887,6 +2975,7 @@ class WhatIfEngine:
                     lat90=sc_lat_p90,
                     lat99=sc_lat_p99,
                     telemetry=sc_telemetry,
+                    fleet=fleet_local,
                 ),
             )
 
@@ -2912,7 +3001,19 @@ class WhatIfEngine:
                 if parts[0]["telemetry"] is None
                 else [t for p in parts for t in p["telemetry"]]
             )
+            if parts[0].get("fleet") is not None:
+                # Fleet merge: phases land under "p<pid>/<phase>", the
+                # aggregates are exact merges over the global scenario
+                # order — bit-matching the single-process oracle.
+                fleet_tel = ReplayTelemetry.merge(
+                    [p["fleet"] for p in parts],
+                    process_ids=list(range(len(parts))),
+                )
             process_count = jax.process_count()
+        elif fleet_local is not None:
+            # Single-process runs get the SAME shape ("p0/..." phase keys)
+            # so consumers never branch on process_count.
+            fleet_tel = ReplayTelemetry.merge([fleet_local], process_ids=[0])
         total = int(placed.sum())
         ndev_local = int(self.mesh.devices.size) if self.mesh is not None else 1
         return WhatIfResult(
@@ -2935,6 +3036,7 @@ class WhatIfEngine:
             latency_p90=sc_lat_p90,
             latency_p99=sc_lat_p99,
             scenario_telemetry=sc_telemetry,
+            fleet_telemetry=fleet_tel,
             # Global footprint: process_count × local devices when the
             # scenario axis was DCN-sliced (the local mesh is 1/nproc of
             # the fleet that produced the gathered result).

@@ -63,6 +63,27 @@ class JsonlWriter:
         self.path = path
         self.context = dict(context or {})
         self._f: Optional[IO] = open(path, "a") if path else None
+        self._proc: Optional[dict] = None  # lazy DCN process stamp
+
+    def _process_stamp(self) -> dict:
+        """``process_id``/``process_count`` under DCN (round 12): rows from
+        a fleet are attributable to the worker that wrote them. Empty in
+        single-process runs — v1–v3 rows are byte-unchanged there, and the
+        DCN parity bar strips exactly these two keys before comparing
+        against the single-process oracle (tests/dcn_case_worker.py)."""
+        if self._proc is None:
+            try:
+                from ..parallel import dcn
+
+                nproc, pid = dcn.process_info()
+                self._proc = (
+                    {"process_id": int(pid), "process_count": int(nproc)}
+                    if nproc > 1
+                    else {}
+                )
+            except Exception:
+                self._proc = {}
+        return self._proc
 
     def write(self, row: dict, stamp_ts: bool = True) -> None:
         # stamp_ts=False drops the wall-clock stamp — the policy tuner's
@@ -72,7 +93,13 @@ class JsonlWriter:
             if stamp_ts
             else {}
         )
-        row = {**stamp, "schema": SCHEMA_VERSION, **self.context, **row}
+        row = {
+            **stamp,
+            "schema": SCHEMA_VERSION,
+            **self._process_stamp(),
+            **self.context,
+            **row,
+        }
         line = json.dumps(row)
         if self._f:
             self._f.write(line + "\n")

@@ -2,16 +2,82 @@
 
 - ``device_trace(dir)``: jax.profiler trace (TensorBoard/Perfetto) around a
   replay.
+- ``profiling_active()`` / ``annotate(name)``: the round-12 device-profiler
+  hook contract — ``KSIM_PROFILE_DIR`` (set directly or via the
+  ``--profile`` flags on bench.py / scripts/northstar.py) arms
+  ``jax.profiler.TraceAnnotation`` markers on the telemetry PHASE_NAMES
+  phases and chunk dispatch, so fused-program device time is attributable
+  in XLA traces. Off by default; annotations never change results (pinned
+  in tests/test_telemetry.py).
+- ``live_buffer_stats()``: live-buffer / memory watermark gauge.
 - ``timed(fn)``: block-until-ready wall-clock timing harness.
-- ``cost_analysis(jitted, *args)``: XLA cost analysis of a compiled step
-  (the ``--profile`` flag's payload).
+- ``cost_analysis(jitted, *args)``: XLA cost analysis of a compiled step.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any, Callable, Optional
+
+
+def profile_dir() -> Optional[str]:
+    """The device-profiler sink (``KSIM_PROFILE_DIR``), or None when
+    profiling is off."""
+    return os.environ.get("KSIM_PROFILE_DIR") or None
+
+
+def profiling_active() -> bool:
+    """True when profiler hooks should annotate. One env-dict lookup — the
+    replay engines consult this per replay (not per chunk) to build their
+    tick functions."""
+    return bool(profile_dir())
+
+
+def annotate(name: str):
+    """``jax.profiler.TraceAnnotation(name)`` when profiling is active,
+    else a no-op context. Annotations outside a live ``jax.profiler.trace``
+    are harmless, so callers gate on :func:`profiling_active` only to skip
+    the object construction on hot paths."""
+    if not profiling_active():
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def live_buffer_stats(collect: bool = True) -> dict:
+    """Live-buffer / memory watermark gauge (round 12): the count and
+    total bytes of ``jax.live_arrays()`` — the same counter machinery as
+    tests/test_donation.py's leak pin — plus the backend's
+    ``peak_bytes_in_use`` watermark where it reports one (TPU/GPU; CPU
+    devices return nothing and the key is simply absent). ``collect``
+    runs ``gc.collect()`` first so the count reflects reachable buffers,
+    not garbage awaiting a cycle — skip it on hot paths."""
+    try:
+        import jax
+
+        if collect:
+            import gc
+
+            gc.collect()
+        arrs = jax.live_arrays()
+        out: dict = {
+            "count": len(arrs),
+            "bytes": int(
+                sum(int(getattr(a, "nbytes", 0) or 0) for a in arrs)
+            ),
+        }
+    except Exception:
+        return {}
+    try:
+        ms = jax.local_devices()[0].memory_stats()
+        if ms and "peak_bytes_in_use" in ms:
+            out["peak_bytes_in_use"] = int(ms["peak_bytes_in_use"])
+    except Exception:
+        pass
+    return out
 
 
 @contextlib.contextmanager

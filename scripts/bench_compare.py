@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Compare bench result files (BENCH_r*.json) and flag regressions.
+
+    python scripts/bench_compare.py BENCH_r04.json BENCH_r05.json [...]
+    python scripts/bench_compare.py --threshold 0.15 BENCH_r*.json
+
+Files are compared in the order given (oldest first — shell globs sort
+BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
+
+- the headline metric (``value``, pod placements/sec): a drop of more
+  than ``--threshold`` (default 10% — bench walls on shared CI hosts are
+  noisy) is a REGRESSION;
+- per-phase wall shares (``detail.phases``, round 12): a phase that
+  grew its share of the total by more than ``threshold`` absolute is
+  flagged (informational — phases shift when features land);
+- DCN scaling (``detail.dcn_scaling.aggregate_pps`` and per-process
+  pps where both files carry them): same threshold as the headline.
+
+Accepts both the archived wrapper shape ``{"n", "cmd", "rc", "parsed"}``
+and a raw bench JSON line ``{"metric", "value", ...}``. Exits nonzero
+iff any headline or dcn_scaling regression was flagged, so it can gate
+CI; phase-share drift never fails the run on its own.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+
+def load_bench(path: str) -> dict:
+    """Parsed bench payload from ``path`` (unwraps the BENCH_r* archive
+    wrapper; raises ValueError when neither shape matches)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or "value" not in doc:
+        raise ValueError(f"{path}: not a bench result (no 'value' field)")
+    return doc
+
+
+def phase_shares(detail: dict) -> dict:
+    """Per-phase fraction of the total phase wall ({} when absent)."""
+    phases = detail.get("phases")
+    if not isinstance(phases, dict) or not phases:
+        return {}
+    vals = {k: float(v) for k, v in phases.items()}
+    total = sum(vals.values())
+    if total <= 0:
+        return {}
+    return {k: v / total for k, v in vals.items()}
+
+
+def compare_pair(
+    name_a: str, a: dict, name_b: str, b: dict, threshold: float
+) -> Tuple[List[str], List[str]]:
+    """(regressions, notes) for the pair old=a → new=b."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    va, vb = float(a["value"]), float(b["value"])
+    if va > 0:
+        delta = (vb - va) / va
+        line = (
+            f"headline {a.get('metric', 'value')}: "
+            f"{va:.1f} -> {vb:.1f} ({delta:+.1%})"
+        )
+        if vb < va * (1.0 - threshold):
+            regressions.append(line + "  REGRESSION")
+        else:
+            notes.append(line)
+
+    da, db = a.get("detail") or {}, b.get("detail") or {}
+    sa, sb = phase_shares(da), phase_shares(db)
+    for k in sorted(set(sa) | set(sb)):
+        grow = sb.get(k, 0.0) - sa.get(k, 0.0)
+        if grow > threshold:
+            notes.append(
+                f"phase share {k}: {sa.get(k, 0.0):.1%} -> "
+                f"{sb.get(k, 0.0):.1%} (grew {grow:+.1%})"
+            )
+
+    dsa, dsb = da.get("dcn_scaling"), db.get("dcn_scaling")
+    if isinstance(dsa, dict) and isinstance(dsb, dict):
+        for key in ("aggregate_pps", "per_process_pps"):
+            pa, pb = dsa.get(key), dsb.get(key)
+            if (
+                isinstance(pa, (int, float))
+                and isinstance(pb, (int, float))
+                and pa > 0
+            ):
+                delta = (pb - pa) / pa
+                line = f"dcn {key}: {pa:.1f} -> {pb:.1f} ({delta:+.1%})"
+                if pb < pa * (1.0 - threshold):
+                    regressions.append(line + "  REGRESSION")
+                else:
+                    notes.append(line)
+    return regressions, notes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("files", nargs="+", help="bench JSON files, oldest first")
+    ap.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="relative drop that counts as a regression (default 0.10)",
+    )
+    args = ap.parse_args(argv)
+    if len(args.files) < 2:
+        ap.error("need at least two files to compare")
+
+    benches = [(p, load_bench(p)) for p in args.files]
+    any_regression = False
+    for (pa, a), (pb, b) in zip(benches, benches[1:]):
+        print(f"== {pa} -> {pb}")
+        regressions, notes = compare_pair(pa, a, pb, b, args.threshold)
+        for line in notes:
+            print(f"   {line}")
+        for line in regressions:
+            print(f"   {line}")
+        any_regression = any_regression or bool(regressions)
+    if any_regression:
+        print(
+            f"bench_compare: REGRESSION beyond {args.threshold:.0%} "
+            "threshold", file=sys.stderr,
+        )
+        return 1
+    print(f"bench_compare: ok ({len(benches)} file(s), no regressions)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,11 +67,14 @@ _OPTIONAL = {
     "config": str,
     "mesh": bool,
     # Round 11 (multi-host DCN): provenance fields stamped by bench.py
-    # and DCN-aware writers. Whatif/replay ROWS deliberately do NOT gain
-    # a process_count — their bytes must match the single-process oracle
-    # (the parity bar) — but top-level bench JSON and future row
-    # variants may carry them.
+    # and DCN-aware writers. Round 12: JsonlWriter stamps process_id +
+    # process_count on every row of a multi-process fleet (so rows are
+    # attributable to the worker that wrote them); single-process files
+    # are byte-unchanged, and the DCN parity bar strips exactly these two
+    # keys before comparing against the single-process oracle
+    # (tests/dcn_case_worker.py).
     "process_count": int,
+    "process_id": int,
     "n_devices": int,
     "mesh_shape": (dict, type(None)),
     "dcn_scaling": dict,
@@ -95,6 +98,10 @@ _OPTIONAL_V3 = {
     "engine": str,
     "config_hash": str,
     "config": str,
+    # Round 12: tuner trajectories written by a DCN fleet carry the same
+    # process stamp as v2 rows.
+    "process_id": int,
+    "process_count": int,
 }
 _TUNE_CAND_REQUIRED = {
     "round": int,

@@ -16,15 +16,25 @@ multi-host TPU uses, minus the hardware, so the DCN code path runs in CI.
 Process 0's output streams through; siblings are captured and replayed on
 failure. Any child failing kills the rest (a DCN replay cannot complete
 with a hole in the scenario axis).
+
+``--watch`` (round 12) tails the workers' liveness heartbeats
+(parallel.dcn.heartbeat mirrors each beacon to ``$KSIM_DCN_HB_DIR``) and
+prints fleet progress to stderr every couple of seconds: last completed
+chunk and chunks/sec per process, a live-buffer gauge, and a straggler
+flag for any process whose beacon went stale or whose chunk cursor trails
+the fleet.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -35,11 +45,19 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def child_env(pid: int, nproc: int, port: int, devices_per_proc: int) -> dict:
+def child_env(
+    pid: int,
+    nproc: int,
+    port: int,
+    devices_per_proc: int,
+    hb_dir: str = "",
+) -> dict:
     env = dict(os.environ)
     env["KSIM_DCN_COORD"] = f"127.0.0.1:{port}"
     env["KSIM_DCN_NPROC"] = str(nproc)
     env["KSIM_DCN_PID"] = str(pid)
+    if hb_dir:
+        env["KSIM_DCN_HB_DIR"] = hb_dir
     env.setdefault("JAX_PLATFORMS", "cpu")
     flags = [
         f for f in env.get("XLA_FLAGS", "").split()
@@ -50,6 +68,73 @@ def child_env(pid: int, nproc: int, port: int, devices_per_proc: int) -> dict:
     )
     env["XLA_FLAGS"] = " ".join(flags)
     return env
+
+
+class FleetWatch:
+    """Heartbeat tail for ``--watch``: reads the ``p<pid>.json`` beacon
+    mirrors, derives chunks/sec from consecutive samples, and flags
+    stragglers (stale beacon, or a chunk cursor trailing the fleet leader
+    by more than ``lag_frac`` of the replay)."""
+
+    def __init__(
+        self,
+        hb_dir: str,
+        nproc: int,
+        stall_s: float = 60.0,
+        lag_frac: float = 0.25,
+    ):
+        self.hb_dir = hb_dir
+        self.nproc = nproc
+        self.stall_s = stall_s
+        self.lag_frac = lag_frac
+        self._prev: dict = {}  # pid -> (chunk, t) of the last rate sample
+
+    def read(self) -> dict:
+        beats = {}
+        for pid in range(self.nproc):
+            try:
+                with open(os.path.join(self.hb_dir, f"p{pid}.json")) as f:
+                    beats[pid] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+        return beats
+
+    def line(self, beats: dict) -> str:
+        now = time.time()
+        max_chunk = max(
+            (int(b.get("chunk", -1)) for b in beats.values()), default=-1
+        )
+        segs = []
+        for pid in range(self.nproc):
+            b = beats.get(pid)
+            if b is None:
+                segs.append(f"p{pid} —")
+                continue
+            chunk = int(b.get("chunk", -1))
+            total = b.get("total_chunks")
+            age = max(0.0, now - float(b.get("t", now)))
+            prev = self._prev.get(pid)
+            rate = ""
+            if prev is not None and b.get("t", 0) > prev[1]:
+                cps = (chunk - prev[0]) / (float(b["t"]) - prev[1])
+                rate = f" {cps:.1f}ch/s"
+            self._prev[pid] = (chunk, float(b.get("t", now)))
+            lag = max_chunk - chunk
+            straggler = age > self.stall_s or (
+                total and lag > max(2, self.lag_frac * int(total))
+            )
+            seg = (
+                f"p{pid} {b.get('state', '?')} "
+                f"chunk {chunk}"
+                + (f"/{total}" if total is not None else "")
+                + rate
+            )
+            if "live_buffers" in b:
+                seg += f" live={b['live_buffers']}"
+            if straggler:
+                seg += " [STRAGGLER]"
+            segs.append(seg)
+        return "dcn_launch[watch]: " + " | ".join(segs)
 
 
 def main(argv=None) -> int:
@@ -67,6 +152,15 @@ def main(argv=None) -> int:
         "--timeout", type=float, default=900.0,
         help="kill the fleet after this many seconds",
     )
+    ap.add_argument(
+        "--watch", action="store_true",
+        help="tail worker heartbeats and print fleet progress "
+             "(chunks/sec per process, stragglers flagged) to stderr",
+    )
+    ap.add_argument(
+        "--watch-interval", type=float, default=2.0,
+        help="seconds between --watch progress lines",
+    )
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to run in every process (after --)")
     args = ap.parse_args(argv)
@@ -78,10 +172,20 @@ def main(argv=None) -> int:
     if args.nproc < 1:
         ap.error("--nproc must be >= 1")
 
+    hb_dir = ""
+    watch = None
+    if args.watch:
+        hb_dir = tempfile.mkdtemp(prefix="ksim_hb_")
+        watch = FleetWatch(
+            hb_dir, args.nproc,
+            stall_s=float(os.environ.get("KSIM_DCN_STALL_S", "60")),
+        )
     port = free_port()
     procs, tails = [], []
     for pid in range(args.nproc):
-        env = child_env(pid, args.nproc, port, args.devices_per_proc)
+        env = child_env(
+            pid, args.nproc, port, args.devices_per_proc, hb_dir
+        )
         if pid == 0:
             p = subprocess.Popen(cmd, env=env)
             tails.append(None)
@@ -101,10 +205,16 @@ def main(argv=None) -> int:
         procs.append(p)
 
     deadline = time.monotonic() + args.timeout
+    next_watch = time.monotonic() + args.watch_interval
     rc = 0
     try:
         pending = set(range(args.nproc))
         while pending:
+            if watch is not None and time.monotonic() >= next_watch:
+                next_watch = time.monotonic() + args.watch_interval
+                beats = watch.read()
+                if beats:
+                    print(watch.line(beats), file=sys.stderr)
             if time.monotonic() > deadline:
                 print(
                     f"dcn_launch: timeout after {args.timeout}s",
@@ -136,6 +246,8 @@ def main(argv=None) -> int:
                 p.kill()
         for p in procs:
             p.wait()
+        if hb_dir:
+            shutil.rmtree(hb_dir, ignore_errors=True)
     return rc
 
 

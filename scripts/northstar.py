@@ -23,6 +23,12 @@ NS_MODE is also set explicitly), and NS_CHAOS (int: inject that many
 seeded node_down/node_up events into each NS_SINGLE kube run and print
 the chaos overhead vs the event-free kube wall — the round-7 eviction
 cost probe; requires 'kube' in NS_SINGLE).
+
+Round 12: ``--profile`` (or KSIM_PROFILE_DIR=<dir>) wraps every timed
+replay in a ``jax.profiler.trace`` dump — phase/chunk TraceAnnotations
+from the engine land in the device timeline. Off by default; results are
+bit-identical either way. Under DCN each process writes to its own
+``p<pid>/`` subdirectory.
 """
 
 import os
@@ -47,6 +53,14 @@ _cc()  # persistent XLA cache: a restart at the same shape compiles in ~s
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.sim.borg import BorgSpec, make_borg_encoded
 from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+from kubernetes_simulator_tpu.utils.profiling import device_trace, profile_dir
+
+
+def _trace_ctx():
+    """Profiler trace context for the timed replay: a jax.profiler.trace
+    into $KSIM_PROFILE_DIR (per-process subpath under DCN), or a no-op
+    when profiling is off."""
+    return device_trace(_dcn.output_path_for_process(profile_dir()))
 
 
 def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
@@ -81,7 +95,8 @@ def run_mode(ec, ep, scenarios, S, tasks, wave, chunk, completions, retry=0,
             flush=True,
         )
     t0 = time.perf_counter()
-    res = eng.run()
+    with _trace_ctx():
+        res = eng.run()
     wall = time.perf_counter() - t0
     placed = int(res.placed.sum())
     attempts = S * tasks
@@ -126,7 +141,8 @@ def run_single(ec, ep, tasks, wave, chunk, mode, retry, events=None):
             flush=True,
         )
     t0 = time.perf_counter()
-    res = eng.replay(node_events=events)
+    with _trace_ctx():
+        res = eng.replay(node_events=events)
     wall = time.perf_counter() - t0
     folds = (
         getattr(eng, "_last_bops", None).plane_folds
@@ -154,6 +170,10 @@ def run_single(ec, ep, tasks, wave, chunk, mode, retry, events=None):
 
 
 def main():
+    if "--profile" in sys.argv[1:]:
+        os.environ.setdefault(
+            "KSIM_PROFILE_DIR", os.path.join(os.getcwd(), "ksim_profile")
+        )
     nodes = int(os.environ.get("NS_NODES", 10_000))
     tasks = int(os.environ.get("NS_TASKS", 1_000_000))
     S = int(os.environ.get("NS_S", 128))

@@ -41,6 +41,35 @@ def _arr_sha(a) -> str:
     )
 
 
+def _normalize_jsonl(data: bytes) -> bytes:
+    """Strip the round-12 DCN process stamp (``process_id`` /
+    ``process_count``) from every row so worker and oracle bytes compare.
+    Single-process files have no stamp and round-trip byte-identically
+    (JsonlWriter serializes with ``json.dumps`` defaults, as here)."""
+    out = []
+    for line in data.splitlines():
+        row = json.loads(line)
+        row.pop("process_id", None)
+        row.pop("process_count", None)
+        out.append(json.dumps(row).encode())
+    return b"\n".join(out) + (b"\n" if out else b"")
+
+
+def _assert_process_stamp(jsonl: bytes) -> None:
+    """Every row of a fleet-written file must carry THIS worker's stamp;
+    single-process rows must carry none (byte-compat with pre-round-12)."""
+    from kubernetes_simulator_tpu.parallel import dcn
+
+    nproc, pid = dcn.process_info()
+    for line in jsonl.splitlines():
+        row = json.loads(line)
+        if nproc > 1:
+            assert row.get("process_id") == pid, row
+            assert row.get("process_count") == nproc, row
+        else:
+            assert "process_id" not in row and "process_count" not in row, row
+
+
 def _deterministic_jsonl():
     """Context manager forcing KSIM_DETERMINISTIC_JSONL=1 (builders run it
     on BOTH sides so worker and oracle bytes are comparable)."""
@@ -68,8 +97,10 @@ def case_plain():
     """Mesh-sharded what-if with collected assignments, plus the full
     JSONL surface written under KSIM_DETERMINISTIC_JSONL — placed counts,
     assignment matrix, and the JSONL file bytes must all match the
-    single-process mesh run. (Boundary retry rides the kube chaos case —
-    it is exclusive with collect_assignments.)"""
+    single-process mesh run (modulo the round-12 process stamp, which is
+    asserted in-worker and stripped before hashing). (Boundary retry
+    rides the kube chaos case — it is exclusive with
+    collect_assignments.)"""
     from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
     from kubernetes_simulator_tpu.models.encode import encode
     from kubernetes_simulator_tpu.parallel.mesh import make_mesh
@@ -108,12 +139,13 @@ def case_plain():
         finally:
             os.unlink(path)
 
+    _assert_process_stamp(jsonl)
     return eng, {
         "placed": res.placed.tolist(),
         "unschedulable": res.unschedulable.tolist(),
         "total_placed": int(res.total_placed),
         "assignments_sha": _arr_sha(res.assignments),
-        "jsonl_sha": _sha(jsonl),
+        "jsonl_sha": _sha(_normalize_jsonl(jsonl)),
         "jsonl_rows": len(jsonl.splitlines()),
     }
 
@@ -322,12 +354,70 @@ def case_odd():
     }
 
 
+def case_fleetmerge():
+    """Round-12 fleet telemetry: kube+series what-if on the no-mesh DCN
+    path. The MERGED ``WhatIfResult.fleet_telemetry`` rides the single
+    end-of-replay gather, and every virtual-time-derived field — latency
+    histogram over the union of first binds, key-wise rejection-counter
+    sums, series concatenated in global scenario order — must bit-match
+    the single-process oracle. Phase timers are wall-clock, so only their
+    key STRUCTURE is pinned in-process: exactly one ``p<pid>/`` namespace
+    per fleet member (``p0`` alone on the oracle side)."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.parallel import dcn
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    nodes = [Node(f"n{i}", {"cpu": 4.0}) for i in range(4)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=20.0)
+        for i in range(24)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    scenarios = [
+        Scenario(),
+        Scenario(events=[
+            NodeEvent(time=6.0, kind="node_down", node=0),
+            NodeEvent(time=14.0, kind="node_up", node=0),
+        ]),
+        Scenario(events=[NodeEvent(time=10.0, kind="node_down", node=1)]),
+        Scenario(),
+    ]
+    eng = WhatIfEngine(
+        ec, ep, scenarios, cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=32, telemetry="series",
+    )
+    res = eng.run()
+    ft = res.fleet_telemetry
+    assert ft is not None, "fleet_telemetry missing from what-if result"
+    nproc, _ = dcn.process_info()
+    prefixes = {k.split("/", 1)[0] for k in ft.phases}
+    assert prefixes == {f"p{i}" for i in range(max(nproc, 1))}, prefixes
+    return eng, {
+        "granularity": ft.granularity,
+        "latency": ft.latency,
+        "reasons": ft.reasons,
+        "rejection_attempts": ft.rejection_attempts,
+        "zero_latency_binds": int(ft.zero_latency_binds),
+        "bind_values": [float(v) for v in ft.bind_latency.values()],
+        "series_sha": _sha(
+            json.dumps(ft.series, sort_keys=True).encode()
+        ),
+        "events_len": len(ft.events),
+    }
+
+
 CASES = {
     "plain": case_plain,
     "chaos": case_chaos,
     "tuner": case_tuner,
     "ckpt": case_ckpt,
     "odd": case_odd,
+    "fleetmerge": case_fleetmerge,
 }
 
 
@@ -356,12 +446,38 @@ def run_cases(names, expect_dcn: bool):
     return out
 
 
+def _arm_selfkill() -> None:
+    """KSIM_DCN_SELFKILL_AT_CHUNK=<n> (round-12 killed-worker test): die
+    with SIGKILL right after publishing the first heartbeat whose chunk
+    cursor reaches <n>, simulating a worker lost mid-replay. Survivors
+    must then fail FAST out of the gather with an attributed
+    DcnGatherTimeout naming this pid and its last completed chunk."""
+    at = os.environ.get("KSIM_DCN_SELFKILL_AT_CHUNK")
+    if at is None:
+        return
+    import signal
+
+    from kubernetes_simulator_tpu.parallel import dcn
+
+    threshold = int(at)
+    real = dcn.heartbeat
+
+    def _hb(chunk, *a, **kw):
+        ok = real(chunk, *a, **kw)
+        if int(chunk) >= threshold:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return ok
+
+    dcn.heartbeat = _hb
+
+
 def main() -> None:
     import jax
 
     from kubernetes_simulator_tpu.parallel import dcn
 
     assert dcn.maybe_init_from_env(), "KSIM_DCN_* env not set"
+    _arm_selfkill()
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
     nproc, pid = dcn.process_info()
     assert nproc == int(os.environ["KSIM_DCN_NPROC"]), nproc

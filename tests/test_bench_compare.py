@@ -1,0 +1,96 @@
+"""scripts/bench_compare.py (round 12): bench-archive diffing — headline
+regression gating, phase-share drift notes, DCN scaling comparison, and
+the BENCH_r* wrapper unwrap."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "scripts")
+    ),
+)
+
+from bench_compare import (  # noqa: E402
+    compare_pair,
+    load_bench,
+    main,
+    phase_shares,
+)
+
+
+def _bench(value, phases=None, dcn=None):
+    detail = {}
+    if phases is not None:
+        detail["phases"] = phases
+    if dcn is not None:
+        detail["dcn_scaling"] = dcn
+    return {"metric": "pps", "value": value, "unit": "1/s",
+            "detail": detail}
+
+
+def _write(tmp_path, name, doc, wrap=False):
+    p = tmp_path / name
+    p.write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "parsed": doc} if wrap else doc
+    ))
+    return str(p)
+
+
+def test_load_bench_unwraps_archive(tmp_path):
+    doc = _bench(100.0)
+    raw = load_bench(_write(tmp_path, "raw.json", doc))
+    wrapped = load_bench(_write(tmp_path, "wrap.json", doc, wrap=True))
+    assert raw == wrapped == doc
+    (tmp_path / "junk.json").write_text(json.dumps({"rows": []}))
+    with pytest.raises(ValueError, match="not a bench result"):
+        load_bench(str(tmp_path / "junk.json"))
+
+
+def test_phase_shares():
+    assert phase_shares({}) == {}
+    assert phase_shares({"phases": {}}) == {}
+    s = phase_shares({"phases": {"p0/dispatch": 3.0, "p0/device_wait": 1.0}})
+    assert s == {"p0/dispatch": 0.75, "p0/device_wait": 0.25}
+
+
+def test_headline_regression_flagged():
+    reg, notes = compare_pair("a", _bench(100.0), "b", _bench(85.0), 0.10)
+    assert len(reg) == 1 and "REGRESSION" in reg[0]
+    # Within threshold: a note, not a regression.
+    reg, notes = compare_pair("a", _bench(100.0), "b", _bench(95.0), 0.10)
+    assert reg == [] and any("-5.0%" in n for n in notes)
+    # Improvement is never a regression.
+    reg, _ = compare_pair("a", _bench(100.0), "b", _bench(150.0), 0.10)
+    assert reg == []
+
+
+def test_phase_share_drift_is_note_not_regression():
+    a = _bench(100.0, phases={"dispatch": 1.0, "device_wait": 1.0})
+    b = _bench(100.0, phases={"dispatch": 9.0, "device_wait": 1.0})
+    reg, notes = compare_pair("a", a, "b", b, 0.10)
+    assert reg == []
+    assert any("phase share dispatch" in n for n in notes)
+
+
+def test_dcn_scaling_regression_flagged():
+    a = _bench(100.0, dcn={"aggregate_pps": 1000.0})
+    b = _bench(100.0, dcn={"aggregate_pps": 500.0})
+    reg, _ = compare_pair("a", a, "b", b, 0.10)
+    assert len(reg) == 1 and "aggregate_pps" in reg[0]
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    ok_a = _write(tmp_path, "a.json", _bench(100.0), wrap=True)
+    ok_b = _write(tmp_path, "b.json", _bench(101.0))
+    assert main([ok_a, ok_b]) == 0
+    bad = _write(tmp_path, "c.json", _bench(50.0))
+    assert main([ok_a, ok_b, bad]) == 1
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.out
+    # Tighter threshold flips the ok pair too.
+    assert main(["--threshold", "0.001", ok_b, ok_a]) == 1

@@ -125,9 +125,80 @@ def test_two_process_kube_tuner_ckpt_parity():
     kube/chaos timelines with series telemetry through the host mirrors,
     a CEM tuner whose per-sweep gathers make the trajectory
     process-count-independent, checkpoint blob content from the
-    single-replay engine, and the loud replicated fallback for a batch
-    that does not divide over the processes."""
-    cases = ("chaos", "tuner", "ckpt", "odd")
+    single-replay engine, the loud replicated fallback for a batch that
+    does not divide over the processes, plus the round-12 merged fleet
+    telemetry (2-process ReplayTelemetry.merge == 1-process oracle)."""
+    cases = ("chaos", "tuner", "ckpt", "odd", "fleetmerge")
     res = _launch(cases, timeout=600)
     for c in cases:
         assert res[c] == _oracle(c), f"case {c} diverged"
+
+
+@pytest.mark.slow
+def test_killed_worker_fails_fast_attributed():
+    """Round-12 liveness bar: SIGKILL one worker mid-replay (the worker
+    self-kills after its chunk-0 heartbeat) and the SURVIVOR must abort
+    the gather with an attributed error naming the dead process and its
+    last completed chunk — well before KSIM_DCN_TIMEOUT_S (here 600s),
+    because the dead worker's beacon goes stale past KSIM_DCN_STALL_S."""
+    import time
+
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "KSIM_DCN_COORD": f"127.0.0.1:{port}",
+        "KSIM_DCN_NPROC": "2",
+        "KSIM_DCN_CASES": "fleetmerge",
+        # Fast-fail knobs: the full timeout is deliberately huge so the
+        # test proves the STALL detector (not the deadline) fired.
+        "KSIM_DCN_TIMEOUT_S": "600",
+        "KSIM_DCN_STALL_S": "2",
+        "KSIM_DCN_POLL_S": "0.3",
+        "KSIM_DCN_HEARTBEAT_EVERY": "1",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(__file__))]
+            + [
+                p
+                for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p
+            ]
+        ),
+    }
+    t0 = time.monotonic()
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, KSIM_DCN_PID=str(pid))
+        if pid == 1:
+            env["KSIM_DCN_SELFKILL_AT_CHUNK"] = "0"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    try:
+        out0, err0 = procs[0].communicate(timeout=300)
+        procs[1].wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.wait()
+        pytest.fail("survivor did not fail fast on a killed worker")
+    elapsed = time.monotonic() - t0
+    blob = out0 + err0
+    if "Multiprocess computations aren't implemented" in blob:
+        pytest.skip("jaxlib CPU backend lacks multiprocess execution")
+    assert procs[1].returncode == -9, "worker 1 should have SIGKILLed itself"
+    assert procs[0].returncode != 0, f"survivor exited 0:\n{blob}"
+    assert "process 1" in blob, f"error does not name the dead process:\n{blob}"
+    assert "last completed chunk" in blob, blob
+    assert "looks DEAD" in blob, blob
+    # Attributed failure must come from the stall detector, not the 600s
+    # deadline (generous bound: replay + compile + stall window).
+    assert elapsed < 240, f"survivor took {elapsed:.0f}s to fail"

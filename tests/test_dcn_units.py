@@ -293,3 +293,236 @@ def test_schema_accepts_dcn_fields():
     assert validate_row(row) == []
     assert validate_row({**row, "process_count": "2"})
     assert validate_row({**row, "dcn_scaling": 3})
+
+
+# -- round-12 heartbeats / attributed gather timeout ------------------------
+
+
+class _FakeKV:
+    """In-memory stand-in for the jaxlib coordination-service KV client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.store:
+            raise RuntimeError(f"key exists: {key}")
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        import time
+
+        if key in self.store:
+            return self.store[key]
+        time.sleep(timeout_ms / 1000.0)
+        raise RuntimeError(f"Deadline Exceeded: {key}")
+
+    def key_value_dir_get(self, prefix):
+        return [
+            (k, v) for k, v in sorted(self.store.items())
+            if k.startswith(prefix)
+        ]
+
+
+def _fleet(monkeypatch, nproc=2, pid=1):
+    kv = _FakeKV()
+    monkeypatch.setattr(dcn, "process_info", lambda: (nproc, pid))
+    monkeypatch.setattr(dcn, "_client", lambda: kv)
+    return kv
+
+
+def test_heartbeat_noop_single_process(monkeypatch):
+    kv = _FakeKV()
+    monkeypatch.setattr(dcn, "_client", lambda: kv)
+    assert dcn.heartbeat(3) is False
+    assert kv.store == {}
+
+
+def test_heartbeat_publishes_full_beacon(monkeypatch):
+    kv = _fleet(monkeypatch, nproc=2, pid=1)
+    ok = dcn.heartbeat(
+        3, total=10, block=(4, 8), wall_s=1.5,
+        phases={"dispatch": 0.25}, state="run",
+    )
+    assert ok is True
+    beat = json.loads(kv.store[f"{dcn.HB_PREFIX}/1"])
+    assert beat["pid"] == 1
+    assert beat["chunk"] == 3
+    assert beat["state"] == "run"
+    assert beat["total_chunks"] == 10
+    assert beat["block"] == [4, 8]
+    assert beat["wall_s"] == 1.5
+    assert beat["phases"] == {"dispatch": 0.25}
+    assert isinstance(beat["t"], float)
+    # live-buffer gauge (jax.live_arrays is available in-process)
+    assert isinstance(beat["live_buffers"], int)
+
+
+def test_heartbeat_overwrites_one_key(monkeypatch):
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    assert dcn.heartbeat(0)
+    assert dcn.heartbeat(5, state="gather")
+    keys = [k for k in kv.store if k.startswith(dcn.HB_PREFIX)]
+    assert keys == [f"{dcn.HB_PREFIX}/0"]
+    beat = json.loads(kv.store[keys[0]])
+    assert beat["chunk"] == 5 and beat["state"] == "gather"
+
+
+def test_heartbeat_file_mirror(tmp_path, monkeypatch):
+    _fleet(monkeypatch, nproc=2, pid=1)
+    monkeypatch.setenv("KSIM_DCN_HB_DIR", str(tmp_path))
+    assert dcn.heartbeat(2, total=4)
+    beat = json.loads((tmp_path / "p1.json").read_text())
+    assert beat["chunk"] == 2 and beat["total_chunks"] == 4
+    assert not list(tmp_path.glob(".p*.tmp")), "tmp file left behind"
+
+
+def test_maybe_heartbeat_cadence(monkeypatch):
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    # every=4: the start-of-replay beacon (chunk_done=-1) always fires,
+    # then chunks 3, 7, ... ((chunk_done+1) % every == 0).
+    assert dcn.maybe_heartbeat(-1, every=4) is True
+    assert dcn.maybe_heartbeat(0, every=4) is False
+    assert dcn.maybe_heartbeat(2, every=4) is False
+    assert dcn.maybe_heartbeat(3, every=4) is True
+    assert dcn.maybe_heartbeat(7, every=4) is True
+    # 0 disables entirely (and short-circuits before any KV traffic).
+    kv.store.clear()
+    assert dcn.maybe_heartbeat(-1, every=0) is False
+    assert kv.store == {}
+
+
+def test_heartbeat_every_env_default(monkeypatch):
+    _fleet(monkeypatch, nproc=2, pid=0)
+    assert dcn.heartbeat_every() == 1
+    monkeypatch.setenv("KSIM_DCN_HEARTBEAT_EVERY", "0")
+    assert dcn.heartbeat_every() == 0
+    assert dcn.maybe_heartbeat(-1) is False
+
+
+def test_read_heartbeats_parses_and_skips_junk(monkeypatch):
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    kv.store[f"{dcn.HB_PREFIX}/0"] = json.dumps({"pid": 0, "chunk": 7})
+    kv.store[f"{dcn.HB_PREFIX}/1"] = "not json"
+    kv.store[f"{dcn.HB_PREFIX}/xx"] = json.dumps({})
+    beats = dcn.read_heartbeats()
+    assert set(beats) == {0}
+    assert beats[0]["chunk"] == 7
+
+
+def test_gather_timeout_stale_beacon_fails_fast(monkeypatch):
+    """A sibling whose beacon went stale past KSIM_DCN_STALL_S is
+    presumed dead: the gather wait aborts IMMEDIATELY with an attributed
+    DcnGatherTimeout — long before the full KSIM_DCN_TIMEOUT_S."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "30")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.05")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 2, "total_chunks": 9, "state": "run",
+         "t": time.time() - 10.0, "block": [4, 8]}
+    )
+    t0 = time.monotonic()
+    with pytest.raises(dcn.DcnGatherTimeout) as ei:
+        dcn._get_attributed(kv, "ksim/gather/1/x/1/n", 1, "x")
+    assert time.monotonic() - t0 < 5.0, "did not fail fast"
+    msg = str(ei.value)
+    assert "process 1" in msg and "looks DEAD" in msg
+    assert "last completed chunk 2/9" in msg
+    assert "scenario block [4, 8)" in msg
+    assert ei.value.missing == [1]
+    assert 1 in ei.value.heartbeats
+
+
+def test_gather_timeout_no_beacon_waits_full_deadline(monkeypatch):
+    """No beacon is NO evidence of death (heartbeats may be disabled):
+    the wait keeps round-11 semantics — full KSIM_DCN_TIMEOUT_S, then an
+    attributed error naming the process that never published."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "0.2")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.05")
+    t0 = time.monotonic()
+    with pytest.raises(dcn.DcnGatherTimeout) as ei:
+        dcn._get_attributed(kv, "ksim/gather/1/x/1/n", 1, "x")
+    assert time.monotonic() - t0 >= 0.15
+    msg = str(ei.value)
+    assert "timed out after KSIM_DCN_TIMEOUT_S=0.2s" in msg
+    assert "no heartbeat ever received" in msg
+
+
+def test_gather_wait_survives_fresh_beacon_then_delivers(monkeypatch):
+    """A slow-but-alive sibling (fresh beacon) never trips the stall
+    detector; the poll loop returns the value as soon as it lands."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "10")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "60")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.02")
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 1, "t": time.time()}
+    )
+    calls = {"n": 0}
+    real_get = kv.blocking_key_value_get
+
+    def _late_get(key, timeout_ms):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            kv.store.setdefault("k", "2")
+        return real_get(key, timeout_ms)
+
+    kv.blocking_key_value_get = _late_get
+    assert dcn._get_attributed(kv, "k", 1, "x") == "2"
+    assert calls["n"] >= 3
+
+
+def test_jsonl_writer_stamps_process_under_dcn(tmp_path, monkeypatch):
+    """Round 12: JSONL rows from a fleet carry process_id/process_count;
+    single-process rows stay byte-unchanged (no stamp at all)."""
+    from kubernetes_simulator_tpu.utils.metrics import JsonlWriter
+
+    p1 = tmp_path / "single.jsonl"
+    with JsonlWriter(str(p1)) as w:
+        w.write({"kind": "x"})
+    row = json.loads(p1.read_text())
+    assert "process_id" not in row and "process_count" not in row
+
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 1))
+    p2 = tmp_path / "fleet.jsonl"
+    with JsonlWriter(str(p2)) as w:
+        w.write({"kind": "x"})
+    row = json.loads(p2.read_text())
+    assert row["process_id"] == 1 and row["process_count"] == 2
+
+
+def test_schema_accepts_process_stamp():
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", "scripts")
+        ),
+    )
+    from check_metrics_schema import validate_row
+
+    v2 = {
+        "ts": 0.0, "schema": 2, "seed": 0, "engine": "v3",
+        "config_hash": "h", "kind": "whatif-scenario",
+        "scenario": 0, "placed": 3, "unschedulable": 0,
+        "process_id": 1, "process_count": 2,
+    }
+    assert validate_row(v2) == []
+    assert validate_row({**v2, "process_id": "1"})
+    v3 = {
+        "schema": 3, "run_type": "tune", "kind": "tune-round",
+        "round": 0, "best_objective": 1.0, "round_best_objective": 1.0,
+        "mean_objective": 1.0, "best_candidate": 0,
+        "process_id": 0, "process_count": 2,
+    }
+    assert validate_row(v3) == []
+    assert validate_row({**v3, "process_count": 2.5})

@@ -355,3 +355,220 @@ def test_series_attribution_fallback_notes(caplog, tmp_path):
     np.testing.assert_array_equal(plain.assignments, ck.assignments)
     assert ck.telemetry is not None and not ck.telemetry.reasons
     assert plain.telemetry.reasons is not None  # instrumented run still works
+
+
+# -- round 12: mergeable telemetry / fleet observability -------------------
+
+
+def _mk_tel(vals, zero, reasons=None, attempts=None, series=None,
+            phases=None, events=(), gran="series"):
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    t = ReplayTelemetry(
+        granularity=gran,
+        latency=latency_summary(zero, vals),
+        phases=dict(phases or {}),
+        bind_latency={i: v for i, v in enumerate(vals)},
+        zero_latency_binds=zero,
+    )
+    t.reasons = reasons
+    t.rejection_attempts = attempts
+    t.series = series
+    t.events = list(events)
+    return t
+
+
+def test_merge_partition_bit_parity():
+    """The merge contract: merging disjoint halves reproduces EXACTLY the
+    telemetry of the union — histogram, counters, raw values, series."""
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    a = _mk_tel([1.0, 4.0], 2, reasons={"A": 2}, attempts={"A": 3},
+                series={"t": [0.0, 1.0], "queue": [1.0, 0.0]},
+                phases={"dispatch": 0.5})
+    b = _mk_tel([0.5], 1, reasons={"B": 1}, attempts={"A": 1, "B": 1},
+                series={"t": [2.0], "queue": [2.0]},
+                phases={"dispatch": 0.25, "device_wait": 0.1})
+    whole = _mk_tel([1.0, 4.0, 0.5], 3, reasons={"A": 2, "B": 1},
+                    attempts={"A": 4, "B": 1},
+                    series={"t": [0.0, 1.0, 2.0], "queue": [1.0, 0.0, 2.0]})
+    m = ReplayTelemetry.merge([a, b])
+    assert m.latency == whole.latency
+    assert m.reasons == whole.reasons
+    assert m.rejection_attempts == whole.rejection_attempts
+    assert m.series == whole.series
+    assert m.zero_latency_binds == 3
+    assert m.bind_latency == {0: 1.0, 1: 4.0, 2: 0.5}
+    # Same-process merge (no process_ids): phase timers key-wise summed.
+    assert m.phases == {"dispatch": 0.75, "device_wait": 0.1}
+
+
+def test_merge_process_phase_namespaces():
+    """With process_ids the wall clocks of different hosts stay DISTINCT
+    (p<pid>/<phase>), and re-merging a merge never double-prefixes."""
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    a = _mk_tel([1.0], 0, phases={"dispatch": 0.5})
+    b = _mk_tel([2.0], 0, phases={"dispatch": 0.25, "device_wait": 0.1})
+    m = ReplayTelemetry.merge([a, b], process_ids=[0, 1])
+    assert m.phases == {
+        "p0/dispatch": 0.5, "p1/dispatch": 0.25, "p1/device_wait": 0.1,
+    }
+    # Latency is identical to the unprefixed merge (phases never feed it).
+    assert m.latency == ReplayTelemetry.merge([a, b]).latency
+    m2 = ReplayTelemetry.merge([m], process_ids=[7])
+    assert m2.phases == m.phases  # "/" keys pass through unprefixed
+
+
+def test_merge_edge_cases():
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    assert ReplayTelemetry.merge([]) is None
+    assert ReplayTelemetry.merge([None, None]) is None
+    a = _mk_tel([1.0], 0)
+    # None parts are skipped, not counted.
+    m = ReplayTelemetry.merge([None, a, None], process_ids=[0, 1, 2])
+    assert m.latency["count"] == 1
+    b = _mk_tel([], 0, gran="summary")
+    with pytest.raises(ValueError, match="granularity"):
+        ReplayTelemetry.merge([a, b])
+    with pytest.raises(ValueError, match="process_ids"):
+        ReplayTelemetry.merge([a], process_ids=[0, 1])
+    # summary-granularity parts carry no counters/series: stays None.
+    c = _mk_tel([2.0], 1, gran="summary")
+    m = ReplayTelemetry.merge([b, c])
+    assert m.reasons is None and m.series is None
+    assert m.latency["count"] == 2
+
+
+def test_merge_associative_on_results():
+    """Partitioning 3 parts as (a+b)+c or a+(b+c) or all-at-once gives
+    the same virtual-time-derived telemetry (the DCN fleet merge relies
+    on this: per-process merges happen first, the gather merge second)."""
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    a = _mk_tel([1.0, 8.0], 1, reasons={"A": 1})
+    b = _mk_tel([0.25], 0, reasons={"B": 2})
+    c = _mk_tel([16.0], 2, reasons={"A": 3})
+    flat = ReplayTelemetry.merge([a, b, c])
+    left = ReplayTelemetry.merge([ReplayTelemetry.merge([a, b]), c])
+    right = ReplayTelemetry.merge([a, ReplayTelemetry.merge([b, c])])
+    for m in (left, right):
+        assert m.latency == flat.latency
+        assert m.reasons == flat.reasons
+        assert m.bind_latency == flat.bind_latency
+        assert m.zero_latency_binds == flat.zero_latency_binds
+
+
+def test_whatif_fleet_telemetry_single_process():
+    """Every what-if result now carries a merged fleet view: engine-level
+    phase timers under the p0/ namespace (single process) and a latency
+    histogram equal to the merge of the per-scenario telemetries."""
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    ec, ep = _light_trace(num_pods=20, num_nodes=4)
+    res = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario()], FIT_ONLY(), wave_width=1,
+        chunk_waves=1, preemption="kube", retry_buffer=64,
+        telemetry="series",
+    ).run()
+    ft = res.fleet_telemetry
+    assert ft is not None
+    assert ft.granularity == "series"
+    assert all(k.startswith("p0/") for k in ft.phases)
+    assert {k.split("/", 1)[1] for k in ft.phases} <= set(PHASE_NAMES)
+    oracle = ReplayTelemetry.merge(res.scenario_telemetry)
+    assert ft.latency == oracle.latency
+    assert ft.reasons == oracle.reasons
+    # Plain batches (no per-scenario telemetry) still get the phase view.
+    plain = WhatIfEngine(
+        ec, ep, [Scenario()], FIT_ONLY(), chunk_waves=4,
+    ).run()
+    assert plain.fleet_telemetry is not None
+    assert plain.fleet_telemetry.latency is None
+    assert any(k.startswith("p0/") for k in plain.fleet_telemetry.phases)
+
+
+def test_chrome_trace_merged_track_groups(tmp_path):
+    """write_chrome_trace_merged renders one track group PER PROCESS
+    (pids 2p/2p+1, suffixed names) while the single-result exporter keeps
+    the pre-round-12 pid 0/1 layout byte-for-byte."""
+    from kubernetes_simulator_tpu.sim.telemetry import (
+        write_chrome_trace_merged,
+    )
+
+    ec, ep = _light_trace(num_pods=8, num_nodes=2)
+    res = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="timeline").replay()
+    single = str(tmp_path / "single.json")
+    write_chrome_trace(single, res, arrival=ep.arrival, duration=ep.duration)
+    with open(single) as f:
+        names = {
+            (e["pid"], e["args"]["name"])
+            for e in json.load(f)["traceEvents"]
+            if e["name"] == "process_name"
+        }
+    assert names == {(0, "cluster"), (1, "chaos")}
+
+    merged = str(tmp_path / "merged.json")
+    n = write_chrome_trace_merged(
+        merged,
+        [(res, ep.arrival, ep.duration), (res, ep.arrival, ep.duration)],
+    )
+    with open(merged) as f:
+        ev = json.load(f)["traceEvents"]
+    assert len(ev) == n
+    names = {
+        (e["pid"], e["args"]["name"])
+        for e in ev if e["name"] == "process_name"
+    }
+    assert names == {
+        (0, "cluster (p0)"), (1, "chaos (p0)"),
+        (2, "cluster (p1)"), (3, "chaos (p1)"),
+    }
+    # Pod spans land inside their process's track group.
+    assert {e["pid"] for e in ev if e["name"].startswith("pod")} == {0, 2}
+
+
+def test_profiler_annotations_bit_parity(tmp_path, monkeypatch):
+    """KSIM_PROFILE_DIR arms TraceAnnotation markers on every phase tick
+    and chunk dispatch — results must stay bit-identical with the hooks
+    on (no active trace needed: annotations outside a trace are no-ops)."""
+    from kubernetes_simulator_tpu.utils import profiling
+
+    monkeypatch.delenv("KSIM_PROFILE_DIR", raising=False)
+    assert not profiling.profiling_active()
+    ec, ep = _light_trace(num_pods=16, num_nodes=4)
+    cfg = FIT_ONLY()
+    off = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+        retry_buffer=64, telemetry="series",
+    ).replay()
+    woff = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario()], cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=64, telemetry="series",
+    ).run()
+    monkeypatch.setenv("KSIM_PROFILE_DIR", str(tmp_path))
+    assert profiling.profiling_active()
+    on = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+        retry_buffer=64, telemetry="series",
+    ).replay()
+    won = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario()], cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=64, telemetry="series",
+    ).run()
+    np.testing.assert_array_equal(off.assignments, on.assignments)
+    assert off.telemetry.latency == on.telemetry.latency
+    np.testing.assert_array_equal(woff.placed, won.placed)
+    np.testing.assert_array_equal(
+        np.asarray(woff.latency_p50, np.float64),
+        np.asarray(won.latency_p50, np.float64),
+    )
+
+
+def test_live_buffer_stats_gauge():
+    from kubernetes_simulator_tpu.utils.profiling import live_buffer_stats
+
+    s = live_buffer_stats()
+    assert isinstance(s.get("count"), int) and s["count"] >= 0
+    assert isinstance(s.get("bytes"), int) and s["bytes"] >= 0
