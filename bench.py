@@ -89,6 +89,7 @@ def main():
     from kubernetes_simulator_tpu.sim.greedy import greedy_replay
     from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
     from kubernetes_simulator_tpu.sim.whatif import WhatIfEngine, uniform_scenarios
+    from kubernetes_simulator_tpu.utils.metrics import round_fragmentation
 
     # Mesh-default headline (round 10): shard the scenario axis over every
     # visible device; scenario count scales with the device count so each
@@ -328,6 +329,22 @@ def main():
                     "completions_on": bool(res.completions_on),
                     "duration_mean_s": dur_mean,
                     "cpu_default_path_pps": round(cpu_pps, 1),
+                    # Utilization economics (round 13): end-of-replay
+                    # utilization + fragmentation gauges of the CPU
+                    # baseline, and the what-if batch's mean scenario CPU
+                    # utilization — bench_compare.py diffs these like the
+                    # headline pps.
+                    "utilization": {
+                        "cpu_baseline_util_cpu": round(
+                            cpu_res.utilization.get("cpu", 0.0), 6
+                        ),
+                        "cpu_baseline_fragmentation": round_fragmentation(
+                            cpu_res.fragmentation
+                        ),
+                        "whatif_util_cpu_mean": round(
+                            float(np.mean(res.utilization_cpu)), 6
+                        ),
+                    },
                     "scenario0_placed": int(res.placed[0]),
                     "device": _device_kind(),
                     # Round 12: engine wall-clock phase shares (fleet-

@@ -113,7 +113,8 @@ def cmd_run(args) -> int:
         from .sim.telemetry import write_chrome_trace
 
         n_ev = write_chrome_trace(
-            timeline_out, res, arrival=ep.arrival, duration=ep.duration
+            timeline_out, res, arrival=ep.arrival, duration=ep.duration,
+            requests=ep.requests, rindex=ec.vocab._r,
         )
         log.info("timeline: wrote %d trace events to %s", n_ev, timeline_out)
     log.info(
@@ -212,6 +213,7 @@ def cmd_tune(args) -> int:
         ec, ep, cfg.framework,
         algo=tu.algo, population=tu.population, rounds=tu.rounds,
         seed=tu.seed, elite_frac=tu.elite_frac, objective=tu.objective,
+        constraints=tu.constraints, evaluator=tu.evaluator,
         train_scenarios=tu.train_scenarios,
         heldout_scenarios=tu.heldout_scenarios,
         scenario_seed=tu.scenario_seed,
@@ -380,7 +382,9 @@ def validate_config(cfg) -> list:
             )
     tu = cfg.tune
     if tu is not None:
-        from .sim.tuner import _ALWAYS_METRICS, _RESULT_METRICS
+        from .sim.tuner import (
+            _ALWAYS_METRICS, _RESULT_METRICS, normalize_constraints,
+        )
 
         if tu.algo not in ("cem", "random"):
             errors.append(
@@ -397,18 +401,32 @@ def validate_config(cfg) -> list:
                 "tune.scenarios: train and heldout must both be >= 1 "
                 "(the acceptance check runs on the held-out split)"
             )
-        for term in tu.objective or {}:
+        if tu.evaluator not in ("auto", "device", "cpu"):
+            errors.append(
+                f"tune.evaluator: must be 'auto', 'device' or 'cpu', "
+                f"got {tu.evaluator!r}"
+            )
+        try:
+            cons = normalize_constraints(tu.constraints)
+        except ValueError as e:
+            errors.append(f"tune.constraints: {e}")
+            cons = []
+        terms = list(tu.objective or {}) + [c["metric"] for c in cons]
+        for term in terms:
             if term not in _RESULT_METRICS:
                 errors.append(
                     f"tune.objective: unknown term '{term}' "
                     f"(known: {', '.join(sorted(_RESULT_METRICS))})"
                 )
-            elif term not in _ALWAYS_METRICS:
+            elif term not in _ALWAYS_METRICS and tu.evaluator == "device":
+                # auto/cpu route such terms to the CPU event engine
+                # (round 13); only an EXPLICIT device evaluator is stuck
+                # with the batched-sweep metric set.
                 errors.append(
-                    f"tune.objective: term '{term}' needs what-if modes "
-                    "(kube/tier preemption) the per-scenario policy axis "
-                    "does not support — use terms from "
-                    f"{', '.join(sorted(_ALWAYS_METRICS))}"
+                    f"tune.objective: term '{term}' rides the kube host "
+                    "mirrors, which the batched policy sweep does not "
+                    "support — drop 'evaluator: device' or use terms "
+                    f"from {', '.join(sorted(_ALWAYS_METRICS))}"
                 )
         wb = tu.weight_bounds
         if wb is not None and (len(wb) != 2 or wb[0] >= wb[1]):

@@ -82,6 +82,19 @@ def fit_mask(ec: EncodedCluster, st: SchedState, pods: EncodedPods, p: int) -> n
     return np.all(st.used + req[None, :] <= ec.allocatable + 1e-6, axis=1)
 
 
+def pending_fit_mask(
+    used: np.ndarray, allocatable: np.ndarray, request: np.ndarray
+) -> np.ndarray:
+    """[N] — which nodes could fit ONE request right now, in the
+    scheduler's own fit arithmetic (identical eps form to ``fit_mask``
+    above, on raw arrays instead of the encoded wrappers). The round-13
+    stranded-capacity gauge (utils.metrics.fragmentation_gauges) charges
+    a node's free capacity as stranded only when THIS test fails — so
+    "cannot fit" means exactly what the Filter pass would decide, on
+    every engine."""
+    return np.all(used + request[None, :] <= allocatable + 1e-6, axis=1)
+
+
 # Scores are INTEGER-valued f32 ([K8S] computes int64 node scores; we floor
 # through single-op chains — sub/div/mul/floor, nothing XLA can FMA-fuse —
 # so the CPU and device paths are bit-identical and argmax ties break the

@@ -642,11 +642,20 @@ class BoundaryOps:
             self.retry_q = still_q
         if tel is not None and tel.cfg.want_series and np.isfinite(t_chunk):
             # Post-boundary occupancy in virtual time (the device twin of
-            # the CPU engine's per-event queue-depth samples).
+            # the CPU engine's per-event queue-depth samples). Utilization
+            # gauges need the mirror's committed planes — flush the lazy
+            # plane log first (cheap/idempotent when empty; the caller
+            # already forced a pre-boundary fold under want_series).
+            self.flush_planes()
+            from ..utils.metrics import series_gauges
+
             tel.sample(
                 float(t_chunk),
                 retry_depth=len(self.retry_q),
                 pend_depth=len(self.pend),
+                **series_gauges(
+                    self.st.used, self.ec.allocatable, self.ec.vocab._r
+                ),
             )
 
         def _pairs(lst: List[Tuple[int, int]]) -> PairArrays:

@@ -42,6 +42,7 @@ import numpy as np
 from ..framework.framework import FrameworkConfig, SchedulerFramework
 from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import bind, unbind
+from ..utils.metrics import fragmentation_gauges, utilization_means
 from .runtime import ReplayResult
 from .waves import WaveBatch, pack_waves
 
@@ -240,14 +241,11 @@ def greedy_replay(
     placed_total = ops.placed_total
     preemptions += ops.preemptions
     to_schedule = int((ep.bound_node == PAD).sum())
-    util = {}
-    for rname in ("cpu", "memory"):
-        ri = ec.vocab._r.get(rname)
-        if ri is not None:
-            alloc = ec.allocatable[:, ri]
-            with np.errstate(invalid="ignore", divide="ignore"):
-                u = np.where(alloc > 0, st.used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
-            util[rname] = float(u.mean())
+    util = utilization_means(st.used, ec.allocatable, ec.vocab._r)
+    pending = (ep.bound_node == PAD) & (assignments == PAD)
+    frag = fragmentation_gauges(
+        ec.allocatable, st.used, ep.requests[pending], ec.vocab._r
+    )
     return ReplayResult(
         assignments=assignments,
         placed=placed_total,
@@ -260,4 +258,5 @@ def greedy_replay(
         utilization=util,
         state=st,
         retry_dropped=ops.retry_dropped,
+        fragmentation=frag,
     )

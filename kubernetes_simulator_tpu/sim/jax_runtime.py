@@ -38,6 +38,11 @@ from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import SchedState, init_state
 from ..ops import tpu as T
 from ..plugins.builtin import DEFAULT_WEIGHTS
+from ..utils.metrics import (
+    fragmentation_gauges,
+    series_gauges,
+    utilization_means,
+)
 from .runtime import ReplayResult, events_hash, validate_node_events
 from .telemetry import TelemetryCollector, TelemetryConfig
 from .waves import WaveBatch, pack_waves
@@ -1047,11 +1052,17 @@ class JaxReplayEngine:
                             ci, wave_times[c0]
                         )
                 if pending is not None and (
-                    int(pending[3]) > 0 or bops.retry_q
+                    int(pending[3]) > 0
+                    or bops.retry_q
+                    or (tel is not None and tel.cfg.want_series)
                 ):
                     # The boundary below will run the retry pass (new
                     # failures or a carried-over queue): it needs chunk
-                    # ci-1 folded and the mirror planes flushed.
+                    # ci-1 folded and the mirror planes flushed. Series
+                    # telemetry also forces the fold — the boundary's
+                    # utilization sample reads the mirror's committed
+                    # planes, and a quiet lazy chunk would leave them one
+                    # chunk stale.
                     _fold_pending()
                 chaos_p: List[np.ndarray] = []
                 chaos_n: List[np.ndarray] = []
@@ -1200,14 +1211,12 @@ class JaxReplayEngine:
             mc = T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost)
             aa = T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost)
             pw = T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost)
-        util = {}
-        for rname in ("cpu", "memory"):
-            ri = self.ec.vocab._r.get(rname)
-            if ri is not None:
-                alloc = self.ec.allocatable[:, ri]
-                with np.errstate(invalid="ignore", divide="ignore"):
-                    u = np.where(alloc > 0, used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
-                util[rname] = float(u.mean())
+        util = utilization_means(used, self.ec.allocatable, self.ec.vocab._r)
+        pending_m = (self.pods.bound_node == PAD) & (assignments == PAD)
+        frag = fragmentation_gauges(
+            self.ec.allocatable, used, self.pods.requests[pending_m],
+            self.ec.vocab._r,
+        )
         host_state = SchedState(
             used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
             bound=assignments.copy(),
@@ -1228,6 +1237,7 @@ class JaxReplayEngine:
             evict_rescheduled=bops.evict_rescheduled,
             evict_stranded=bops.evict_stranded,
             evict_latency_mean=bops.evict_latency_mean,
+            fragmentation=frag,
             telemetry=tel.result() if tel is not None else None,
         )
 
@@ -1410,7 +1420,9 @@ class JaxReplayEngine:
         )
         wave_times = (
             self._wave_start_times(idx)
-            if (pending_events or completions_on)
+            # use_rej: series telemetry also samples utilization at chunk
+            # boundaries, which needs the chunk start times.
+            if (pending_events or completions_on or use_rej)
             else None
         )
         pending_fold = None  # (rows, choices) of the not-yet-folded chunk
@@ -1515,6 +1527,24 @@ class JaxReplayEngine:
                                 as_v2=use_rej,
                             )
                         released[due_p] = True
+            if use_rej and wave_times is not None and np.isfinite(
+                wave_times[c0]
+            ):
+                # Utilization economics (round 13): chunk-boundary sample
+                # of the committed device state (binds through chunk ci-1
+                # plus the releases applied above). The fetch blocks on
+                # the in-flight chunk — a series-mode-only sync; summary
+                # runs the untouched program. The instrumented-rej carry
+                # guarantees node-space [N, R] state.used here.
+                with _tick("host_mirror"):
+                    tel.sample(
+                        float(wave_times[c0]),
+                        **series_gauges(
+                            np.asarray(state.used),
+                            np.asarray(self.dc.allocatable),
+                            self.ec.vocab._r,
+                        ),
+                    )
             with _tick("dispatch"), _chunk_ann(ci):
                 if use_rej:
                     state, rej_dev, choices = self._chunk_fn_rej(
@@ -1620,14 +1650,12 @@ class JaxReplayEngine:
             mc = T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost)
             aa = T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost)
             pw = T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost)
-        util = {}
-        for rname in ("cpu", "memory"):
-            ri = self.ec.vocab._r.get(rname)
-            if ri is not None:
-                alloc = self.ec.allocatable[:, ri]
-                with np.errstate(invalid="ignore", divide="ignore"):
-                    u = np.where(alloc > 0, used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
-                util[rname] = float(u.mean())
+        util = utilization_means(used, self.ec.allocatable, self.ec.vocab._r)
+        pending_m = (self.pods.bound_node == PAD) & (assignments == PAD)
+        frag = fragmentation_gauges(
+            self.ec.allocatable, used, self.pods.requests[pending_m],
+            self.ec.vocab._r,
+        )
         host_state = SchedState(
             used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
             bound=assignments.copy(),
@@ -1643,6 +1671,7 @@ class JaxReplayEngine:
             virtual_makespan=float(self.pods.arrival.max()) if self.pods.num_pods else 0.0,
             utilization=util,
             state=host_state,
+            fragmentation=frag,
             telemetry=tel.result() if tel is not None else None,
         )
 

@@ -26,6 +26,12 @@ from ..framework.queue import SchedulingQueue
 from ..framework.registry import register_strategy
 from ..models.encode import PAD, EncodedCluster, EncodedPods
 from ..models.state import SchedState, bind, init_state, unbind
+from ..utils.metrics import (
+    fragmentation_gauges,
+    round_fragmentation,
+    series_gauges,
+    utilization_means,
+)
 from .telemetry import ReplayTelemetry, TelemetryCollector, TelemetryConfig
 
 # Event kinds, in tie-break order at equal timestamps: node events first,
@@ -150,6 +156,13 @@ class ReplayResult:
     evict_rescheduled: int = 0
     evict_stranded: int = 0
     evict_latency_mean: float = 0.0
+    # Utilization economics (round 13): end-of-replay fragmentation /
+    # stranded-capacity / packing gauges (utils.metrics
+    # fragmentation_gauges) computed from the committed state against the
+    # restored allocatable, with the still-pending pod set. Bit-identical
+    # CPU engine ↔ device paths. None only on legacy callers that build
+    # the result by hand.
+    fragmentation: Optional[dict] = None
     # Telemetry (sim.telemetry.ReplayTelemetry) — None at granularity
     # "off". Latency histograms, rejection attribution, series, phase
     # timers; see the telemetry module docstring for cross-engine
@@ -172,6 +185,8 @@ class ReplayResult:
             "evict_stranded": self.evict_stranded,
             "evict_latency_mean": round(self.evict_latency_mean, 4),
         }
+        if self.fragmentation is not None:
+            out["fragmentation"] = round_fragmentation(self.fragmentation)
         if self.telemetry is not None:
             out["telemetry"] = self.telemetry.summary()
         return out
@@ -387,6 +402,11 @@ class CpuReplayEngine:
                         active=len(q),
                         unschedulable=q.num_unschedulable,
                         backoff=q.num_backoff,
+                        # Utilization economics (round 13): sampled after
+                        # the instant's events, before scheduling — the
+                        # device boundary samples the same committed
+                        # state via the shared helper (bit-parity).
+                        **series_gauges(st.used, ec.allocatable, ec.vocab._r),
                     )
                 _pt = time.perf_counter()
 
@@ -477,15 +497,12 @@ class CpuReplayEngine:
 
         wall = time.perf_counter() - t0
         ec.allocatable[:] = saved_alloc
-        util = {}
-        for rname in ("cpu", "memory"):
-            ri = ec.vocab._r.get(rname)
-            if ri is not None:
-                alloc = ec.allocatable[:, ri]
-                with np.errstate(invalid="ignore", divide="ignore"):
-                    u = np.where(alloc > 0, st.used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
-                util[rname] = float(u.mean())
+        util = utilization_means(st.used, ec.allocatable, ec.vocab._r)
         unsched = int((assignments[to_schedule] == PAD).sum())
+        pending = to_schedule[assignments[to_schedule] == PAD]
+        frag = fragmentation_gauges(
+            ec.allocatable, st.used, pods.requests[pending], ec.vocab._r
+        )
         return ReplayResult(
             assignments=assignments,
             placed=placed,
@@ -503,6 +520,7 @@ class CpuReplayEngine:
             evict_latency_mean=(
                 evict_lat_sum / evict_rescheduled if evict_rescheduled else 0.0
             ),
+            fragmentation=frag,
             telemetry=tel.result() if tel is not None else None,
         )
 

@@ -438,6 +438,8 @@ def _trace_events(
     arrival: Optional[np.ndarray] = None,
     duration: Optional[np.ndarray] = None,
     process_id: Optional[int] = None,
+    requests: Optional[np.ndarray] = None,
+    rindex: Optional[Dict[str, int]] = None,
 ) -> List[dict]:
     """Trace events for ONE result. With ``process_id`` None (the
     single-process export) pids are 0 ("cluster") / 1 ("chaos") exactly
@@ -463,6 +465,7 @@ def _trace_events(
         ev.append({"name": "thread_name", "ph": "M", "pid": pid_cluster,
                    "tid": n, "args": {"name": f"node{n}"}})
     lat = tel.bind_latency if tel is not None else {}
+    spans: List[tuple] = []  # (pod, node, start, end) — spans + counters
     if arrival is not None:
         placed = np.nonzero(assignments >= 0)[0]
         for p in placed.tolist():
@@ -470,11 +473,40 @@ def _trace_events(
             end = makespan
             if duration is not None and np.isfinite(duration[p]):
                 end = min(end, start + float(duration[p]))
+            spans.append((p, int(assignments[p]), start, end))
             ev.append({
                 "name": f"pod{p}", "ph": "X", "pid": pid_cluster,
                 "tid": int(assignments[p]),
                 "ts": start * 1e6, "dur": max(end - start, 0.0) * 1e6,
             })
+    if requests is not None and rindex is not None and spans:
+        # Per-node utilization counter tracks (round 13): the pod spans
+        # above double as change-points of a running cpu/mem usage sum,
+        # emitted as Chrome "C" counter events — Perfetto renders one
+        # stacked-area track per node next to its span row.
+        req = np.asarray(requests, dtype=np.float64)
+        cols = [
+            (rn, ri) for rn, ri in sorted(rindex.items(), key=lambda kv: kv[1])
+            if rn in ("cpu", "memory")
+        ]
+        deltas: Dict[int, Dict[float, np.ndarray]] = {}
+        for p, n, start, end in spans:
+            d = deltas.setdefault(n, {})
+            r = req[p, [ri for _, ri in cols]]
+            d[start] = d.get(start, 0.0) + r
+            d[end] = d.get(end, 0.0) - r
+        for n in sorted(deltas):
+            run = np.zeros(len(cols), dtype=np.float64)
+            for t in sorted(deltas[n]):
+                run = run + deltas[n][t]
+                ev.append({
+                    "name": f"node{n} usage", "ph": "C", "pid": pid_cluster,
+                    "tid": n, "ts": t * 1e6,
+                    "args": {
+                        rn: round(float(run[k]), 6)
+                        for k, (rn, _) in enumerate(cols)
+                    },
+                })
     down_at: Dict[int, float] = {}
     for kind, t, pod, node in (tel.events if tel is not None else ()):
         if kind == "node_down":
@@ -504,6 +536,8 @@ def write_chrome_trace(
     arrival: Optional[np.ndarray] = None,
     duration: Optional[np.ndarray] = None,
     process_id: Optional[int] = None,
+    requests: Optional[np.ndarray] = None,
+    rindex: Optional[Dict[str, int]] = None,
 ) -> int:
     """Export the SIMULATED cluster timeline as a Chrome trace JSON
     (load in Perfetto / chrome://tracing). Virtual seconds map to trace
@@ -515,22 +549,38 @@ def write_chrome_trace(
     evict / boundary re-binds) appear as instant events on the node row.
     ``process_id`` scopes the track group for multi-process exports (see
     :func:`_trace_events`); the default keeps the round-7 pid 0/1 layout.
+    ``requests`` ([P, R] pod requests) + ``rindex`` (resource → column)
+    additionally emit per-node cpu/mem usage counter tracks (round 13).
     Returns the number of trace events written."""
-    ev = _trace_events(res, arrival, duration, process_id)
+    ev = _trace_events(
+        res, arrival, duration, process_id, requests=requests, rindex=rindex
+    )
     with open(path, "w") as f:
         json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
     return len(ev)
 
 
-def write_chrome_trace_merged(path: str, parts: Sequence[tuple]) -> int:
+def write_chrome_trace_merged(
+    path: str,
+    parts: Sequence[tuple],
+    rindex: Optional[Dict[str, int]] = None,
+) -> int:
     """Merge per-process timelines into ONE Chrome trace (round 12): each
-    element of ``parts`` is ``(res, arrival, duration)`` in process order,
-    and process *i*'s events land in its own track group ("cluster (pi)" /
-    "chaos (pi)"), so a 2-process DCN replay renders as a single Perfetto
-    timeline. Returns the number of trace events written."""
+    element of ``parts`` is ``(res, arrival, duration)`` — or, round 13,
+    ``(res, arrival, duration, requests)`` to add that process's per-node
+    usage counter tracks (``rindex`` maps resource → request column; the
+    fleet shares one vocabulary) — in process order, and process *i*'s
+    events land in its own track group ("cluster (pi)" / "chaos (pi)"),
+    so a 2-process DCN replay renders as a single Perfetto timeline.
+    Returns the number of trace events written."""
     ev: List[dict] = []
-    for i, (res, arrival, duration) in enumerate(parts):
-        ev.extend(_trace_events(res, arrival, duration, process_id=i))
+    for i, part in enumerate(parts):
+        res, arrival, duration = part[0], part[1], part[2]
+        requests = part[3] if len(part) > 3 else None
+        ev.extend(_trace_events(
+            res, arrival, duration, process_id=i,
+            requests=requests, rindex=rindex,
+        ))
     with open(path, "w") as f:
         json.dump({"traceEvents": ev, "displayTimeUnit": "ms"}, f)
     return len(ev)

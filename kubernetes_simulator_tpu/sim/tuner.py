@@ -66,11 +66,20 @@ _RESULT_METRICS = {
     "latencyP50": "latency_p50",
     "latencyP90": "latency_p90",
     "latencyP99": "latency_p99",
+    # Utilization economics (round 13) — fragmentation gauges ride the
+    # kube host mirrors on WhatIfResult and every ReplayResult; the
+    # host (CPU event engine) evaluator provides them for any trace.
+    "strandedCpu": "stranded_cpu",
+    "fragIndexCpu": "frag_index_cpu",
+    "packingEfficiency": "packing_efficiency",
 }
 
 #: Terms the CPU oracle (greedy_replay) can recompute exactly — the
 #: envelope check is skipped (with a log note) for objectives outside it.
-_ORACLE_METRICS = {"placementRate", "unschedulable", "utilizationCpu"}
+_ORACLE_METRICS = {
+    "placementRate", "unschedulable", "utilizationCpu",
+    "strandedCpu", "fragIndexCpu", "packingEfficiency",
+}
 
 DEFAULT_OBJECTIVE = {"placementRate": 1.0}
 
@@ -94,13 +103,54 @@ def _metric_series(res, key: str) -> np.ndarray:
     return np.asarray(val, np.float64)
 
 
-def make_objective(weights: Optional[Dict[str, float]]) -> Tuple[
-    Dict[str, float], Callable
-]:
-    """Validate an objective spec and return (weights, fn) where fn maps a
-    WhatIfResult to a per-scenario [S] f64 objective (HIGHER IS BETTER —
-    express costs with negative weights, e.g. ``{"placementRate": 1.0,
-    "unschedulable": -0.01}``)."""
+def normalize_constraints(constraints) -> List[dict]:
+    """Validate penalty-constraint specs (round 13). Each entry is
+    ``{"metric": <term>, "max": x | "min": x, "penalty": p}`` — ``max``
+    bounds the metric from above, ``min`` from below; ``penalty``
+    (default 1.0, must be > 0) scales the hinge. Returns normalized
+    copies (exactly one bound key, float values)."""
+    out: List[dict] = []
+    for i, c in enumerate(constraints or []):
+        where = f"constraints[{i}]"
+        if not isinstance(c, dict):
+            raise ValueError(f"{where}: expected a mapping, got {c!r}")
+        metric = c.get("metric")
+        if metric not in _RESULT_METRICS:
+            raise ValueError(
+                f"{where}: unknown metric {metric!r} — known: "
+                f"{sorted(_RESULT_METRICS)}"
+            )
+        has_max, has_min = "max" in c, "min" in c
+        if has_max == has_min:
+            raise ValueError(
+                f"{where}: need exactly one of 'max' or 'min' (got "
+                f"{sorted(set(c) & {'max', 'min'}) or 'neither'})"
+            )
+        penalty = float(c.get("penalty", 1.0))
+        if not penalty > 0:
+            raise ValueError(f"{where}: penalty must be > 0, got {penalty}")
+        unknown = sorted(set(c) - {"metric", "max", "min", "penalty"})
+        if unknown:
+            raise ValueError(f"{where}: unknown key(s) {unknown}")
+        norm = {"metric": metric, "penalty": penalty}
+        norm["max" if has_max else "min"] = float(c["max" if has_max else "min"])
+        out.append(norm)
+    return out
+
+
+def make_objective(
+    weights: Optional[Dict[str, float]], constraints=None
+) -> Tuple[Dict[str, float], List[dict], Callable]:
+    """Validate an objective spec and return (weights, constraints, fn)
+    where fn maps a WhatIfResult to a per-scenario [S] f64 objective
+    (HIGHER IS BETTER — express costs with negative weights, e.g.
+    ``{"placementRate": 1.0, "unschedulable": -0.01}``).
+
+    ``constraints`` (round 13) turn the weighted sum into a penalty form:
+    each violated bound subtracts ``penalty · relu(violation)`` — e.g.
+    maximize ``utilizationCpu`` subject to ``latencyP99 <= 2.0``. A NaN
+    constraint metric (a scenario that bound nothing has no latency
+    quantiles) contributes zero violation."""
     w = dict(DEFAULT_OBJECTIVE if weights is None else weights)
     unknown = sorted(set(w) - set(_RESULT_METRICS))
     if unknown:
@@ -110,15 +160,23 @@ def make_objective(weights: Optional[Dict[str, float]]) -> Tuple[
         )
     if not w:
         raise ValueError("objective must contain at least one term")
+    cons = normalize_constraints(constraints)
 
     def fn(res) -> np.ndarray:
         out = None
         for key, wt in w.items():
             term = float(wt) * _metric_series(res, key)
             out = term if out is None else out + term
+        for c in cons:
+            v = _metric_series(res, c["metric"])
+            if "max" in c:
+                viol = np.maximum(v - c["max"], 0.0)
+            else:
+                viol = np.maximum(c["min"] - v, 0.0)
+            out = out - c["penalty"] * np.nan_to_num(viol, nan=0.0)
         return out
 
-    return w, fn
+    return w, cons, fn
 
 
 @dataclass(frozen=True)
@@ -223,6 +281,12 @@ class TuneResult:
     # identical full population and the search trajectory is
     # process-count-independent.
     process_count: int = 1
+    # Constraint-aware objectives (round 13): the normalized penalty
+    # constraints the search optimized under, and which evaluator scored
+    # candidates — "device" (batched what-if sweep) or "cpu" (the CPU
+    # event engine, required for latency/host-mirror terms).
+    objective_constraints: List[dict] = field(default_factory=list)
+    evaluator: str = "device"
 
     def improved(self) -> bool:
         return self.heldout_objective > self.default_heldout_objective
@@ -251,6 +315,8 @@ class PolicyTuner:
         seed: int = 0,
         elite_frac: float = 0.25,
         objective: Optional[Dict[str, float]] = None,
+        constraints: Optional[List[dict]] = None,
+        evaluator: str = "auto",
         train_scenarios: int = 4,
         heldout_scenarios: int = 2,
         scenario_seed: int = 0,
@@ -285,7 +351,43 @@ class PolicyTuner:
         self.space = SearchSpace.from_config(
             config, weight_bounds=weight_bounds, tune_strategy=tune_strategy
         )
-        self.objective_weights, self._objective = make_objective(objective)
+        (
+            self.objective_weights,
+            self.objective_constraints,
+            self._objective,
+        ) = make_objective(objective, constraints)
+        # Evaluator selection (round 13). "device": the batched policy
+        # sweep (one compiled executable, the round-9 fast path) —
+        # restricted to _ALWAYS_METRICS because the policy axis has no
+        # kube host mirrors. "cpu": score every candidate×scenario on the
+        # CPU event engine, which carries EVERY metric (latency
+        # quantiles, fragmentation gauges) exactly. "auto" picks device
+        # when the terms allow it, else cpu.
+        if evaluator not in ("auto", "device", "cpu"):
+            raise ValueError(
+                f"evaluator must be 'auto', 'device' or 'cpu', got "
+                f"{evaluator!r}"
+            )
+        terms = set(self.objective_weights) | {
+            c["metric"] for c in self.objective_constraints
+        }
+        needs_host = not terms <= set(_ALWAYS_METRICS)
+        if evaluator == "device" and needs_host:
+            raise ValueError(
+                f"objective/constraint term(s) "
+                f"{sorted(terms - set(_ALWAYS_METRICS))} ride the kube "
+                "host mirrors, which the batched policy sweep does not "
+                "support — use evaluator='cpu' (every candidate scored "
+                "on the CPU event engine) or restrict terms to "
+                f"{sorted(_ALWAYS_METRICS)}"
+            )
+        self.evaluator = "cpu" if (evaluator == "cpu" or needs_host) else "device"
+        if self.evaluator == "cpu" and evaluator == "auto":
+            log.info(
+                "tune: objective terms %s need the host evaluator — "
+                "scoring candidates on the CPU event engine",
+                sorted(terms - set(_ALWAYS_METRICS)),
+            )
         self.S_t = int(train_scenarios)
         self.S_h = int(heldout_scenarios)
         self.mesh = mesh
@@ -315,6 +417,12 @@ class PolicyTuner:
         self.cpu_oracle = bool(cpu_oracle)
         self.cpu_envelope = float(cpu_envelope)
         self._train_engine: Optional[WhatIfEngine] = None
+        # Host-evaluator state: perturbed host clusters per split, and a
+        # per-(split, vector) objective cache — the incumbent rides as
+        # candidate 0 of EVERY round, so caching keeps the search loop
+        # from re-replaying identical candidates.
+        self._host_clusters: Dict[str, list] = {}
+        self._host_cache: Dict[tuple, np.ndarray] = {}
 
     # -- population sampling ------------------------------------------------
 
@@ -357,9 +465,88 @@ class PolicyTuner:
         layout the train engine's scenario list was built with."""
         return np.repeat(cand, self.S_t, axis=0)
 
+    def _policy_config(self, vec: np.ndarray) -> FrameworkConfig:
+        """A candidate vector materialized as an ordinary FrameworkConfig
+        (the host engines' policy carrier)."""
+        desc = self.space.describe(vec)
+        strategy = desc.pop("fitStrategy")
+        base = self.config if self.config is not None else FrameworkConfig()
+        return base.with_policy(
+            desc, fit_strategy=strategy if self.space.tune_strategy else None
+        )
+
+    # -- host (CPU event engine) evaluator, round 13 -------------------------
+
+    def _host_split_clusters(self, split_name: str) -> list:
+        from .whatif import ScenarioSet
+
+        clusters = self._host_clusters.get(split_name)
+        if clusters is None:
+            split = (
+                self.train_split if split_name == "train"
+                else self.heldout_split
+            )
+            clusters = ScenarioSet(
+                self.ec, split, keep_host_stacks=True
+            ).host_clusters(self.ec)
+            self._host_clusters[split_name] = clusters
+        return clusters
+
+    def _host_row(self, ec_s, cfg: FrameworkConfig):
+        """One scenario scored on the CPU event engine — the exact oracle:
+        event-clock latencies, end-of-replay fragmentation gauges, every
+        _RESULT_METRICS term present (len-1 arrays, WhatIfResult shape)."""
+        from types import SimpleNamespace
+
+        from .runtime import CpuReplayEngine
+
+        r = CpuReplayEngine(ec_s, self.pods, cfg, telemetry="summary").replay()
+        lat = r.telemetry.latency if r.telemetry is not None else None
+
+        def q(k: str) -> np.ndarray:
+            return np.array(
+                [float(lat[k]) if lat else np.nan], np.float64
+            )
+
+        fr = r.fragmentation
+        return SimpleNamespace(
+            placed=np.array([float(r.placed)]),
+            unschedulable=np.array([float(r.unschedulable)]),
+            utilization_cpu=np.array([r.utilization.get("cpu", 0.0)]),
+            preemptions=np.array([float(r.preemptions)]),
+            retry_dropped=np.array([float(r.retry_dropped)]),
+            evictions=np.array([float(r.evictions)]),
+            latency_p50=q("p50"), latency_p90=q("p90"), latency_p99=q("p99"),
+            stranded_cpu=np.array([fr["stranded"].get("cpu", 0.0)]),
+            frag_index_cpu=np.array([fr["frag_index"].get("cpu", 0.0)]),
+            packing_efficiency=np.array([fr["packing_efficiency"]]),
+        )
+
+    def _host_objective(self, vec: np.ndarray, split_name: str) -> np.ndarray:
+        """Per-scenario objective of one candidate on one split, via the
+        CPU event engine; cached by (split, vector bytes)."""
+        key = (split_name, np.asarray(vec, np.float32).tobytes())
+        hit = self._host_cache.get(key)
+        if hit is not None:
+            return hit
+        cfg = self._policy_config(vec)
+        rows = [
+            self._host_row(ec_s, cfg)
+            for ec_s in self._host_split_clusters(split_name)
+        ]
+        obj = np.concatenate([self._objective(r) for r in rows])
+        self._host_cache[key] = obj
+        return obj
+
     def _train_eval(self, cand: np.ndarray) -> np.ndarray:
-        """Evaluate the whole population in ONE device sweep; returns the
+        """Evaluate the whole population in ONE device sweep (host mode:
+        one CPU event replay per candidate×scenario, cached); returns the
         [P] per-candidate objective (mean over its train scenarios)."""
+        if self.evaluator == "cpu":
+            return np.array([
+                float(self._host_objective(cand[i], "train").mean())
+                for i in range(self.population)
+            ])
         flat = self._flat_policies(cand)
         if self._train_engine is None:
             self._train_engine = WhatIfEngine(
@@ -376,6 +563,10 @@ class PolicyTuner:
         """One 2-policy sweep on the held-out split: winner vs the
         config's default policy. Returns (best_obj, default_obj,
         per-scenario winner objectives, engine)."""
+        if self.evaluator == "cpu":
+            best = self._host_objective(best_vec, "heldout")
+            default = self._host_objective(self.space.defaults, "heldout")
+            return float(best.mean()), float(default.mean()), best, None
         pol = np.concatenate([
             np.repeat(best_vec[None], self.S_h, axis=0),
             np.repeat(self.space.defaults[None], self.S_h, axis=0),
@@ -398,18 +589,22 @@ class PolicyTuner:
         from .greedy import greedy_replay
         from .whatif import ScenarioSet
 
-        if not set(self.objective_weights) <= _ORACLE_METRICS:
+        if self.evaluator == "cpu":
+            log.info(
+                "tune: CPU-oracle check skipped — evaluation already ran "
+                "on the CPU event engine"
+            )
+            return None
+        terms = set(self.objective_weights) | {
+            c["metric"] for c in self.objective_constraints
+        }
+        if not terms <= _ORACLE_METRICS:
             log.info(
                 "tune: CPU-oracle check skipped — objective uses terms "
                 "outside %s", sorted(_ORACLE_METRICS),
             )
             return None
-        desc = self.space.describe(best_vec)
-        strategy = desc.pop("fitStrategy")
-        base = self.config if self.config is not None else FrameworkConfig()
-        cfg = base.with_policy(
-            desc, fit_strategy=strategy if self.space.tune_strategy else None
-        )
+        cfg = self._policy_config(best_vec)
         sset = ScenarioSet(self.ec, self.heldout_split, keep_host_stacks=True)
         chunk = eng.chunk_waves if eng.completions_on else None
         rows = []
@@ -419,6 +614,7 @@ class PolicyTuner:
                 completions_chunk_waves=chunk,
             )
             placed, unsched = float(r.placed), float(r.unschedulable)
+            fr = r.fragmentation or {}
             rows.append(SimpleNamespace(
                 placed=np.array([placed]),
                 unschedulable=np.array([unsched]),
@@ -427,6 +623,15 @@ class PolicyTuner:
                 retry_dropped=np.array([float(r.retry_dropped)]),
                 evictions=np.array([float(r.evictions)]),
                 latency_p50=None, latency_p90=None, latency_p99=None,
+                stranded_cpu=np.array(
+                    [fr.get("stranded", {}).get("cpu", 0.0)]
+                ),
+                frag_index_cpu=np.array(
+                    [fr.get("frag_index", {}).get("cpu", 0.0)]
+                ),
+                packing_efficiency=np.array(
+                    [fr.get("packing_efficiency", 1.0)]
+                ),
             ))
         return np.concatenate([self._objective(r) for r in rows])
 
@@ -526,6 +731,8 @@ class PolicyTuner:
             "objective_weights": {
                 k: float(v) for k, v in self.objective_weights.items()
             },
+            "objective_constraints": self.objective_constraints,
+            "evaluator": self.evaluator,
             "algo": self.algo,
             "seed": self.seed,
         })
@@ -556,4 +763,6 @@ class PolicyTuner:
                 else None
             ),
             process_count=jax.process_count(),
+            objective_constraints=self.objective_constraints,
+            evaluator=self.evaluator,
         )

@@ -470,6 +470,14 @@ class WhatIfResult:
     latency_p50: Optional[np.ndarray] = None  # [S] f64
     latency_p90: Optional[np.ndarray] = None  # [S] f64
     latency_p99: Optional[np.ndarray] = None  # [S] f64
+    # Per-scenario fragmentation economics (round 13, kube batches only —
+    # like the latency quantiles, the host mirrors are the only carrier
+    # of per-scenario committed state + pending sets; plain/batch paths
+    # report None). Bit-matches the single kube replay's
+    # ReplayResult.fragmentation on the same scenario.
+    stranded_cpu: Optional[np.ndarray] = None  # [S] f64
+    frag_index_cpu: Optional[np.ndarray] = None  # [S] f64
+    packing_efficiency: Optional[np.ndarray] = None  # [S] f64
     # Per-scenario ReplayTelemetry (kube batches at series+; else None).
     scenario_telemetry: Optional[list] = None
     # Fleet-merged ReplayTelemetry (round 12): every process's partial
@@ -2789,6 +2797,7 @@ class WhatIfEngine:
         kube_preempt = kube_dropped = None
         kube_evict = kube_resched = kube_stranded = kube_lat = None
         sc_lat_p50 = sc_lat_p90 = sc_lat_p99 = sc_telemetry = None
+        frag_stranded = frag_index = frag_pack = None
         stel = None
         if kbops is not None:
             host_k = np.stack([b.assignments for b in kbops])
@@ -2806,6 +2815,27 @@ class WhatIfEngine:
             kube_resched = cnt[:, 3].astype(np.int32)
             kube_stranded = cnt[:, 4].astype(np.int32)
             kube_lat = cnt[:, 5]
+            # Fragmentation economics (round 13): each mirror holds the
+            # scenario's committed state, its restored allocatable view
+            # (hs["alloc"][s] — put back above when events ran), and the
+            # still-pending set — exactly the inputs the single-replay
+            # engines hand to the same helper, so the [S] gauges
+            # bit-match the per-scenario kube replays.
+            from ..utils.metrics import fragmentation_gauges
+
+            frag_stranded = np.zeros(self.S, np.float64)
+            frag_index = np.zeros(self.S, np.float64)
+            frag_pack = np.zeros(self.S, np.float64)
+            for s, b in enumerate(kbops):
+                b.flush_planes()
+                pend = scheduled & (host_k[s] == PAD)
+                fr = fragmentation_gauges(
+                    b.ec.allocatable, b.st.used,
+                    self.pods.requests[pend], b.ec.vocab._r,
+                )
+                frag_stranded[s] = fr["stranded"].get("cpu", 0.0)
+                frag_index[s] = fr["frag_index"].get("cpu", 0.0)
+                frag_pack[s] = fr["packing_efficiency"]
             if self.telemetry_cfg.enabled:
                 stel = [t.result() for t in ktel]
                 lat_q = np.full((3, self.S), np.nan, np.float64)
@@ -2958,6 +2988,16 @@ class WhatIfEngine:
                     wall_s=wall,
                     phases=run_phases.acc,
                     state="gather",
+                    # Fleet utilization gauge (round 13): this process's
+                    # mean CPU utilization over its local scenario block —
+                    # already computed above, so the beacon stays free of
+                    # extra D2H. dcn_launch --watch renders it next to
+                    # the live-buffer gauge.
+                    extra=(
+                        {"util_cpu": round(float(np.mean(util)), 4)}
+                        if util is not None and len(util)
+                        else None
+                    ),
                 )
             parts = dcn.gather(
                 "whatif",
@@ -2974,6 +3014,9 @@ class WhatIfEngine:
                     lat50=sc_lat_p50,
                     lat90=sc_lat_p90,
                     lat99=sc_lat_p99,
+                    frag_stranded=frag_stranded,
+                    frag_index=frag_index,
+                    frag_pack=frag_pack,
                     telemetry=sc_telemetry,
                     fleet=fleet_local,
                 ),
@@ -2996,6 +3039,9 @@ class WhatIfEngine:
             sc_lat_p50 = _cat("lat50")
             sc_lat_p90 = _cat("lat90")
             sc_lat_p99 = _cat("lat99")
+            frag_stranded = _cat("frag_stranded")
+            frag_index = _cat("frag_index")
+            frag_pack = _cat("frag_pack")
             sc_telemetry = (
                 None
                 if parts[0]["telemetry"] is None
@@ -3035,6 +3081,9 @@ class WhatIfEngine:
             latency_p50=sc_lat_p50,
             latency_p90=sc_lat_p90,
             latency_p99=sc_lat_p99,
+            stranded_cpu=frag_stranded,
+            frag_index_cpu=frag_index,
+            packing_efficiency=frag_pack,
             scenario_telemetry=sc_telemetry,
             fleet_telemetry=fleet_tel,
             # Global footprint: process_count × local devices when the

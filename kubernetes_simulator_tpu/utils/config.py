@@ -129,6 +129,11 @@ class TuneSpec:
     seed: int = 0
     elite_frac: float = 0.25
     objective: Optional[Dict[str, float]] = None
+    # Round 13: penalty constraints (list of {metric, max|min, penalty})
+    # and the evaluator knob — "auto" (device sweep when the terms allow,
+    # else the CPU event engine), "device", or "cpu".
+    constraints: Optional[List[Dict[str, float]]] = None
+    evaluator: str = "auto"
     train_scenarios: int = 4
     heldout_scenarios: int = 2
     scenario_seed: int = 0
@@ -268,6 +273,8 @@ class SimConfig:
                 seed=int(tu.get("seed", 0)),
                 elite_frac=float(tu.get("eliteFrac", 0.25)),
                 objective=tu.get("objective"),
+                constraints=tu.get("constraints"),
+                evaluator=str(tu.get("evaluator", "auto")),
                 train_scenarios=int(sc.get("train", 4)),
                 heldout_scenarios=int(sc.get("heldout", 2)),
                 scenario_seed=int(sc.get("seed", 0)),

@@ -12,7 +12,9 @@ import logging
 import os
 import sys
 import time
-from typing import IO, Iterable, Optional
+from typing import IO, Dict, Iterable, Optional
+
+import numpy as np
 
 
 def deterministic_jsonl() -> bool:
@@ -40,7 +42,13 @@ if not log.handlers:
 #   v3 — tuner rows (sim.tuner): "run_type" required, "ts" optional —
 #        trajectory files are bit-deterministic for a fixed seed + config,
 #        so no wall-clock fields. Non-tuner rows stay v2.
-SCHEMA_VERSION = 2
+#   v4 — utilization economics (round 13): replay rows may carry a
+#        "fragmentation" dict (stranded / frag_index / packing gauges);
+#        whatif-scenario rows may carry stranded_cpu / frag_index_cpu /
+#        packing_efficiency (None on paths without host mirrors). All new
+#        fields are virtual-time-deterministic — KSIM_DETERMINISTIC_JSONL
+#        needs no new scrubs.
+SCHEMA_VERSION = 4
 TUNE_SCHEMA_VERSION = 3
 
 
@@ -50,6 +58,157 @@ def config_hash(cfg_dict: dict) -> str:
     config that produced them."""
     blob = json.dumps(cfg_dict, sort_keys=True, separators=(",", ":"), default=str)
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+# -- utilization economics (round 13) ------------------------------------
+#
+# Every engine (CPU event engine, device boundary mirror, plain device
+# path after D2H) funnels its end-of-replay and per-sample utilization /
+# fragmentation arithmetic through the three helpers below. One shared
+# float64 code path is what makes the CPU↔device bit-parity bar hold BY
+# CONSTRUCTION: both engines hand over the same committed state, so the
+# gauges cannot drift through reimplementation.
+
+_UTIL_RESOURCES = ("cpu", "memory")
+
+
+def utilization_means(used, allocatable, rindex) -> Dict[str, float]:
+    """Mean per-node utilization fraction per resource name.
+
+    ``used``/``allocatable`` are [N, R]; ``rindex`` maps resource name →
+    column. Nodes with zero allocatable (drained / chaos node_down before
+    restore) count as 0 utilization, matching the historical inline loops
+    this replaces."""
+    used = np.asarray(used, dtype=np.float64)
+    alloc_all = np.asarray(allocatable, dtype=np.float64)
+    util: Dict[str, float] = {}
+    for rname in _UTIL_RESOURCES:
+        ri = rindex.get(rname)
+        if ri is not None:
+            alloc = alloc_all[:, ri]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                u = np.where(alloc > 0, used[:, ri] / np.where(alloc > 0, alloc, 1), 0)
+            util[rname] = float(u.mean())
+    return util
+
+
+def series_gauges(used, allocatable, rindex) -> Dict[str, float]:
+    """Per-sample utilization gauges for the telemetry series (round 13).
+
+    Keys: ``util_cpu`` (mean per-node CPU utilization), ``util_mem``
+    (only when the vocab has a memory column — series keys must stay
+    consistent within one run), and ``frag_cpu`` (CPU fragmentation
+    index: 1 − largest free block / total free; 0 when nothing is free).
+    Called at every event-loop sample on the CPU engine and at every
+    chunk boundary on the device path — same helper, bit-parity by
+    construction."""
+    means = utilization_means(used, allocatable, rindex)
+    out = {"util_cpu": means.get("cpu", 0.0)}
+    if "memory" in means:
+        out["util_mem"] = means["memory"]
+    ci = rindex.get("cpu")
+    frag = 0.0
+    if ci is not None:
+        alloc = np.asarray(allocatable, dtype=np.float64)[:, ci]
+        u = np.asarray(used, dtype=np.float64)[:, ci]
+        free = np.maximum(alloc - u, 0.0)
+        total_free = float(free.sum())
+        if total_free > 0.0:
+            frag = 1.0 - float(free.max()) / total_free
+    out["frag_cpu"] = frag
+    return out
+
+
+def fragmentation_gauges(allocatable, used, pending_requests, rindex) -> dict:
+    """End-of-replay fragmentation / packing gauges (round 13).
+
+    - ``stranded[r]``: free capacity on nodes that cannot fit the largest
+      still-pending pod (largest by CPU request, memory tie-break, lowest
+      pod index last) — the classic stranded-capacity gauge. 0 when no
+      pod is pending. The fit test is vector-wise over ALL resource
+      columns, so a node is only "usable" if the whole pod fits.
+    - ``frag_index[r]``: 1 − largest free block / total free (0 when the
+      cluster is fully packed or fully empty).
+    - ``packing_efficiency``: ideal node count (sum-of-usage lower bound,
+      per-resource ceiling against the largest node) / nodes actually
+      touched. 1.0 when nothing is placed.
+
+    Pure float64 numpy on host state — both engines call it with the
+    restored allocatable and their committed ``used``/pending sets, so
+    the outputs are bit-identical CPU ↔ device."""
+    alloc = np.asarray(allocatable, dtype=np.float64)
+    used = np.asarray(used, dtype=np.float64)
+    req = np.asarray(pending_requests, dtype=np.float64)
+    if req.ndim == 1:
+        req = req.reshape(0, alloc.shape[1]) if req.size == 0 else req.reshape(1, -1)
+    free = np.maximum(alloc - used, 0.0)
+    names = [r for r in _UTIL_RESOURCES if rindex.get(r) is not None]
+
+    stranded: Dict[str, float] = {r: 0.0 for r in names}
+    stranded_frac: Dict[str, float] = {r: 0.0 for r in names}
+    npend = int(req.shape[0])
+    if npend:
+        n = npend
+        ci, mi = rindex.get("cpu"), rindex.get("memory")
+        key_cpu = req[:, ci] if ci is not None else np.zeros(n)
+        key_mem = req[:, mi] if mi is not None else np.zeros(n)
+        # lexsort: last key is primary — biggest CPU, then biggest memory,
+        # then lowest index, so the "largest pending pod" is deterministic.
+        big = req[int(np.lexsort((np.arange(n), -key_mem, -key_cpu))[0])]
+        # The scheduler's own fit arithmetic decides "cannot fit" (local
+        # import: ops pulls the model stack, metrics must stay light).
+        from ..ops.cpu import pending_fit_mask
+
+        fits = pending_fit_mask(used, alloc, big)
+        for r in names:
+            ri = rindex[r]
+            stranded[r] = float(free[~fits, ri].sum())
+            total = float(alloc[:, ri].sum())
+            stranded_frac[r] = stranded[r] / total if total > 0 else 0.0
+
+    frag_index: Dict[str, float] = {}
+    for r in names:
+        ri = rindex[r]
+        total_free = float(free[:, ri].sum())
+        frag_index[r] = (
+            1.0 - float(free[:, ri].max()) / total_free if total_free > 0 else 0.0
+        )
+
+    nodes_active = int(np.any(used > 0, axis=1).sum())
+    nodes_ideal = 0
+    for r in names:
+        ri = rindex[r]
+        cap = float(alloc[:, ri].max()) if alloc.shape[0] else 0.0
+        total_used = float(used[:, ri].sum())
+        if cap > 0 and total_used > 0:
+            nodes_ideal = max(nodes_ideal, int(np.ceil(total_used / cap)))
+    packing = float(nodes_ideal) / nodes_active if nodes_active else 1.0
+    return {
+        "stranded": stranded,
+        "stranded_frac": stranded_frac,
+        "frag_index": frag_index,
+        "packing_efficiency": packing,
+        "nodes_active": nodes_active,
+        "nodes_ideal": nodes_ideal,
+        "pending": npend,
+    }
+
+
+def round_fragmentation(frag: Optional[dict]) -> Optional[dict]:
+    """JSONL/summary-friendly copy of a fragmentation_gauges() dict with
+    floats rounded to 6 places (virtual-time-deterministic, so no
+    KSIM_DETERMINISTIC_JSONL scrub is needed)."""
+    if frag is None:
+        return None
+    out: dict = {}
+    for k, v in frag.items():
+        if isinstance(v, dict):
+            out[k] = {kk: round(float(vv), 6) for kk, vv in v.items()}
+        elif isinstance(v, float):
+            out[k] = round(v, 6)
+        else:
+            out[k] = v
+    return out
 
 
 class JsonlWriter:
@@ -153,6 +312,7 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
     drop = getattr(res, "retry_dropped", None)
     evi = getattr(res, "evictions", None)
     lat50 = getattr(res, "latency_p50", None)
+    str_cpu = getattr(res, "stranded_cpu", None)
     for s in range(res.placed.shape[0]):
         row = {
             "kind": "whatif-scenario",
@@ -190,6 +350,14 @@ def whatif_rows(res, extra: Optional[dict] = None) -> Iterable[dict]:
             ):
                 v = float(arr[s])
                 row[key] = None if math.isnan(v) else round(v, 6)
+        if str_cpu is not None:
+            # Fragmentation economics (schema v4, kube what-if paths with
+            # host mirrors); virtual-time-deterministic by construction.
+            row["stranded_cpu"] = round(float(str_cpu[s]), 6)
+            row["frag_index_cpu"] = round(float(res.frag_index_cpu[s]), 6)
+            row["packing_efficiency"] = round(
+                float(res.packing_efficiency[s]), 6
+            )
         yield row
 
 

@@ -14,7 +14,12 @@ BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
   grew its share of the total by more than ``threshold`` absolute is
   flagged (informational — phases shift when features land);
 - DCN scaling (``detail.dcn_scaling.aggregate_pps`` and per-process
-  pps where both files carry them): same threshold as the headline.
+  pps where both files carry them): same threshold as the headline;
+- utilization economics (``detail.utilization``, round 13): a relative
+  drop in ``whatif_util_cpu_mean`` / ``cpu_baseline_util_cpu`` /
+  packing efficiency beyond the threshold is a REGRESSION; growth in
+  stranded capacity or the fragmentation index is informational (those
+  gauges move whenever the workload mix does).
 
 Accepts both the archived wrapper shape ``{"n", "cmd", "rc", "parsed"}``
 and a raw bench JSON line ``{"metric", "value", ...}``. Exits nonzero
@@ -81,6 +86,56 @@ def compare_pair(
                 f"phase share {k}: {sa.get(k, 0.0):.1%} -> "
                 f"{sb.get(k, 0.0):.1%} (grew {grow:+.1%})"
             )
+
+    ua, ub = da.get("utilization"), db.get("utilization")
+    if isinstance(ua, dict) and isinstance(ub, dict):
+        fa = ua.get("cpu_baseline_fragmentation") or {}
+        fb = ub.get("cpu_baseline_fragmentation") or {}
+        gauges = {
+            "util whatif_util_cpu_mean": (
+                ua.get("whatif_util_cpu_mean"), ub.get("whatif_util_cpu_mean")
+            ),
+            "util cpu_baseline_util_cpu": (
+                ua.get("cpu_baseline_util_cpu"),
+                ub.get("cpu_baseline_util_cpu"),
+            ),
+            "util packing_efficiency": (
+                fa.get("packing_efficiency"), fb.get("packing_efficiency")
+            ),
+        }
+        for label, (ga, gb) in gauges.items():
+            if (
+                isinstance(ga, (int, float))
+                and isinstance(gb, (int, float))
+                and ga > 0
+            ):
+                delta = (gb - ga) / ga
+                line = f"{label}: {ga:.4f} -> {gb:.4f} ({delta:+.1%})"
+                if gb < ga * (1.0 - threshold):
+                    regressions.append(line + "  REGRESSION")
+                else:
+                    notes.append(line)
+        for label, ga, gb in (
+            (
+                "util stranded_frac(cpu)",
+                (fa.get("stranded_frac") or {}).get("cpu"),
+                (fb.get("stranded_frac") or {}).get("cpu"),
+            ),
+            (
+                "util frag_index(cpu)",
+                (fa.get("frag_index") or {}).get("cpu"),
+                (fb.get("frag_index") or {}).get("cpu"),
+            ),
+        ):
+            if (
+                isinstance(ga, (int, float))
+                and isinstance(gb, (int, float))
+                and gb - ga > threshold
+            ):
+                notes.append(
+                    f"{label}: {ga:.4f} -> {gb:.4f} "
+                    f"(grew {gb - ga:+.4f} absolute)"
+                )
 
     dsa, dsb = da.get("dcn_scaling"), db.get("dcn_scaling")
     if isinstance(dsa, dict) and isinstance(dsb, dict):

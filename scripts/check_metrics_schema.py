@@ -82,6 +82,17 @@ _OPTIONAL = {
 
 _TEL_GRANULARITIES = ("summary", "series", "timeline")
 
+# v4 (utilization economics, round 13): v2 rules plus optional typed
+# fragmentation fields — replay rows may carry a "fragmentation" gauge
+# dict; whatif-scenario rows may carry per-scenario stranded/frag-index/
+# packing gauges. v1–v3 rows validate byte-unchanged.
+_OPTIONAL_V4 = {
+    "fragmentation": dict,
+    "stranded_cpu": (*_NUM, type(None)),
+    "frag_index_cpu": (*_NUM, type(None)),
+    "packing_efficiency": (*_NUM, type(None)),
+}
+
 # v3 (policy tuner, sim.tuner): "run_type" is required and "ts" becomes
 # OPTIONAL — trajectory rows are bit-deterministic for a fixed seed +
 # config, so the writer omits the wall-clock stamp (JsonlWriter
@@ -181,6 +192,21 @@ def _check_telemetry(tel: dict) -> List[str]:
     return errs
 
 
+def _check_fragmentation(frag: dict) -> List[str]:
+    errs = []
+    for k in ("stranded", "stranded_frac", "frag_index"):
+        if not isinstance(frag.get(k), dict):
+            errs.append(f"fragmentation.{k}: expected an object")
+    for k in ("packing_efficiency",):
+        if not isinstance(frag.get(k), _NUM):
+            errs.append(f"fragmentation.{k}: expected a number")
+    for k in ("nodes_active", "nodes_ideal", "pending"):
+        v = frag.get(k)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"fragmentation.{k}: expected an int")
+    return errs
+
+
 def validate_row(row: dict) -> List[str]:
     """Errors for one parsed row ([] = valid)."""
     errs = []
@@ -191,7 +217,14 @@ def validate_row(row: dict) -> List[str]:
         return [] if isinstance(row.get("ts"), _NUM) else ["ts: missing"]
     if schema == 3:
         return _validate_v3(row)
-    if schema != 2:
+    if schema == 4:
+        for k, t in _OPTIONAL_V4.items():
+            if k in row and not isinstance(row[k], t):
+                errs.append(f"{k}: expected {t}, got {row[k]!r}")
+        if isinstance(row.get("fragmentation"), dict):
+            errs.extend(_check_fragmentation(row["fragmentation"]))
+        # Fall through: everything else follows the v2 rules.
+    elif schema != 2:
         return [f"schema: unknown version {schema!r}"]
     for k, t in _BASE_V2.items():
         v = row.get(k)
@@ -253,7 +286,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for e in all_errs:
         print(e)
     if not all_errs:
-        print(f"ok: {len(argv)} file(s) validate against schema v2/v3")
+        print(f"ok: {len(argv)} file(s) validate against schema v2/v3/v4")
     return 1 if all_errs else 0
 
 

@@ -131,6 +131,10 @@ class FleetWatch:
             )
             if "live_buffers" in b:
                 seg += f" live={b['live_buffers']}"
+            if "util_cpu" in b:
+                # Fleet utilization gauge (round 13): the end-of-replay
+                # gather beacon carries the mean scenario CPU utilization.
+                seg += f" util={float(b['util_cpu']):.1%}"
             if straggler:
                 seg += " [STRAGGLER]"
             segs.append(seg)

@@ -317,12 +317,164 @@ def test_schema_v3_checker_rejects_malformed():
 
 
 def test_cmd_tune_validates_objective_terms(tmp_path):
+    """Round 13: host-mirror terms (latency quantiles, fragmentation)
+    are only a config error under an EXPLICIT device evaluator — auto
+    routes them to the CPU event engine instead."""
     from kubernetes_simulator_tpu.cli import main as cli_main
 
     cfg = tmp_path / "bad.yaml"
     cfg.write_text(
         "cluster:\n  synthetic: {nodes: 4, seed: 0}\n"
         "workload:\n  synthetic: {pods: 16, seed: 0}\n"
-        "tune:\n  objective: {latencyP99: -1.0}\n"
+        "tune:\n"
+        "  evaluator: device\n"
+        "  objective: {latencyP99: -1.0}\n"
     )
     assert cli_main(["tune", str(cfg)]) == 2
+    bad_cons = tmp_path / "bad_cons.yaml"
+    bad_cons.write_text(
+        "cluster:\n  synthetic: {nodes: 4, seed: 0}\n"
+        "workload:\n  synthetic: {pods: 16, seed: 0}\n"
+        "tune:\n"
+        "  objective: {placementRate: 1.0}\n"
+        "  constraints: [{metric: latencyP99}]\n"  # no bound
+    )
+    assert cli_main(["tune", str(bad_cons)]) == 2
+    bad_eval = tmp_path / "bad_eval.yaml"
+    bad_eval.write_text(
+        "cluster:\n  synthetic: {nodes: 4, seed: 0}\n"
+        "workload:\n  synthetic: {pods: 16, seed: 0}\n"
+        "tune:\n"
+        "  evaluator: gpu\n"
+        "  objective: {placementRate: 1.0}\n"
+    )
+    assert cli_main(["tune", str(bad_eval)]) == 2
+
+
+# -- constraint-aware objectives (round 13) --------------------------------
+
+
+def test_constraint_validation():
+    with pytest.raises(ValueError, match="exactly one of"):
+        make_objective({"placementRate": 1.0},
+                       [{"metric": "latencyP99"}])
+    with pytest.raises(ValueError, match="exactly one of"):
+        make_objective({"placementRate": 1.0},
+                       [{"metric": "latencyP99", "max": 1.0, "min": 0.0}])
+    with pytest.raises(ValueError, match="unknown metric"):
+        make_objective({"placementRate": 1.0},
+                       [{"metric": "nope", "max": 1.0}])
+    with pytest.raises(ValueError, match="penalty"):
+        make_objective({"placementRate": 1.0},
+                       [{"metric": "latencyP99", "max": 1.0, "penalty": 0}])
+    with pytest.raises(ValueError, match="unknown key"):
+        make_objective({"placementRate": 1.0},
+                       [{"metric": "latencyP99", "max": 1.0, "bogus": 2}])
+
+
+def test_constraint_penalty_hinge():
+    """max bounds penalize overshoot, min bounds penalize undershoot,
+    NaN metric values (a scenario that bound nothing) violate nothing."""
+    from types import SimpleNamespace
+
+    _, _, fn = make_objective(
+        {"utilizationCpu": 1.0},
+        [{"metric": "latencyP99", "max": 2.0, "penalty": 10.0}],
+    )
+    res = SimpleNamespace(
+        utilization_cpu=np.array([0.5, 0.5, 0.5]),
+        latency_p99=np.array([1.0, 4.0, np.nan]),
+    )
+    np.testing.assert_allclose(fn(res), [0.5, 0.5 - 20.0, 0.5])
+    _, _, fn = make_objective(
+        {"utilizationCpu": 1.0},
+        [{"metric": "packingEfficiency", "min": 0.9, "penalty": 1.0}],
+    )
+    res = SimpleNamespace(
+        utilization_cpu=np.array([0.5, 0.5]),
+        packing_efficiency=np.array([1.0, 0.4]),
+    )
+    np.testing.assert_allclose(fn(res), [0.5, 0.5 - 0.5])
+
+
+def test_evaluator_resolution():
+    ec, ep = _fragmentation_case()
+    t = PolicyTuner(ec, ep, FrameworkConfig(), population=2, rounds=1,
+                    objective={"placementRate": 1.0})
+    assert t.evaluator == "device"  # auto keeps the batched sweep
+    t = PolicyTuner(ec, ep, FrameworkConfig(), population=2, rounds=1,
+                    objective={"utilizationCpu": 1.0},
+                    constraints=[{"metric": "latencyP99", "max": 1.0}])
+    assert t.evaluator == "cpu"  # auto routes host-mirror terms
+    with pytest.raises(ValueError, match="evaluator='cpu'"):
+        PolicyTuner(ec, ep, FrameworkConfig(), population=2, rounds=1,
+                    objective={"latencyP99": -1.0}, evaluator="device")
+    with pytest.raises(ValueError, match="evaluator must be"):
+        PolicyTuner(ec, ep, FrameworkConfig(), population=2, rounds=1,
+                    evaluator="gpu")
+
+
+def _latency_fragmentation_case():
+    """The fragmentation family with durations (round 13): 8 one-cpu
+    smalls (duration 20) then two 4-cpu larges (infinite). EVERY policy
+    eventually places everything, so the end-of-replay CPU utilization
+    ties at 0.5 across the whole search space — but LeastAllocated
+    spreads the smalls two per node, stranding the larges until the
+    smalls drain (first-bind latency 16 virtual seconds), while
+    MostAllocated packs two nodes and binds the larges on arrival."""
+    nodes = [Node(f"n{i}", capacity={"cpu": 4.0, "memory": 16.0})
+             for i in range(4)]
+    pods = [
+        Pod(f"small-{i}", requests={"cpu": 1.0, "memory": 1.0},
+            arrival_time=float(i), duration=20.0)
+        for i in range(8)
+    ] + [
+        Pod(f"large-{i}", requests={"cpu": 4.0, "memory": 4.0},
+            arrival_time=float(8 + i))
+        for i in range(2)
+    ]
+    return encode(Cluster(nodes=nodes), pods)
+
+
+def test_latency_constraint_changes_winner():
+    """Acceptance pin (round 13): on the latency-fragmentation family
+    the unconstrained utilization objective ties everywhere (elitism
+    keeps the default LeastAllocated incumbent), while the latency-
+    constrained run must discover MostAllocated — a DIFFERENT winner."""
+    ec, ep = _latency_fragmentation_case()
+    kw = dict(
+        algo="cem", population=8, rounds=3, seed=0,
+        train_scenarios=2, heldout_scenarios=1, scenario_seed=1,
+        p_node_down=0.0, p_capacity=0.0, p_taint=0.0,  # clean family
+        evaluator="cpu",
+    )
+    unconstrained = PolicyTuner(
+        ec, ep, FrameworkConfig(),
+        objective={"utilizationCpu": 1.0}, **kw,
+    ).run()
+    constrained = PolicyTuner(
+        ec, ep, FrameworkConfig(),
+        objective={"utilizationCpu": 1.0},
+        constraints=[{"metric": "latencyP99", "max": 1.0, "penalty": 1.0}],
+        **kw,
+    ).run()
+    # Ties keep the incumbent: strict > never replaces the default.
+    assert unconstrained.best_policy["fitStrategy"] == "LeastAllocated"
+    assert constrained.best_policy["fitStrategy"] == "MostAllocated"
+    assert (
+        constrained.best_policy["fitStrategy"]
+        != unconstrained.best_policy["fitStrategy"]
+    )
+    assert constrained.evaluator == "cpu"
+    assert constrained.heldout_objective > constrained.default_heldout_objective
+    assert constrained.improved()
+    # Host evaluation: no device executable, no CPU-oracle re-run.
+    assert constrained.compile_count is None
+    assert constrained.cpu_objective is None
+    # The tune-result row carries the constraint/evaluator provenance.
+    final = constrained.trajectory[-1]
+    assert final["kind"] == "tune-result"
+    assert final["evaluator"] == "cpu"
+    assert final["objective_constraints"] == [
+        {"metric": "latencyP99", "penalty": 1.0, "max": 1.0}
+    ]

@@ -1,0 +1,480 @@
+"""Utilization economics (round 13): per-node utilization series,
+fragmentation / stranded-capacity gauges, and their CPU↔device bit-parity.
+
+Both engines funnel every gauge through the SAME float64 numpy helpers
+(utils.metrics.utilization_means / series_gauges / fragmentation_gauges),
+so wherever the two engines sample identical committed state the values
+are bit-identical — the same oracle discipline as the round-7 latency
+histograms. Parity envelopes exercised here:
+
+* end-of-replay gauges — bit-identical whenever every release lands
+  inside the replayed horizon (the CPU engine drains trailing
+  completions past the last arrival; the device applies releases only
+  at chunk boundaries), so traces here either finish their releases
+  before the last arrival or run infinite durations;
+* series samples — the device samples at chunk boundaries (pre-dispatch,
+  post-release: exactly the CPU engine's post-events/pre-schedule
+  instant), so samples at COMMON virtual times must bit-match.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+from kubernetes_simulator_tpu.sim.runtime import CpuReplayEngine
+from kubernetes_simulator_tpu.sim.synthetic import make_chaos_timeline
+from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+from kubernetes_simulator_tpu.utils.metrics import (
+    fragmentation_gauges,
+    round_fragmentation,
+    series_gauges,
+    utilization_means,
+)
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+sys.path.insert(0, os.path.abspath(_SCRIPTS))
+
+from check_metrics_schema import validate_file, validate_row  # noqa: E402
+
+FIT_ONLY = lambda: FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+
+
+def _series_at(tel):
+    """{t: (util_cpu, frag_cpu)} for common-instant comparisons."""
+    s = tel.series
+    return {
+        t: (u, f)
+        for t, u, f in zip(s["t"], s["util_cpu"], s["frag_cpu"])
+    }
+
+
+def _assert_common_instants_match(cpu_tel, dev_tel, min_common=3):
+    ca, da = _series_at(cpu_tel), _series_at(dev_tel)
+    common = sorted(set(ca) & set(da))
+    assert len(common) >= min_common
+    for t in common:
+        assert ca[t] == da[t], f"t={t}: cpu {ca[t]} != dev {da[t]}"
+    return common
+
+
+# -- gauge helpers (exact, hand-computed) ----------------------------------
+
+
+def test_utilization_means_exact():
+    used = np.array([[2.0, 4.0], [0.0, 0.0]])
+    alloc = np.array([[4.0, 8.0], [4.0, 8.0]])
+    u = utilization_means(used, alloc, {"cpu": 0, "memory": 1})
+    assert u == {"cpu": 0.25, "memory": 0.25}
+    # Zero-allocatable nodes (chaos node_down) contribute 0, not NaN.
+    u = utilization_means(used, np.zeros_like(alloc), {"cpu": 0, "memory": 1})
+    assert u == {"cpu": 0.0, "memory": 0.0}
+
+
+def test_series_gauges_exact():
+    used = np.array([[3.0, 1.0], [1.0, 1.0]])
+    alloc = np.array([[4.0, 8.0], [4.0, 8.0]])
+    g = series_gauges(used, alloc, {"cpu": 0, "memory": 1})
+    assert g["util_cpu"] == 0.5
+    assert g["util_mem"] == 0.125
+    # free cpu: [1, 3] → frag = 1 - 3/4.
+    assert g["frag_cpu"] == 0.25
+    # Memory absent from the vocabulary → no util_mem key.
+    g = series_gauges(used[:, :1], alloc[:, :1], {"cpu": 0})
+    assert set(g) == {"util_cpu", "frag_cpu"}
+
+
+def test_fragmentation_gauges_exact():
+    alloc = np.array([[4.0], [4.0], [4.0]])
+    used = np.array([[2.0], [2.0], [0.0]])
+    pend = np.array([[3.0], [1.0]])  # largest pending wants 3 cpu
+    fr = fragmentation_gauges(alloc, used, pend, {"cpu": 0})
+    # Only n2 (4 free) fits the 3-cpu pod; n0/n1 strand 2 cpu each.
+    assert fr["stranded"] == {"cpu": 4.0}
+    assert fr["stranded_frac"] == {"cpu": 4.0 / 12.0}
+    # free [2, 2, 4]: frag index = 1 - 4/8.
+    assert fr["frag_index"] == {"cpu": 0.5}
+    assert fr["pending"] == 2
+    assert fr["nodes_active"] == 2
+    assert fr["nodes_ideal"] == 1  # ceil(4 used / 4 cap)
+    assert fr["packing_efficiency"] == 0.5
+    # No pending pods → nothing stranded, packing still reported.
+    fr = fragmentation_gauges(alloc, used, pend[:0], {"cpu": 0})
+    assert fr["stranded"] == {"cpu": 0.0} and fr["pending"] == 0
+    rounded = round_fragmentation(fr)
+    assert rounded["stranded_frac"]["cpu"] == 0.0
+    assert round_fragmentation(None) is None
+
+
+def test_pending_fit_mask_eps():
+    """The stranded fit test reuses the scheduler's own epsilon."""
+    from kubernetes_simulator_tpu.ops.cpu import pending_fit_mask
+
+    used = np.array([[3.0], [4.0]])
+    alloc = np.array([[4.0], [4.0]])
+    m = pending_fit_mask(used, alloc, np.array([1.0]))
+    np.testing.assert_array_equal(m, [True, False])
+    # Float dust within the scheduler's 1e-6 epsilon still fits.
+    m = pending_fit_mask(used + 5e-7, alloc, np.array([1.0]))
+    np.testing.assert_array_equal(m, [True, False])
+
+
+# -- CPU engine ------------------------------------------------------------
+
+
+def test_cpu_replay_carries_fragmentation():
+    nodes = [Node(f"n{i}", {"cpu": 2.0}) for i in range(2)]
+    pods = [
+        Pod("p0", requests={"cpu": 2.0}, arrival_time=0.0),
+        Pod("p1", requests={"cpu": 1.0}, arrival_time=1.0),
+        # 2-cpu pod that can never fit once p0/p1 are down: 1 cpu free
+        # on n1 is stranded for it.
+        Pod("p2", requests={"cpu": 2.0}, arrival_time=2.0),
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    res = CpuReplayEngine(ec, ep, FIT_ONLY()).replay()
+    fr = res.fragmentation
+    assert fr is not None and fr["pending"] == 1
+    assert fr["stranded"]["cpu"] == 1.0  # n1's free cpu can't host p2
+    assert res.summary()["fragmentation"] == round_fragmentation(fr)
+    # Series granularity samples utilization at every event instant.
+    tel = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="series").replay(
+    ).telemetry
+    assert {"t", "util_cpu", "frag_cpu"} <= set(tel.series)
+    assert len(tel.series["util_cpu"]) == len(tel.series["t"])
+
+
+# -- plain device path -----------------------------------------------------
+
+
+def _release_trace(num_nodes=3, num_pods=12, duration=5.0):
+    """Arrivals 1 s apart; every release lands before the last arrival,
+    so both engines reach the identical end state."""
+    nodes = [Node(f"n{i}", {"cpu": 8.0}) for i in range(num_nodes)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=duration)
+        for i in range(num_pods)
+    ]
+    return encode(Cluster(nodes=nodes), pods)
+
+
+def test_plain_series_utilization_bit_parity():
+    """Common-instant series parity on the plain path: the device samples
+    at every chunk boundary (post-release, pre-dispatch) — exactly the
+    CPU engine's post-events/pre-schedule sample of the same instant."""
+    ec, ep = _release_trace()
+    cpu = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="series").replay()
+    dev = JaxReplayEngine(
+        ec, ep, FIT_ONLY(), wave_width=1, chunk_waves=1, telemetry="series"
+    ).replay()
+    np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+    common = _assert_common_instants_match(
+        cpu.telemetry, dev.telemetry, min_common=8
+    )
+    # Non-vacuous: utilization moved over the compared window.
+    utils = [_series_at(cpu.telemetry)[t][0] for t in common]
+    assert max(utils) > 0.0
+
+
+def test_plain_end_gauges_bit_parity_infinite_durations():
+    """No completions → both engines end on the identical committed
+    state; utilization AND fragmentation dicts are bit-equal."""
+    nodes = [Node(f"n{i}", {"cpu": 4.0}) for i in range(3)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 3.0}, arrival_time=float(i))
+        for i in range(3)
+    ] + [
+        # Can never fit next to a 3-cpu tenant: strands 1 cpu per node.
+        Pod("big", requests={"cpu": 2.0}, arrival_time=3.0),
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    for gran in ("summary", "series"):
+        cpu = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry=gran).replay()
+        dev = JaxReplayEngine(
+            ec, ep, FIT_ONLY(), wave_width=1, chunk_waves=1, telemetry=gran
+        ).replay()
+        np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+        assert cpu.utilization == dev.utilization, gran
+        assert cpu.fragmentation == dev.fragmentation, gran
+    assert cpu.fragmentation["stranded"]["cpu"] == 3.0
+    assert cpu.fragmentation["pending"] == 1
+
+
+def test_off_and_summary_keep_gauges_and_program():
+    """The gauges are end-of-replay host arithmetic: granularity off /
+    summary must produce the same fragmentation as series (no sampling
+    side-effects), and off still reports them (telemetry-independent)."""
+    ec, ep = _release_trace(num_pods=8)
+    frags = {}
+    for gran in ("off", "summary", "series"):
+        res = JaxReplayEngine(
+            ec, ep, FIT_ONLY(), wave_width=1, chunk_waves=2, telemetry=gran
+        ).replay()
+        frags[gran] = res.fragmentation
+        assert res.fragmentation is not None
+    assert frags["off"] == frags["summary"] == frags["series"]
+
+
+# -- boundary (retry) path -------------------------------------------------
+
+
+def test_boundary_series_and_end_gauges_match_cpu():
+    """Retry-path twin of the latency coincidence trace: a failed pod
+    retries at the next boundary; utilization samples at common instants
+    and the end gauges bit-match the event engine. The trailing zero-cpu
+    arrival puts the last release inside the horizon for BOTH engines."""
+    nodes = [Node("n0", {"cpu": 1.0})]
+    pods = [
+        Pod("p0", requests={"cpu": 1.0}, arrival_time=0.0, duration=1.5),
+        Pod("p1", requests={"cpu": 1.0}, arrival_time=1.0, duration=2.0),
+        Pod("p2", requests={"cpu": 0.0}, arrival_time=2.0),
+        Pod("p3", requests={"cpu": 0.0}, arrival_time=5.0),
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FIT_ONLY()
+    cpu = CpuReplayEngine(ec, ep, cfg, telemetry="series").replay()
+    dev = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, retry_buffer=8,
+        telemetry="series",
+    ).replay()
+    np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+    assert {"retry_depth", "pend_depth", "util_cpu", "frag_cpu"} <= set(
+        dev.telemetry.series
+    )
+    assert cpu.utilization == dev.utilization
+    assert cpu.fragmentation == dev.fragmentation
+    # The boundary sample is POST-retry-bind (like retry_depth); at t=5
+    # nothing is in flight on either engine, so the instants agree.
+    ca, da = _series_at(cpu.telemetry), _series_at(dev.telemetry)
+    assert ca[5.0] == da[5.0] == (0.0, 0.0)
+
+
+def test_chaos_eviction_utilization_parity():
+    """Chaos eviction case (kube preemption, mttr=0 timelines): evicted
+    pods rebind through the boundary retry queue; end-of-replay
+    utilization + fragmentation stay bit-identical to the CPU oracle."""
+    nodes = [Node(f"n{i}", {"cpu": 8.0}) for i in range(6)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i))
+        for i in range(28)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FIT_ONLY()
+    evs = make_chaos_timeline(
+        ec.num_nodes, seed=2, horizon=float(ep.arrival.max()),
+        mtbf=12.0, mttr=0.0, node_fraction=0.34,
+    )
+    cpu = CpuReplayEngine(ec, ep, cfg, telemetry="series").replay(
+        node_events=evs
+    )
+    dev = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+        retry_buffer=64, telemetry="series",
+    ).replay(node_events=evs)
+    assert dev.evictions > 0  # non-vacuous
+    np.testing.assert_array_equal(cpu.assignments, dev.assignments)
+    assert cpu.utilization == dev.utilization
+    assert cpu.fragmentation == dev.fragmentation
+
+
+@pytest.mark.fuzz_quick
+def test_seeded_fuzz_utilization_parity():
+    """Seeded slice: infinite-duration traces across capacities — end
+    gauges bit-match on plain AND boundary paths; series samples match
+    at every common instant."""
+    for seed in (1, 2, 3):
+        rng = np.random.default_rng(seed)
+        nodes = [
+            Node(f"n{i}", {"cpu": float(rng.integers(2, 9))})
+            for i in range(5)
+        ]
+        pods = [
+            Pod(f"p{i}", requests={"cpu": float(rng.integers(1, 4))},
+                arrival_time=float(i))
+            for i in range(24)
+        ]
+        ec, ep = encode(Cluster(nodes=nodes), pods)
+        cfg = FIT_ONLY()
+        cpu = CpuReplayEngine(ec, ep, cfg, telemetry="series").replay()
+        for kw in (
+            dict(wave_width=1, chunk_waves=1),
+            dict(wave_width=1, chunk_waves=1, retry_buffer=16),
+        ):
+            dev = JaxReplayEngine(
+                ec, ep, cfg, telemetry="series", **kw
+            ).replay()
+            np.testing.assert_array_equal(
+                cpu.assignments, dev.assignments
+            )
+            assert cpu.utilization == dev.utilization, (seed, kw)
+            assert cpu.fragmentation == dev.fragmentation, (seed, kw)
+
+
+# -- what-if kube batches --------------------------------------------------
+
+
+def test_whatif_scenario_fragmentation_bit_matches_single_replay():
+    ec, ep = _release_trace(num_nodes=4, num_pods=16)
+    cfg = FIT_ONLY()
+    evs = make_chaos_timeline(
+        ec.num_nodes, seed=7, horizon=float(ep.arrival.max()),
+        mtbf=10.0, mttr=0.0, node_fraction=0.5,
+    )
+    single = JaxReplayEngine(
+        ec, ep, cfg, wave_width=1, chunk_waves=1, preemption="kube",
+        retry_buffer=64,
+    ).replay()
+    res = WhatIfEngine(
+        ec, ep, [Scenario(), Scenario(events=evs)], cfg, wave_width=1,
+        chunk_waves=1, preemption="kube", retry_buffer=64,
+    ).run()
+    assert res.stranded_cpu.shape == (2,)
+    fr = single.fragmentation
+    assert float(res.stranded_cpu[0]) == fr["stranded"]["cpu"]
+    assert float(res.frag_index_cpu[0]) == fr["frag_index"]["cpu"]
+    assert float(res.packing_efficiency[0]) == fr["packing_efficiency"]
+    # Plain batches have no kube host mirrors → gauges absent, like the
+    # latency quantiles.
+    plain = WhatIfEngine(
+        ec, ep, [Scenario()], cfg, chunk_waves=4, granularity_guard=False
+    ).run()
+    assert plain.stranded_cpu is None
+
+
+# -- JSONL schema v4 + determinism ----------------------------------------
+
+
+def test_replay_row_schema_v4(tmp_path):
+    from kubernetes_simulator_tpu.utils.metrics import JsonlWriter, replay_row
+
+    ec, ep = _release_trace(num_pods=8)
+    res = CpuReplayEngine(ec, ep, FIT_ONLY()).replay()
+    out = tmp_path / "r.jsonl"
+    ctx = {"seed": 0, "engine": "cpu", "config_hash": "deadbeef"}
+    with JsonlWriter(str(out), context=ctx) as w:
+        w.write(replay_row("replay-cpu", res))
+    assert validate_file(str(out)) == []
+    row = json.loads(out.read_text())
+    assert row["schema"] == 4
+    assert set(row["fragmentation"]) == {
+        "stranded", "stranded_frac", "frag_index", "packing_efficiency",
+        "nodes_active", "nodes_ideal", "pending",
+    }
+    # The checker rejects a malformed fragmentation payload.
+    bad = dict(row)
+    bad["fragmentation"] = {"stranded": 3}
+    assert any("fragmentation" in e for e in validate_row(bad))
+    # v2 rows (pre round 13) keep validating byte-unchanged.
+    v2 = dict(row)
+    v2["schema"] = 2
+    v2.pop("fragmentation")
+    assert validate_row(v2) == []
+
+
+def test_deterministic_jsonl_covers_fragmentation(tmp_path, monkeypatch):
+    """KSIM_DETERMINISTIC_JSONL byte-parity covers the new fields: the
+    gauges are virtual-time arithmetic, so two same-seed runs emit
+    byte-identical rows with no new scrubs."""
+    from kubernetes_simulator_tpu.utils.metrics import JsonlWriter, replay_row
+
+    monkeypatch.setenv("KSIM_DETERMINISTIC_JSONL", "1")
+    lines = []
+    for name in ("a", "b"):
+        ec, ep = _release_trace()
+        res = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="off").replay()
+        out = tmp_path / f"{name}.jsonl"
+        ctx = {"seed": 0, "engine": "cpu", "config_hash": "deadbeef"}
+        with JsonlWriter(str(out), context=ctx) as w:
+            w.write(replay_row("replay-cpu", res))
+        lines.append(out.read_bytes())
+    assert lines[0] == lines[1]
+    assert b"fragmentation" in lines[0]
+
+
+# -- telemetry merge + chrome-trace counter tracks -------------------------
+
+
+def test_merge_extends_to_utilization_series():
+    from kubernetes_simulator_tpu.sim.telemetry import ReplayTelemetry
+
+    a = ReplayTelemetry(
+        granularity="series",
+        series={"t": [0.0, 1.0], "util_cpu": [0.1, 0.2],
+                "frag_cpu": [0.5, 0.4]},
+    )
+    b = ReplayTelemetry(
+        granularity="series",
+        series={"t": [0.0, 2.0], "util_cpu": [0.3, 0.4],
+                "frag_cpu": [0.2, 0.1]},
+    )
+    m = ReplayTelemetry.merge([a, b], process_ids=[0, 1])
+    assert m.series["util_cpu"] == [0.1, 0.2, 0.3, 0.4]
+    assert m.series["frag_cpu"] == [0.5, 0.4, 0.2, 0.1]
+
+
+def test_chrome_trace_counter_tracks(tmp_path):
+    from kubernetes_simulator_tpu.sim.telemetry import (
+        write_chrome_trace,
+        write_chrome_trace_merged,
+    )
+
+    ec, ep = _release_trace(num_nodes=3, num_pods=9)
+    res = CpuReplayEngine(ec, ep, FIT_ONLY(), telemetry="timeline").replay()
+    path = str(tmp_path / "trace.json")
+    write_chrome_trace(
+        path, res, arrival=ep.arrival, duration=ep.duration,
+        requests=ep.requests, rindex=ec.vocab._r,
+    )
+    with open(path) as f:
+        ev = json.load(f)["traceEvents"]
+    counters = [e for e in ev if e["ph"] == "C"]
+    assert counters
+    # Counter change-points reconstruct per-node usage from the pod
+    # spans: values never exceed the node's capacity, and each track
+    # drains to zero (every pod in this trace completes).
+    by_node = {}
+    for e in counters:
+        assert 0.0 <= e["args"]["cpu"] <= 8.0
+        by_node.setdefault(e["tid"], []).append((e["ts"], e["args"]["cpu"]))
+    assert set(by_node) == {int(n) for n in res.assignments if n >= 0}
+    for n, pts in by_node.items():
+        assert max(v for _, v in pts) > 0.0
+        assert sorted(pts)[-1][1] == 0.0
+    # Without requests the export is byte-compatible with round 12 (no
+    # counter events).
+    write_chrome_trace(path, res, arrival=ep.arrival, duration=ep.duration)
+    with open(path) as f:
+        assert not [
+            e for e in json.load(f)["traceEvents"] if e["ph"] == "C"
+        ]
+    # Merged fleet export: optional 4-tuples add per-process tracks.
+    merged = str(tmp_path / "merged.json")
+    write_chrome_trace_merged(
+        merged,
+        [(res, ep.arrival, ep.duration, ep.requests),
+         (res, ep.arrival, ep.duration)],
+        rindex=ec.vocab._r,
+    )
+    with open(merged) as f:
+        ev = json.load(f)["traceEvents"]
+    pids = {e["pid"] for e in ev if e["ph"] == "C"}
+    assert pids == {0}  # only process 0 shipped requests
+
+
+def test_fleet_watch_shows_utilization_gauge():
+    sys.path.insert(0, os.path.abspath(_SCRIPTS))
+    from dcn_launch import FleetWatch
+
+    w = FleetWatch(hb_dir="/nonexistent", nproc=1)
+    import time as _time
+
+    line = w.line({0: {"state": "gather", "chunk": 4, "total_chunks": 4,
+                       "t": _time.time(), "util_cpu": 0.4321}})
+    assert "util=43.2%" in line
