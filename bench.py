@@ -298,6 +298,65 @@ def main():
             }
         }
 
+    # Borg-scale single scenario (round 14): ONE scenario whose node and
+    # pod axes dwarf the headline shape (default 10k nodes × 100k pods on
+    # accelerators; CPU meshes downscale so CI stays in budget), run
+    # node-sharded over every local device with paged pod waves — the
+    # configuration the replicated path cannot hold at Borg scale at all.
+    # BENCH_BORG=0 disables; BENCH_BORG_NODES / BENCH_BORG_PODS resize.
+    borg_block = {}
+    if int(os.environ.get("BENCH_BORG", 1)) and nproc == 1 and ndev > 1:
+        from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+
+        on_cpu = jax.devices()[0].platform == "cpu"
+        borg_nodes = int(
+            os.environ.get("BENCH_BORG_NODES", 1000 if on_cpu else 10_000)
+        )
+        borg_pods = int(
+            os.environ.get("BENCH_BORG_PODS", 20_000 if on_cpu else 100_000)
+        )
+        borg_cluster = make_cluster(borg_nodes, seed=0, taint_fraction=0.1)
+        borg_pods_l, _ = make_workload(
+            borg_pods, seed=0, with_affinity=True, with_spread=True,
+            with_tolerations=True, gang_fraction=0.02, gang_size=4,
+            duration_mean=dur_mean or None,
+        )
+        ec_b, ep_b = encode(borg_cluster, borg_pods_l)
+        # Document the refusal the sharded mode exists to dodge: at the
+        # flagship accelerator shape the REPLICATED planes bust a single
+        # chip's HBM — probed via the residency estimate, not an OOM.
+        from kubernetes_simulator_tpu.sim.jax_runtime import (
+            replicated_resident_bytes,
+        )
+        replicated_bytes = replicated_resident_bytes(ec_b, ep_b)
+        eng_b = JaxReplayEngine(
+            ec_b, ep_b, cfg, chunk_waves=512, node_shards=ndev, paged=True,
+        )
+        eng_b.replay()  # warmup: compile + first execution
+        runs_b = [
+            eng_b.replay()
+            for _ in range(max(1, int(os.environ.get("BENCH_REF_RUNS", 2))))
+        ]
+        walls_b = sorted(r.wall_clock_s for r in runs_b)
+        med_b = float(np.median(walls_b))
+        res_b = runs_b[0]
+        borg_block = {
+            "borg_scale": {
+                "nodes": borg_nodes,
+                "pods": borg_pods,
+                "node_shards": ndev,
+                "paged": True,
+                "pps": round(
+                    res_b.placed / med_b if med_b > 0 else 0.0, 1
+                ),
+                "wall_median_s": round(med_b, 3),
+                "placed": int(res_b.placed),
+                "replicated_resident_mib": round(
+                    replicated_bytes / 2**20, 1
+                ),
+            }
+        }
+
     line = json.dumps(
             {
                 "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, %s, %d device%s)"
@@ -363,6 +422,7 @@ def main():
                     **scaling,
                     **cont,
                     **tune_sweep,
+                    **borg_block,
                 },
             }
         )

@@ -96,7 +96,9 @@ def cmd_run(args) -> int:
     if cfg.strategy == "jax":
         kw.update({"wave_width": cfg.wave_width, "chunk_waves": cfg.chunk_waves,
                    "preemption": cfg.device_preemption,
-                   "retry_buffer": cfg.whatif.retry_buffer})
+                   "retry_buffer": cfg.whatif.retry_buffer,
+                   "node_shards": cfg.node_shards,
+                   "paged": cfg.paged_waves})
     engine = factory(ec, ep, cfg.framework, **kw)
     events = None
     if cfg.chaos is not None and cfg.chaos.enabled:
@@ -356,6 +358,30 @@ def validate_config(cfg) -> list:
             "whatIf.completions: false (the retry pass runs at completion "
             "boundaries)"
         )
+    if cfg.node_shards < 0:
+        errors.append("nodeShards: must be >= 0 (0/1 = replicated planes)")
+    if cfg.node_shards > 1:
+        if cfg.strategy != "jax":
+            errors.append(
+                "nodeShards: intra-scenario node-plane sharding is a "
+                "strategy: jax feature (the what-if batch spends the mesh "
+                "on the scenario axis)"
+            )
+        if tier_on:
+            errors.append(
+                "nodeShards is not supported with tier devicePreemption "
+                "(the sharded chunk program is the node-space engine; use "
+                "devicePreemption: kube)"
+            )
+    if cfg.paged_waves:
+        if cfg.strategy != "jax":
+            errors.append("pagedWaves: requires strategy: jax")
+        if cfg.whatif.retry_buffer or cfg.device_preemption == "kube":
+            errors.append(
+                "pagedWaves is not supported with whatIf.retryBuffer / "
+                "devicePreemption: kube yet (the boundary mirror "
+                "pre-stages the whole wave index tensor)"
+            )
     ch = cfg.chaos
     if ch is not None and ch.enabled:
         if ch.mtbf <= 0:

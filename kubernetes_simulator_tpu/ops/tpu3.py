@@ -606,6 +606,28 @@ class ExtraSource(NamedTuple):
             tier=jnp.asarray(st.pod_tier.astype(np.int32) if st.Tt else z),
         )
 
+    @classmethod
+    def page(cls, st: V3Static, flat: np.ndarray) -> "ExtraSource":
+        """One PAGE of the extra source (round 14 paged pod waves — the
+        v3 twin of ops.tpu.SlotSource.page): rows at flat pod ids
+        ``flat``, PAD ids mapped to neutral zero rows. Keeps the pod
+        axis streamable — only chunk_waves × wave_width rows are
+        device-resident at once instead of all P."""
+        safe = np.clip(flat, 0, None)
+        n = safe.shape[0]
+        z = np.zeros(n, np.int32)
+        return cls(
+            anti_midx=jnp.asarray(st.anti_midx[safe].astype(np.int32)),
+            pref_midx=jnp.asarray(st.pref_midx[safe].astype(np.int32)),
+            tol_class=jnp.asarray(
+                st.tol_class[safe].astype(np.int32) if st.tol_class.size else z
+            ),
+            na_class=jnp.asarray(
+                st.na_class[safe].astype(np.int32) if st.na_class.size else z
+            ),
+            tier=jnp.asarray(st.pod_tier[safe].astype(np.int32) if st.Tt else z),
+        )
+
 
 @jax.jit
 def gather_extra_device(src: ExtraSource, idx: jax.Array) -> SlotExtra:

@@ -48,6 +48,87 @@ _seq = 0
 # payloads are chunked well below it.
 _KV_CHUNK = 2 * 1024 * 1024
 
+# ---------------------------------------------------------------------------
+# Gather payload compression (round 14). Assignment tensors dominate the
+# gather bytes at Borg scale ([S_local, P] int32 — 100k+ pods per row), and
+# they are extremely delta-compressible (node ids of consecutive pods
+# cluster; PAD runs are constant). Large integer ndarrays are re-encoded as
+# zlib(delta int32) before the KV put and decoded transparently on gather;
+# decode is byte-exact (values, dtype, shape — pinned by
+# tests/test_dcn_units.py). Float/object leaves and small arrays pass
+# through untouched — the codec must never cost more than it saves.
+
+_COMPRESS_MIN_ELEMS = 1024
+# (raw, compressed) byte totals for this process's gather puts since
+# import — tests and operators read the reduction off these.
+COMPRESS_BYTES = [0, 0]
+
+_I32_MIN = np.iinfo(np.int32).min
+_I32_MAX = np.iinfo(np.int32).max
+
+
+class _PackedArray:
+    """Wire wrapper for one compressed ndarray leaf. ``codec``:
+    "delta-zlib" (zlib over consecutive int32 deltas of the flattened
+    array — first element is delta-from-zero) or "zlib" (zlib over the
+    raw bytes; the fallback when deltas overflow int32)."""
+
+    __slots__ = ("codec", "dtype", "shape", "data")
+
+    def __init__(self, codec: str, dtype: str, shape, data: bytes):
+        self.codec = codec
+        self.dtype = dtype
+        self.shape = shape
+        self.data = data
+
+
+def _pack_leaf(a):
+    import zlib
+
+    if not (
+        isinstance(a, np.ndarray)
+        and a.size >= _COMPRESS_MIN_ELEMS
+        and np.issubdtype(a.dtype, np.integer)
+    ):
+        return a
+    flat = a.reshape(-1).astype(np.int64)
+    deltas = np.diff(flat, prepend=np.int64(0))
+    if deltas.min() >= _I32_MIN and deltas.max() <= _I32_MAX:
+        codec, raw = "delta-zlib", deltas.astype("<i4").tobytes()
+    else:
+        codec, raw = "zlib", np.ascontiguousarray(a).tobytes()
+    comp = zlib.compress(raw, 6)
+    if len(comp) >= a.nbytes:
+        return a  # incompressible — ship raw
+    COMPRESS_BYTES[0] += a.nbytes
+    COMPRESS_BYTES[1] += len(comp)
+    return _PackedArray(codec, a.dtype.str, a.shape, comp)
+
+
+def _unpack_leaf(p):
+    import zlib
+
+    if not isinstance(p, _PackedArray):
+        return p
+    raw = zlib.decompress(p.data)
+    if p.codec == "delta-zlib":
+        flat = np.cumsum(np.frombuffer(raw, dtype="<i4").astype(np.int64))
+        return flat.astype(np.dtype(p.dtype)).reshape(p.shape)
+    return (
+        np.frombuffer(raw, dtype=np.dtype(p.dtype)).reshape(p.shape).copy()
+    )
+
+
+def _walk_payload(obj, leaf):
+    """Structure-preserving map over the gather payload containers (dict /
+    list / tuple); everything else is a leaf. Symmetric for pack and
+    unpack, so round-tripping preserves the payload's exact shape."""
+    if isinstance(obj, dict):
+        return {k: _walk_payload(v, leaf) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_walk_payload(v, leaf) for v in obj)
+    return leaf(obj)
+
 
 def maybe_init_from_env() -> bool:
     """Join the ``jax.distributed`` coordinator described by
@@ -366,8 +447,25 @@ def gather(name: str, payload) -> list:
     _seq += 1
     GATHER_COUNT += 1
     c = _client()
+    # Round 14: delta+zlib the large integer tensors before the KV put —
+    # remote payloads decode through _unpack_leaf below; the LOCAL payload
+    # is returned as-is (it never crosses the wire), so compression is
+    # invisible to callers either way.
+    raw0, comp0 = COMPRESS_BYTES
+    packed = _walk_payload(payload, _pack_leaf)
+    if COMPRESS_BYTES[0] > raw0:
+        from ..utils.metrics import log
+
+        log.info(
+            "gather(%s): compressed %.1f KiB of int tensors to %.1f KiB "
+            "(%.1fx) before the KV put",
+            name,
+            (COMPRESS_BYTES[0] - raw0) / 1024,
+            (COMPRESS_BYTES[1] - comp0) / 1024,
+            (COMPRESS_BYTES[0] - raw0) / max(COMPRESS_BYTES[1] - comp0, 1),
+        )
     blob = base64.b64encode(
-        pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.dumps(packed, protocol=pickle.HIGHEST_PROTOCOL)
     ).decode("ascii")
     chunks = [
         blob[i : i + _KV_CHUNK] for i in range(0, len(blob), _KV_CHUNK)
@@ -382,16 +480,15 @@ def gather(name: str, payload) -> list:
             out.append(payload)
             continue
         n = int(_get_attributed(c, f"{prefix}/{p}/n", p, name))
-        out.append(
-            pickle.loads(
-                base64.b64decode(
-                    "".join(
-                        _get_attributed(c, f"{prefix}/{p}/{j}", p, name)
-                        for j in range(n)
-                    )
+        remote = pickle.loads(
+            base64.b64decode(
+                "".join(
+                    _get_attributed(c, f"{prefix}/{p}/{j}", p, name)
+                    for j in range(n)
                 )
             )
         )
+        out.append(_walk_payload(remote, _unpack_leaf))
     return out
 
 

@@ -443,6 +443,184 @@ def make_chunk_fn_rej(wave_width: int, spec: StepSpec):
     return jax.jit(chunk_fn, donate_argnums=(1, 2))
 
 
+def _node_plane_specs():
+    """(DevCluster, DevState) PartitionSpec trees for the node-sharded
+    chunk program (round 14): [N, ...] leading-axis tensors shard the
+    node axis, [*, N] trailing-axis planes shard the last axis, and the
+    group/expr tables plus ``match_total`` (replicated semantic state —
+    every shard applies the identical scalar updates) carry P()."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import NODE_AXIS
+
+    dc_specs = T.DevCluster(
+        allocatable=P(NODE_AXIS),
+        node_label_key=P(NODE_AXIS),
+        node_label_kv=P(NODE_AXIS),
+        node_label_num=P(NODE_AXIS),
+        taint_key=P(NODE_AXIS),
+        taint_kv=P(NODE_AXIS),
+        taint_effect=P(NODE_AXIS),
+        node_domain=P(None, NODE_AXIS),
+        num_domains=P(),
+        expr_key=P(),
+        expr_op=P(),
+        expr_vals=P(),
+        expr_num=P(),
+        group_topo=P(),
+    )
+    st_specs = T.DevState(
+        used=P(NODE_AXIS),
+        match_count=P(None, NODE_AXIS),
+        anti_active=P(None, NODE_AXIS),
+        pref_wsum=P(None, NODE_AXIS),
+        match_total=P(),
+    )
+    return dc_specs, st_specs
+
+
+def make_wave_step_sharded(
+    dc: T.DevCluster, d: T.Derived, wave_width: int, spec: StepSpec,
+    ctx: "T.ShardCtx",
+):
+    """:func:`make_wave_step` over one NODE SHARD (round 14 big-scenario
+    mode; runs inside shard_map — ``dc``/``d``/``st`` carry the local
+    node block). Three deltas from the replicated body, each exact (see
+    ops.tpu's sharded section): the fused eval takes ``shard_ctx``;
+    selection is the two-stage :func:`ops.tpu.select_node_sharded` whose
+    global (score, node-id, bind-domain-row) exchange also yields
+    ``placed`` (the local ``any_f`` never decides placement); and the
+    winner's [G] domain row is stacked across the wave so gang rollback
+    can undo count-plane updates without re-reading the owner shard."""
+
+    def wave_step(st: T.DevState, slot_batch: T.PodSlot):
+        pre = T.build_wave_pre(dc, d, slot_batch, spec)
+        widths = T.wave_widths(slot_batch, spec)
+        choices, placeds, gdoms, hasdoms = [], [], [], []
+        for wslot in range(wave_width):
+            s = jax.tree.map(lambda a: a[wslot], slot_batch)
+            p = jax.tree.map(lambda a: a[wslot], pre)
+            feasible, scores, _any_f = T.eval_pod_fused(
+                dc, d, st, s, p, spec, widths, shard_ctx=ctx
+            )
+            node, placed_any, gdom_at, has_dom = T.select_node_sharded(
+                scores, feasible, d.gdom_f, ctx
+            )
+            placed = placed_any & s.valid
+            st = T.apply_binding_sharded(
+                d, st, s, node, placed, gdom_at, has_dom, ctx
+            )
+            choices.append(node)
+            placeds.append(placed)
+            gdoms.append(gdom_at)
+            hasdoms.append(has_dom)
+        choice = jnp.stack(choices)  # [W] GLOBAL node ids
+        placed = jnp.stack(placeds)  # [W]
+        if spec.has_gangs:
+            groups = slot_batch.group  # [W]
+            same = (groups[:, None] == groups[None, :]) & (groups[:, None] >= 0)
+            fail = jnp.any(same & ~placed[None, :], axis=1)
+            revert = placed & fail
+            st = T.apply_unbind_wave_sharded(
+                d, st, slot_batch, choice, revert,
+                jnp.stack(gdoms), jnp.stack(hasdoms), ctx,
+            )
+            final = jnp.where(placed & ~fail, choice, PAD).astype(jnp.int32)
+        else:
+            final = jnp.where(placed, choice, PAD).astype(jnp.int32)
+        return st, final
+
+    return wave_step
+
+
+def make_chunk_fn_sharded(
+    wave_width: int, spec: StepSpec, mesh, ctx: "T.ShardCtx"
+):
+    """:func:`make_chunk_fn` under shard_map over the NODE axis: each
+    device scans the same waves against its node-plane block; the slots
+    replicate; the choices come out replicated (every shard computes the
+    same global winner — out_spec P()). shard_map, not jit-with-
+    shardings, for the same reason as the what-if mesh path: the sharding
+    becomes a compile-time guarantee and the ONLY collectives are the
+    tiny per-slot exchanges the sharded primitives spell out (pinned by
+    tests/test_mesh_hlo.py)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    dc_specs, st_specs = _node_plane_specs()
+
+    def body(dc: T.DevCluster, state: T.DevState, slots: T.PodSlot):
+        d = T.Derived.build(dc)
+        wave_step = make_wave_step_sharded(dc, d, wave_width, spec, ctx)
+        state, choices = jax.lax.scan(wave_step, state, slots)
+        return state, choices
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(dc_specs, st_specs, P()),
+        out_specs=(st_specs, P()),
+        check_rep=False,
+    )
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def replicated_resident_bytes(
+    ec: EncodedCluster, pods: EncodedPods, pods_resident: bool = True
+) -> int:
+    """Per-device HBM estimate of the REPLICATED single-scenario
+    residency: the DevCluster tensors, the DevState planes, and (when
+    ``pods_resident`` — the v3 unpaged layout) the whole-trace
+    SlotSource/ExtraSource rows. The ``KSIM_MAX_REPLICATED_BYTES`` gate
+    in JaxReplayEngine refuses replicated runs past this estimate with a
+    pointer at node_shards/paged — the Borg-scale shapes (10k nodes ×
+    1M pods) are exactly the ones that OOM one chip silently otherwise."""
+    dc_fields = (
+        ec.allocatable, ec.node_label_key, ec.node_label_kv,
+        ec.node_label_num, ec.taint_key, ec.taint_kv, ec.taint_effect,
+        ec.node_domain, ec.num_domains, ec.expr_key, ec.expr_op,
+        ec.expr_vals, ec.expr_num, ec.group_topo,
+    )
+    total = sum(int(np.asarray(a).nbytes) for a in dc_fields)
+    N, R = ec.num_nodes, ec.num_resources
+    G = max(ec.num_groups, 1)
+    total += 4 * (N * R + 3 * G * N + G)  # DevState planes (f32)
+    if pods_resident:
+        pod_fields = (
+            pods.requests, pods.tol_key, pods.tol_kv, pods.tol_effect,
+            pods.na_req, pods.na_has_req, pods.na_pref, pods.na_pref_w,
+            pods.aff_req, pods.anti_req, pods.pref_aff, pods.pref_aff_w,
+            pods.spread_g, pods.spread_skew, pods.spread_dns,
+            pods.pod_matches_group, pods.group_id,
+        )
+        total += sum(int(np.asarray(a).nbytes) for a in pod_fields)
+    return total
+
+
+class _PodPager:
+    """Rolling two-deep host→device page prefetcher (round 14 paged pod
+    waves): ``get(ci)`` returns chunk ci's staged page (staging it now if
+    the prefetch missed — first chunk, resume jumps); ``prefetch(ci)`` is
+    called right after dispatching a chunk, so the next page's H2D copies
+    are issued while the device is still scanning — the paged twin of the
+    double-buffered boundary staging."""
+
+    def __init__(self, fetch):
+        self._fetch = fetch
+        self._next = None
+
+    def get(self, ci: int):
+        if self._next is not None and self._next[0] == ci:
+            page = self._next[1]
+        else:
+            page = self._fetch(ci)
+        self._next = None
+        return page
+
+    def prefetch(self, ci: int) -> None:
+        self._next = (ci, self._fetch(ci))
+
+
 def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
     """The v3 chunk program with the slot gathers INSIDE the jit:
     (dc, state, SlotSource, ExtraSource, idx [C, W]) → (state, choices).
@@ -600,6 +778,8 @@ class JaxReplayEngine:
         lazy_boundary: bool = True,
         double_buffer: bool = True,
         telemetry=None,
+        node_shards: int = 0,
+        paged: bool = False,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
@@ -663,6 +843,34 @@ class JaxReplayEngine:
                 "preemption='kube' requires retry_buffer > 0 (failed pods "
                 "reach the PostFilter through the boundary retry pass)"
             )
+        # Round 14 big-scenario mode: shard ONE scenario's node planes over
+        # the local devices (node_shards > 1) and/or stream pod pages
+        # host->device (paged) instead of keeping the whole trace resident.
+        self.node_shards = int(node_shards or 0)
+        self.paged = bool(paged)
+        if self.node_shards > 1 and mode == "tier":
+            raise ValueError(
+                "node_shards is not supported with tier preemption: the "
+                "node-sharded chunk program is the node-space (v2) engine "
+                "and tier preemption is v3-only — use preemption='kube'"
+            )
+        if self.paged and (mode == "kube" or retry_buffer):
+            raise ValueError(
+                "paged=True is not supported with retry_buffer / "
+                "preemption='kube' yet — the boundary mirror pre-stages the "
+                "whole wave index tensor; run paged replays on the plain path"
+            )
+        if self.node_shards > 1 and engine == "v3":
+            from ..utils.metrics import log
+
+            log.info(
+                "node_shards=%d: forcing engine='v2' — the node-sharded "
+                "chunk program runs on the node-space planes (the v3 "
+                "domain-space layout replicates exactly the per-domain "
+                "state node sharding is meant to split)",
+                self.node_shards,
+            )
+            engine = "v2"
         self.ec = ec
         self.pods = pods
         self.spec = StepSpec.from_config(ec, config, pods)
@@ -680,7 +888,40 @@ class JaxReplayEngine:
         self.completions = completions
         self.granularity_guard = granularity_guard
         self.telemetry_cfg = TelemetryConfig.resolve(telemetry)
-        self.dc = T.DevCluster.from_encoded(ec)
+        # Replicated-residency refusal (Borg-scale guard): with a per-device
+        # byte budget set, a replicated run whose single-scenario planes
+        # exceed it is refused UP FRONT with the fix spelled out, instead of
+        # dying in an opaque device OOM mid-replay.
+        import os
+
+        budget = os.environ.get("KSIM_MAX_REPLICATED_BYTES")
+        if budget and self.node_shards <= 1:
+            est = replicated_resident_bytes(
+                ec, pods, pods_resident=(engine == "v3" and not self.paged)
+            )
+            if est > int(budget):
+                raise ValueError(
+                    f"replicated single-scenario residency ~{est / 2**20:.0f} "
+                    f"MiB/device exceeds KSIM_MAX_REPLICATED_BYTES "
+                    f"({int(budget) / 2**20:.0f} MiB): shard the node axis "
+                    "across devices (node_shards=...) and/or stream pod "
+                    "pages (paged=True) instead of the replicated path"
+                )
+        if self.node_shards > 1:
+            from ..parallel import mesh as M
+
+            self._node_mesh = M.make_node_mesh(self.node_shards)
+            n_real = ec.num_nodes
+            n_local = -(-n_real // self.node_shards)
+            self._n_real = n_real
+            self._n_pad = n_local * self.node_shards
+            self._shard_ctx = T.ShardCtx(
+                axis=M.NODE_AXIS, n_local=n_local, n_real=n_real,
+                nshards=self.node_shards,
+            )
+            self.dc = self._shard_cluster(ec)
+        else:
+            self.dc = T.DevCluster.from_encoded(ec)
         # "auto": measured optimum is W=8 across shapes (W=16 loses to the
         # W² in-wave coupling even on coarse-only traces) — kept as a
         # resolution point for when the kernel cost model changes.
@@ -696,18 +937,117 @@ class JaxReplayEngine:
                 self.static3, self.shared3, rep_slots_for(self.static3, pods),
                 wave_width, self.spec,
             )
+        elif self.node_shards > 1:
+            self.chunk_fn = make_chunk_fn_sharded(
+                wave_width, self.spec, self._node_mesh, self._shard_ctx
+            )
         else:
             self.chunk_fn = make_chunk_fn(wave_width, self.spec)
-        self.waves = pack_waves(pods, wave_width)
+        self.waves = pack_waves(
+            pods, wave_width,
+            page_pods=(chunk_waves * wave_width if self.paged else None),
+        )
         # Slot data lives on device once; chunks gather rows inside jit
         # (ops.tpu.SlotSource) — only wave indices cross the host boundary.
         # v3-only: the v2 fallback engine still host-gathers, so the device
-        # copies would be dead HBM weight there.
-        self._slot_src = T.SlotSource.build(pods) if engine == "v3" else None
+        # copies would be dead HBM weight there. Paged mode keeps slots on
+        # host and streams per-chunk pages instead (SlotSource.page).
+        self._slot_src = (
+            T.SlotSource.build(pods)
+            if engine == "v3" and not self.paged
+            else None
+        )
         self._extra_src = (
             V3.ExtraSource.build(self.static3, pods.num_pods)
-            if engine == "v3"
+            if engine == "v3" and not self.paged
             else None
+        )
+
+    def _shard_cluster(self, ec: EncodedCluster) -> T.DevCluster:
+        """Padded + node-sharded device cluster. Node-axis tensors are
+        padded to the shard width with NEUTRAL fill (zero capacity, PAD
+        labels/taints/domains, no-op taint effect) so pad rows filter out
+        identically on every plugin, then placed under the node-plane
+        shardings. ``ec`` itself is untouched — results and the host mirror
+        always see the real node count."""
+        from ..parallel import mesh as M
+
+        n_pad = self._n_pad
+        pad = M.pad_node_axis
+        host = T.DevCluster(
+            allocatable=pad(ec.allocatable, 0, n_pad, 0.0),
+            node_label_key=pad(ec.node_label_key, 0, n_pad, PAD),
+            node_label_kv=pad(ec.node_label_kv, 0, n_pad, PAD),
+            node_label_num=pad(ec.node_label_num, 0, n_pad, 0.0),
+            taint_key=pad(ec.taint_key, 0, n_pad, PAD),
+            taint_kv=pad(ec.taint_kv, 0, n_pad, PAD),
+            taint_effect=pad(ec.taint_effect, 0, n_pad, 0),
+            node_domain=pad(ec.node_domain, 1, n_pad, PAD),
+            num_domains=np.asarray(ec.num_domains),
+            expr_key=np.asarray(ec.expr_key),
+            expr_op=np.asarray(ec.expr_op),
+            expr_vals=np.asarray(ec.expr_vals),
+            expr_num=np.asarray(ec.expr_num),
+            group_topo=np.asarray(ec.group_topo),
+        )
+        dc_specs, _ = _node_plane_specs()
+        return M.shard_node_planes(self._node_mesh, host, dc_specs)
+
+    def _put_alloc(self, alloc: np.ndarray):
+        """Device copy of an allocatable plane, re-placed under the node
+        sharding when the node mesh is active (a bare jnp.asarray would
+        leave the replaced DevCluster with mixed shardings and trip the
+        shard_map in_specs)."""
+        if self.node_shards > 1:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel import mesh as M
+
+            return jax.device_put(
+                alloc, M.node_sharding(self._node_mesh, P(M.NODE_AXIS))
+            )
+        return jnp.asarray(alloc)
+
+    def _to_dev_state_v2(self, used, mc, aa, pw, mt) -> T.DevState:
+        """Device v2 (node-space) state/delta from host planes — padded to
+        the shard width and placed under the node-plane shardings when node
+        sharding is active, plain device arrays otherwise."""
+        if self.node_shards > 1:
+            from ..parallel import mesh as M
+
+            n_pad = self._n_pad
+            host = T.DevState(
+                used=M.pad_node_axis(np.asarray(used, np.float32), 0, n_pad, 0.0),
+                match_count=M.pad_node_axis(np.asarray(mc, np.float32), 1, n_pad, 0.0),
+                anti_active=M.pad_node_axis(np.asarray(aa, np.float32), 1, n_pad, 0.0),
+                pref_wsum=M.pad_node_axis(np.asarray(pw, np.float32), 1, n_pad, 0.0),
+                match_total=np.asarray(mt, np.float32),
+            )
+            _, st_specs = _node_plane_specs()
+            return M.shard_node_planes(self._node_mesh, host, st_specs)
+        return T.DevState(
+            used=jnp.asarray(used),
+            match_count=jnp.asarray(mc),
+            anti_active=jnp.asarray(aa),
+            pref_wsum=jnp.asarray(pw),
+            match_total=jnp.asarray(mt),
+        )
+
+    def _unshard_state_v2(self, state) -> T.DevState:
+        """Host node-space copy of a (possibly node-sharded) v2 carry,
+        sliced back to the real node count — checkpoint blobs and result
+        planes never see the shard padding, so they are byte-identical
+        across shard counts."""
+        u = np.asarray(state.used)
+        mc = np.asarray(state.match_count)
+        aa = np.asarray(state.anti_active)
+        pw = np.asarray(state.pref_wsum)
+        if self.node_shards > 1:
+            n = self._n_real
+            u, mc, aa, pw = u[:n], mc[:, :n], aa[:, :n], pw[:, :n]
+        return T.DevState(
+            used=u, match_count=mc, anti_active=aa, pref_wsum=pw,
+            match_total=np.asarray(state.match_total),
         )
 
     def _init_dev_state(self, force_v2: bool = False):
@@ -723,12 +1063,12 @@ class JaxReplayEngine:
                 host.used, host.match_count, host.anti_active, host.pref_wsum,
                 self.ec, self.static3, ep=self.pods,
             )
-        return T.DevState(
-            used=jnp.asarray(host.used),
-            match_count=jnp.asarray(T.domain_to_node_space(host.match_count, gdom)),
-            anti_active=jnp.asarray(T.domain_to_node_space(host.anti_active, gdom)),
-            pref_wsum=jnp.asarray(T.domain_to_node_space(host.pref_wsum, gdom)),
-            match_total=jnp.asarray(host.match_count.sum(axis=1).astype(np.float32)),
+        return self._to_dev_state_v2(
+            host.used,
+            T.domain_to_node_space(host.match_count, gdom),
+            T.domain_to_node_space(host.anti_active, gdom),
+            T.domain_to_node_space(host.pref_wsum, gdom),
+            host.match_count.sum(axis=1).astype(np.float32),
         )
 
     def _save_checkpoint(self, state, cursor: int, all_choices, path: str,
@@ -744,7 +1084,8 @@ class JaxReplayEngine:
             ).save(path)
         else:
             ck = state_to_checkpoint(
-                state, self._gdom, self._Dhost, cursor, all_choices
+                self._unshard_state_v2(state), self._gdom, self._Dhost,
+                cursor, all_choices,
             )
             ck.released = released
             ck.boundary = boundary
@@ -814,12 +1155,12 @@ class JaxReplayEngine:
                 )
         else:
             gdom = self._gdom
-            delta = T.DevState(
-                used=jnp.asarray(used_d),
-                match_count=jnp.asarray(T.domain_to_node_space(mc_d, gdom)),
-                anti_active=jnp.asarray(T.domain_to_node_space(aa_d, gdom)),
-                pref_wsum=jnp.asarray(T.domain_to_node_space(pw_d, gdom)),
-                match_total=jnp.asarray(mc_d.sum(axis=1)),
+            delta = self._to_dev_state_v2(
+                used_d,
+                T.domain_to_node_space(mc_d, gdom),
+                T.domain_to_node_space(aa_d, gdom),
+                T.domain_to_node_space(pw_d, gdom),
+                mc_d.sum(axis=1),
             )
         return self._donated_subtract(state, delta)
 
@@ -855,12 +1196,12 @@ class JaxReplayEngine:
             delta = V3.DevState3.from_host(*net, self.ec, self.static3)
         else:
             gdom = self._gdom
-            delta = T.DevState(
-                used=jnp.asarray(net[0]),
-                match_count=jnp.asarray(T.domain_to_node_space(net[1], gdom)),
-                anti_active=jnp.asarray(T.domain_to_node_space(net[2], gdom)),
-                pref_wsum=jnp.asarray(T.domain_to_node_space(net[3], gdom)),
-                match_total=jnp.asarray(net[1].sum(axis=1)),
+            delta = self._to_dev_state_v2(
+                net[0],
+                T.domain_to_node_space(net[1], gdom),
+                T.domain_to_node_space(net[2], gdom),
+                T.domain_to_node_space(net[3], gdom),
+                net[1].sum(axis=1),
             )
         return self._donated_subtract(state, delta)
 
@@ -874,6 +1215,15 @@ class JaxReplayEngine:
             return V3.DevState3.from_host(
                 ck.used, ck.match_count, ck.anti_active, ck.pref_wsum,
                 self.ec, self.static3,
+            )
+        if self.node_shards > 1:
+            g = self._gdom
+            return self._to_dev_state_v2(
+                ck.used,
+                T.domain_to_node_space(ck.match_count, g),
+                T.domain_to_node_space(ck.anti_active, g),
+                T.domain_to_node_space(ck.pref_wsum, g),
+                ck.match_count.sum(axis=1).astype(np.float32),
             )
         return checkpoint_to_state(ck, self._gdom)
 
@@ -1197,7 +1547,7 @@ class JaxReplayEngine:
                     jax.block_until_ready(state)
         finally:
             if node_events:
-                self.dc = self.dc._replace(allocatable=jnp.asarray(saved_alloc))
+                self.dc = self.dc._replace(allocatable=self._put_alloc(saved_alloc))
                 self.ec.allocatable[:] = saved_alloc_ec
         wall = time.perf_counter() - t0
 
@@ -1207,10 +1557,11 @@ class JaxReplayEngine:
         if self.engine == "v3":
             used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
         else:
-            used = np.asarray(state.used)
-            mc = T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost)
-            aa = T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost)
-            pw = T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost)
+            hs = self._unshard_state_v2(state)
+            used = hs.used
+            mc = T.node_space_to_domain(hs.match_count, self._gdom, self._Dhost)
+            aa = T.node_space_to_domain(hs.anti_active, self._gdom, self._Dhost)
+            pw = T.node_space_to_domain(hs.pref_wsum, self._gdom, self._Dhost)
         util = utilization_means(used, self.ec.allocatable, self.ec.vocab._r)
         pending_m = (self.pods.bound_node == PAD) & (assignments == PAD)
         frag = fragmentation_gauges(
@@ -1261,7 +1612,7 @@ class JaxReplayEngine:
                 alloc[ev.node] = saved_alloc[ev.node]
             elif ev.kind == "capacity_scale":
                 alloc[ev.node] = saved_alloc[ev.node] * ev.scale
-        self.dc = self.dc._replace(allocatable=jnp.asarray(alloc))
+        self.dc = self.dc._replace(allocatable=self._put_alloc(alloc))
 
     def replay(
         self,
@@ -1378,6 +1729,14 @@ class JaxReplayEngine:
                 "checkpoints) — latency/phase telemetry still collected"
             )
             use_rej = False
+        if use_rej and self.node_shards > 1:
+            log.info(
+                "telemetry: rejection attribution is disabled under node "
+                "sharding (the instrumented reference program carries "
+                "replicated node planes) — latency/phase telemetry still "
+                "collected"
+            )
+            use_rej = False
         rej_dev = None
         if use_rej:
             if self.engine == "v3":
@@ -1476,9 +1835,37 @@ class JaxReplayEngine:
                 jnp.asarray(idx[c0 : c0 + C])
                 for c0 in range(0, idx.shape[0], C)
             ]
-            if self.engine == "v3" and not use_rej
+            if self.engine == "v3" and not use_rej and not self.paged
             else None
         )
+        # Paged pod waves (round 14): per-chunk pages of the slot planes
+        # stream host->device with one-chunk prefetch instead of whole-trace
+        # residency. v3 pages carry page-LOCAL row indices (the kernels only
+        # consume pod_id as a width, never as an identity).
+        pager = None
+        if self.paged and not use_rej:
+            if self.engine == "v3":
+                def _fetch_page(pci):
+                    rows = idx[pci * C : (pci + 1) * C]
+                    flat = rows.reshape(-1)
+                    local = np.where(
+                        rows >= 0,
+                        np.arange(
+                            rows.size, dtype=np.int32
+                        ).reshape(rows.shape),
+                        PAD,
+                    ).astype(np.int32)
+                    return (
+                        T.SlotSource.page(self.pods, flat),
+                        V3.ExtraSource.page(self.static3, flat),
+                        jnp.asarray(local),
+                    )
+            else:
+                def _fetch_page(pci):
+                    return T.gather_slots(
+                        self.pods, idx[pci * C : (pci + 1) * C]
+                    )
+            pager = _PodPager(_fetch_page)
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_chunk:
@@ -1552,15 +1939,26 @@ class JaxReplayEngine:
                         T.gather_slots(self.pods, idx[c0 : c0 + C]),
                     )
                 elif self.engine == "v3":
-                    state, choices = self.chunk_fn(
-                        self.dc, state, self._slot_src, self._extra_src,
-                        idx_chunks[ci],
-                    )
+                    if pager is not None:
+                        src, xsrc, lidx = pager.get(ci)
+                        state, choices = self.chunk_fn(
+                            self.dc, state, src, xsrc, lidx
+                        )
+                    else:
+                        state, choices = self.chunk_fn(
+                            self.dc, state, self._slot_src, self._extra_src,
+                            idx_chunks[ci],
+                        )
                 else:
                     state, choices = self.chunk_fn(
                         self.dc, state,
-                        T.gather_slots(self.pods, idx[c0 : c0 + C]),
+                        pager.get(ci)
+                        if pager is not None
+                        else T.gather_slots(self.pods, idx[c0 : c0 + C]),
                     )
+            if pager is not None and c0 + C < idx.shape[0]:
+                # Stage the next page while this chunk is still on device.
+                pager.prefetch(ci + 1)
             all_choices.append(choices)
             if completions_on and self.preemption:
                 pending_fold = (idx[c0 : c0 + C], choices)
@@ -1588,7 +1986,7 @@ class JaxReplayEngine:
             jax.block_until_ready(all_choices[-1] if all_choices else state)
         wall = time.perf_counter() - t0
         if node_events:
-            self.dc = self.dc._replace(allocatable=jnp.asarray(saved_alloc))
+            self.dc = self.dc._replace(allocatable=self._put_alloc(saved_alloc))
 
         preemptions = 0
         to_schedule = int((idx >= 0).sum())
@@ -1646,10 +2044,11 @@ class JaxReplayEngine:
         if self.engine == "v3" and not use_rej:
             used, mc, aa, pw = state.to_host(self.ec, self.static3, self._Dhost)
         else:
-            used = np.asarray(state.used)
-            mc = T.node_space_to_domain(np.asarray(state.match_count), self._gdom, self._Dhost)
-            aa = T.node_space_to_domain(np.asarray(state.anti_active), self._gdom, self._Dhost)
-            pw = T.node_space_to_domain(np.asarray(state.pref_wsum), self._gdom, self._Dhost)
+            hs = self._unshard_state_v2(state)
+            used = hs.used
+            mc = T.node_space_to_domain(hs.match_count, self._gdom, self._Dhost)
+            aa = T.node_space_to_domain(hs.anti_active, self._gdom, self._Dhost)
+            pw = T.node_space_to_domain(hs.pref_wsum, self._gdom, self._Dhost)
         util = utilization_means(used, self.ec.allocatable, self.ec.vocab._r)
         pending_m = (self.pods.bound_node == PAD) & (assignments == PAD)
         frag = fragmentation_gauges(

@@ -31,15 +31,33 @@ class WaveBatch:
 
 
 def pack_waves(
-    ep: EncodedPods, wave_width: int = 8, order: Optional[np.ndarray] = None
+    ep: EncodedPods, wave_width: int = 8, order: Optional[np.ndarray] = None,
+    page_pods: Optional[int] = None,
 ) -> WaveBatch:
     """Pack schedulable pods into waves. ``order`` defaults to arrival order
     of unbound pods (stable; deterministic). Uses the native C++ packer
     (kubernetes_simulator_tpu.native) when available — ~40× faster at 1M
-    pods; this Python path is the semantic reference and fallback."""
+    pods; this Python path is the semantic reference and fallback.
+
+    ``page_pods`` (round 14 paged mode): number of pod SLOTS per streamed
+    page. Validated here against the largest gang — a gang split across
+    pages could see its later members arrive after the page carrying its
+    earlier ones was evicted, so the guard mirrors the wave-width check
+    (and runs on BOTH the native and reference paths)."""
     if order is None:
         unbound = np.nonzero(ep.bound_node == PAD)[0]
         order = unbound[np.argsort(ep.arrival[unbound], kind="stable")]
+    if page_pods is not None:
+        gids = ep.group_id[np.asarray(order)]
+        gids = gids[gids != PAD]
+        max_gang = int(np.bincount(gids).max()) if gids.size else 1
+        if page_pods < max_gang:
+            raise ValueError(
+                f"paged mode: page of {page_pods} pod slots is smaller than "
+                f"the largest gang ({max_gang} pods) — a gang must fit in "
+                f"one page; raise chunk_waves/wave_width so that "
+                f"chunk_waves * wave_width >= {max_gang}, or disable paging"
+            )
     from ..native import pack_waves_native
 
     idx_native = pack_waves_native(np.asarray(order), ep.group_id, wave_width)

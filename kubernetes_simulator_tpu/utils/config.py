@@ -194,6 +194,11 @@ class SimConfig:
     # PostFilter at chunk boundaries; single-replay engine only — see
     # sim.greedy / sim.boundary docstrings).
     device_preemption: object = False
+    # Big-scenario mode (round 14, jax strategy only): shard ONE scenario's
+    # node planes over `nodeShards` local devices, and/or stream pod pages
+    # host->device instead of whole-trace residency (`pagedWaves`).
+    node_shards: int = 0
+    paged_waves: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "SimConfig":
@@ -329,6 +334,8 @@ class SimConfig:
         # bool (legacy: true = tier) or the string "tier"/"kube".
         dp = d.get("devicePreemption", False)
         cfg.device_preemption = dp if isinstance(dp, str) else bool(dp)
+        cfg.node_shards = int(d.get("nodeShards", 0))
+        cfg.paged_waves = bool(d.get("pagedWaves", False))
         return cfg
 
     @classmethod

@@ -15,6 +15,10 @@ BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
   flagged (informational — phases shift when features land);
 - DCN scaling (``detail.dcn_scaling.aggregate_pps`` and per-process
   pps where both files carry them): same threshold as the headline;
+- Borg-scale block (``detail.borg_scale``, round 14): ``pps`` compared
+  with the same threshold when both rounds ran the same shape
+  (nodes/pods/node_shards/paged); first appearance or a reshaped run
+  is informational only;
 - utilization economics (``detail.utilization``, round 13): a relative
   drop in ``whatif_util_cpu_mean`` / ``cpu_baseline_util_cpu`` /
   packing efficiency beyond the threshold is a REGRESSION; growth in
@@ -152,6 +156,43 @@ def compare_pair(
                     regressions.append(line + "  REGRESSION")
                 else:
                     notes.append(line)
+
+    # Borg-scale single-scenario block (round 14): pps drop beyond the
+    # threshold regresses — but ONLY when both rounds ran the same shape
+    # (nodes/pods/node_shards); a reshaped or first-appearing block is
+    # informational.
+    bsa, bsb = da.get("borg_scale"), db.get("borg_scale")
+    if isinstance(bsb, dict) and not isinstance(bsa, dict):
+        notes.append(
+            f"borg_scale: first appearance ({bsb.get('nodes')} nodes x "
+            f"{bsb.get('pods')} pods, {bsb.get('node_shards')} shards, "
+            f"pps={bsb.get('pps')})"
+        )
+    elif isinstance(bsa, dict) and isinstance(bsb, dict):
+        same_shape = all(
+            bsa.get(k) == bsb.get(k)
+            for k in ("nodes", "pods", "node_shards", "paged")
+        )
+        pa, pb = bsa.get("pps"), bsb.get("pps")
+        if not same_shape:
+            notes.append(
+                "borg_scale: shape changed "
+                f"({bsa.get('nodes')}x{bsa.get('pods')}/"
+                f"{bsa.get('node_shards')} -> {bsb.get('nodes')}x"
+                f"{bsb.get('pods')}/{bsb.get('node_shards')}) — "
+                "pps not compared"
+            )
+        elif (
+            isinstance(pa, (int, float))
+            and isinstance(pb, (int, float))
+            and pa > 0
+        ):
+            delta = (pb - pa) / pa
+            line = f"borg_scale pps: {pa:.1f} -> {pb:.1f} ({delta:+.1%})"
+            if pb < pa * (1.0 - threshold):
+                regressions.append(line + "  REGRESSION")
+            else:
+                notes.append(line)
     return regressions, notes
 
 

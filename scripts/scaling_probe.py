@@ -62,6 +62,48 @@ def default_sweep():
     probe(10_000, 10_000, 128)
 
 
+def node_probe(nodes, pods_n, node_shards, paged=False):
+    """One single-scenario replay at N nodes — replicated planes when
+    ``node_shards`` <= 1, node-sharded over that many devices otherwise
+    (round 14 big-scenario mode)."""
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+
+    cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
+    pods, _ = make_workload(
+        pods_n, seed=0, with_affinity=True, with_spread=True,
+        with_tolerations=True, gang_fraction=0.02, gang_size=4,
+    )
+    ec, ep = encode(cluster, pods)
+    eng = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), node_shards=node_shards, paged=paged,
+    )
+    eng.replay()  # warmup (compile)
+    t0 = time.perf_counter()
+    res = eng.replay()
+    wall = time.perf_counter() - t0
+    mode = f"shards={node_shards}" if node_shards > 1 else "replicated"
+    mode += "+paged" if paged else ""
+    print(
+        f"N={nodes:6d} P={pods_n:7d} {mode:>18s} wall={wall:6.2f}s "
+        f"pps={res.placements_per_sec/1e3:8.1f}k/s",
+        flush=True,
+    )
+
+
+def node_sweep(nodes_list, pods_n, paged=False):
+    """Node-axis scaling at S=1 (round 14): each N runs replicated and
+    node-sharded over all local devices, so the crossover where sharding
+    starts paying (and the shapes the replicated path cannot hold at all)
+    lands in the same scaling record as the S- and process-axis sweeps."""
+    import jax
+
+    ndev = len(jax.devices())
+    for nodes in nodes_list:
+        node_probe(nodes, pods_n, 1, paged=paged)
+        if ndev > 1:
+            node_probe(nodes, pods_n, ndev, paged=paged)
+
+
 def dcn_sweep(proc_counts, S, nodes, pods_n):
     """Re-launch this probe under scripts/dcn_launch.py once per process
     count — the DCN axis of the scaling trajectory (device-count sweeps
@@ -90,21 +132,29 @@ def main():
     ap.add_argument("--inner", action="store_true",
                     help="(internal) run one probe inside a DCN fleet")
     ap.add_argument("--scenarios", type=int, default=32)
-    ap.add_argument("--nodes", type=int, default=2000)
+    ap.add_argument("--nodes", type=str, default="2000",
+                    help="node count (int) for --dcn/--inner, or a comma "
+                         "list to run the round-14 node-axis sweep "
+                         "(replicated vs node-sharded at S=1)")
     ap.add_argument("--pods", type=int, default=10_000)
+    ap.add_argument("--paged", action="store_true",
+                    help="stream pod pages in the node-axis sweep")
     args = ap.parse_args()
+    node_list = [int(x) for x in str(args.nodes).split(",") if x]
     if args.inner:
         from kubernetes_simulator_tpu.parallel.mesh import make_mesh
 
         import jax
 
         mesh = make_mesh() if len(jax.devices()) > 1 else None
-        probe(args.nodes, args.pods, args.scenarios, mesh=mesh)
+        probe(node_list[0], args.pods, args.scenarios, mesh=mesh)
     elif args.dcn is not None:
         dcn_sweep(
             [int(x) for x in args.dcn.split(",") if x],
-            args.scenarios, args.nodes, args.pods,
+            args.scenarios, node_list[0], args.pods,
         )
+    elif len(node_list) > 1:
+        node_sweep(node_list, args.pods, paged=args.paged)
     else:
         default_sweep()
 

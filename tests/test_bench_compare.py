@@ -23,12 +23,14 @@ from bench_compare import (  # noqa: E402
 )
 
 
-def _bench(value, phases=None, dcn=None):
+def _bench(value, phases=None, dcn=None, borg=None):
     detail = {}
     if phases is not None:
         detail["phases"] = phases
     if dcn is not None:
         detail["dcn_scaling"] = dcn
+    if borg is not None:
+        detail["borg_scale"] = borg
     return {"metric": "pps", "value": value, "unit": "1/s",
             "detail": detail}
 
@@ -82,6 +84,30 @@ def test_dcn_scaling_regression_flagged():
     b = _bench(100.0, dcn={"aggregate_pps": 500.0})
     reg, _ = compare_pair("a", a, "b", b, 0.10)
     assert len(reg) == 1 and "aggregate_pps" in reg[0]
+
+
+def _borg(pps, nodes=1000, pods=20000, shards=8, paged=True):
+    return {"nodes": nodes, "pods": pods, "node_shards": shards,
+            "paged": paged, "pps": pps}
+
+
+def test_borg_scale_comparison():
+    # Same shape, pps drop beyond threshold: REGRESSION.
+    a = _bench(100.0, borg=_borg(5000.0))
+    b = _bench(100.0, borg=_borg(4000.0))
+    reg, _ = compare_pair("a", a, "b", b, 0.10)
+    assert len(reg) == 1 and "borg_scale pps" in reg[0]
+    # Within threshold: informational note.
+    reg, notes = compare_pair("a", a, "b", _bench(100.0, borg=_borg(4900.0)),
+                              0.10)
+    assert reg == [] and any("borg_scale pps" in n for n in notes)
+    # First appearance: informational, never a regression.
+    reg, notes = compare_pair("a", _bench(100.0), "b", b, 0.10)
+    assert reg == [] and any("first appearance" in n for n in notes)
+    # Shape changed (different node count): pps not compared.
+    reg, notes = compare_pair(
+        "a", a, "b", _bench(100.0, borg=_borg(1.0, nodes=2000)), 0.10)
+    assert reg == [] and any("shape changed" in n for n in notes)
 
 
 def test_main_exit_codes(tmp_path, capsys):

@@ -159,3 +159,75 @@ def test_mesh_chunk_program_no_collectives_with_completions(S):
     assert rel is not None, "expected at least one static release bucket"
     rel_fn = eng._release_fn(rel[2].shape[0])
     _assert_no_collectives(rel_fn.lower(*rel).compile().as_text())
+
+
+# ── Node-sharded chunk program (round 14) ────────────────────────────
+# The OTHER mesh axis: one scenario, node planes split across devices.
+# Here collectives are not forbidden — they are RATIONED. The design
+# claim ("one tiny (score, node-id) exchange per slot is the only
+# collective in the chunk loop") is pinned by whitelisting the compiled
+# op set: the winner exchange lowers to all-gather (+ all-reduce for
+# the packed plugin folds; partition-id for global-id arithmetic), and
+# anything else — all-to-all, permutes, point-to-point, reduce-scatter
+# — means node planes are being reshuffled mid-scan.
+
+NODE_SHARD_ALLOWED = frozenset({"all-gather", "all-reduce", "partition-id"})
+
+
+def _collective_hits(txt):
+    assert "ENTRY" in txt
+    return sorted({
+        op
+        for ln in txt.splitlines()
+        for op in COLLECTIVE_OPS
+        if f" {op}" in ln or ln.lstrip().startswith(op)
+    })
+
+
+def _node_sharded_hlo(fit_only: bool) -> str:
+    from kubernetes_simulator_tpu.ops import tpu as T
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.synthetic import config1
+
+    if fit_only:
+        cluster, pods, _ = config1(24, 64, seed=3)
+    else:
+        cluster = make_cluster(24, seed=3, taint_fraction=0.2)
+        pods, _ = make_workload(
+            64, seed=3, with_affinity=True, with_spread=True,
+            with_tolerations=True, gang_fraction=0.1, gang_size=4,
+        )
+    ec, ep = encode(cluster, pods)
+    eng = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), node_shards=8, chunk_waves=4
+    )
+    state = eng._init_dev_state()
+    C = min(eng.chunk_waves, eng.waves.idx.shape[0])
+    src = T.gather_slots(eng.pods, eng.waves.idx[:C])
+    return eng.chunk_fn.lower(eng.dc, state, src).compile().as_text()
+
+
+def test_node_sharded_chunk_collectives_whitelisted():
+    ops = _collective_hits(_node_sharded_hlo(fit_only=False))
+    assert "all-gather" in ops, (
+        "node-sharded chunk program lowered without the winner exchange "
+        "— selection is no longer crossing shards (is the mesh real?)"
+    )
+    extra = set(ops) - NODE_SHARD_ALLOWED
+    assert not extra, (
+        "node-sharded chunk program contains collectives beyond the "
+        f"per-slot selection/fold exchanges: {sorted(extra)} — node "
+        "planes are being reshuffled inside the chunk scan"
+    )
+
+
+def test_node_sharded_fit_only_is_single_exchange():
+    """Fit-only drops the packed plugin folds: the surviving collective
+    set is the selection exchange alone (all-gather + the partition-id
+    that turns local argmins into global node ids) — the literal 'one
+    tiny reduce per slot' of the round-14 design."""
+    ops = _collective_hits(_node_sharded_hlo(fit_only=True))
+    assert "all-gather" in ops
+    assert set(ops) <= {"all-gather", "partition-id"}, (
+        f"fit-only node-sharded program grew extra collectives: {ops}"
+    )

@@ -1,0 +1,249 @@
+"""Round 14: intra-scenario node-plane sharding + paged pod waves.
+
+The contract under test: ``node_shards`` and ``paged`` are pure
+memory/latency knobs — placements, JSONL rows, and checkpoint blobs are
+BIT-IDENTICAL across node_shards ∈ {1, 2, 4} and paged on/off. (The CPU
+greedy-oracle link is transitive: sharded ≡ replicated here, replicated
+≡ oracle in tests/test_oracle_parity.py.) Runs on the virtual 8-device
+CPU mesh (conftest forces XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Also here: the paged-mode gang guard in pack_waves, the
+KSIM_MAX_REPLICATED_BYTES refusal gate, the knob-combination validation
+raises, and byte-parity for the round-14 DCN gather payload compression
+(delta+zlib with raw-zlib overflow fallback).
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.jax_runtime import (
+    JaxReplayEngine,
+    replicated_resident_bytes,
+)
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+
+def _case(n_nodes=24, n_pods=220, seed=7):
+    """Full plugin surface: taints, affinity/anti-affinity, spread,
+    tolerations, gangs, finite durations (completions on)."""
+    cluster = make_cluster(n_nodes, seed=seed, taint_fraction=0.2)
+    pods, _ = make_workload(
+        n_pods, seed=seed, with_affinity=True, with_spread=True,
+        with_tolerations=True, gang_fraction=0.1, gang_size=4,
+        duration_mean=40.0,
+    )
+    return encode(cluster, pods)
+
+
+@pytest.fixture(scope="module")
+def shard_results():
+    """{node_shards: (engine, ReplayResult)} for the same trace."""
+    ec, ep = _case()
+    out = {}
+    for s in (1, 2, 4):
+        # telemetry="off": phase timers are wall clocks — the one field
+        # family that legitimately differs across shard counts.
+        eng = JaxReplayEngine(
+            ec, ep, FrameworkConfig(), chunk_waves=4, node_shards=s,
+            telemetry="off",
+        )
+        out[s] = (eng, eng.replay())
+    return out
+
+
+def _stable_summary(res):
+    """summary() minus the wall-clock-derived fields (the exact set the
+    KSIM_DETERMINISTIC_JSONL scrub zeroes)."""
+    row = dict(res.summary())
+    for k in ("wall_clock_s", "placements_per_sec"):
+        row.pop(k, None)
+    return row
+
+
+def test_shard_count_invariance(shard_results):
+    _, ref = shard_results[1]
+    for s in (2, 4):
+        _, res = shard_results[s]
+        np.testing.assert_array_equal(
+            res.assignments, ref.assignments,
+            err_msg=f"node_shards={s}: per-pod assignments diverged",
+        )
+        assert _stable_summary(res) == _stable_summary(ref), (
+            f"node_shards={s}: result summary diverged"
+        )
+
+
+def test_jsonl_byte_identical(shard_results, tmp_path, monkeypatch):
+    """The JSONL a run would emit is byte-identical across shard counts
+    once wall-clock fields are scrubbed (KSIM_DETERMINISTIC_JSONL — the
+    repo's standing rule: determinism lives in results, never timing)."""
+    from kubernetes_simulator_tpu.utils.metrics import JsonlWriter, replay_row
+
+    monkeypatch.setenv("KSIM_DETERMINISTIC_JSONL", "1")
+    blobs = {}
+    for s, (_, res) in shard_results.items():
+        p = tmp_path / f"shards{s}.jsonl"
+        with JsonlWriter(str(p)) as w:
+            w.write(replay_row("replay-jax", res))
+        blobs[s] = p.read_bytes()
+        json.loads(blobs[s].splitlines()[-1])  # still valid JSONL
+    assert blobs[1] == blobs[2] == blobs[4]
+
+
+def test_checkpoint_blobs_identical_and_cross_resume(shard_results, tmp_path):
+    """Checkpoints are written in HOST layout (sharded state is
+    unsharded and sliced back to the real node count first), so the
+    blob on disk is byte-identical across shard counts — and a
+    replicated checkpoint resumes under a sharded engine."""
+    eng1, ref = shard_results[1]
+    eng4, _ = shard_results[4]
+    digests = {}
+    for s, eng in ((1, eng1), (4, eng4)):
+        p = tmp_path / f"ckpt{s}.npz"
+        res = eng.replay(checkpoint_path=str(p), checkpoint_every=2)
+        np.testing.assert_array_equal(res.assignments, ref.assignments)
+        digests[s] = hashlib.sha256(p.read_bytes()).hexdigest()
+    assert digests[1] == digests[4], (
+        "checkpoint blob differs between replicated and node-sharded "
+        "engines — the sharded path is leaking device layout to disk"
+    )
+    # Replicated-written blob, sharded resume: identical end state.
+    res = eng4.replay(checkpoint_path=str(tmp_path / "ckpt1.npz"), resume=True)
+    np.testing.assert_array_equal(res.assignments, ref.assignments)
+
+
+def test_paged_parity(shard_results):
+    """Paged pod waves change residency, not results: paged ≡ unpaged on
+    the replicated engine, and paged+sharded ≡ replicated."""
+    ec, ep = _case()
+    _, ref = shard_results[1]
+    for shards in (1, 4):
+        eng = JaxReplayEngine(
+            ec, ep, FrameworkConfig(), chunk_waves=4,
+            node_shards=shards, paged=True, telemetry="off",
+        )
+        res = eng.replay()
+        np.testing.assert_array_equal(
+            res.assignments, ref.assignments,
+            err_msg=f"paged (node_shards={shards}): assignments diverged",
+        )
+        assert _stable_summary(res) == _stable_summary(ref)
+
+
+def test_pack_waves_rejects_page_smaller_than_gang():
+    """Satellite bugfix: a page smaller than the largest gang would
+    split the gang across page evictions — refuse up front, actionably."""
+    from kubernetes_simulator_tpu.sim.waves import pack_waves
+
+    _, ep = _case(n_pods=64)
+    pods, _ = make_workload(
+        64, seed=7, gang_fraction=0.5, gang_size=8,
+    )
+    _, ep = encode(make_cluster(8, seed=7), pods)
+    with pytest.raises(ValueError, match="largest gang"):
+        pack_waves(ep, 8, page_pods=4)
+    # Page >= largest gang: packs fine.
+    assert pack_waves(ep, 8, page_pods=8).idx.shape[1] == 8
+
+
+def test_replicated_refusal_gate(monkeypatch):
+    """KSIM_MAX_REPLICATED_BYTES refuses the replicated path past the
+    budget (pointing at node_shards/paged); the sharded engine
+    constructs under the same budget."""
+    ec, ep = _case(n_pods=64)
+    assert replicated_resident_bytes(ec, ep) > 1000
+    monkeypatch.setenv("KSIM_MAX_REPLICATED_BYTES", "1000")
+    with pytest.raises(ValueError, match="KSIM_MAX_REPLICATED_BYTES"):
+        JaxReplayEngine(ec, ep, FrameworkConfig())
+    eng = JaxReplayEngine(ec, ep, FrameworkConfig(), node_shards=2)
+    assert eng.node_shards == 2
+
+
+def test_knob_combination_raises():
+    ec, ep = _case(n_pods=64)
+    with pytest.raises(ValueError, match="tier preemption"):
+        JaxReplayEngine(
+            ec, ep, FrameworkConfig(), node_shards=2, preemption="tier"
+        )
+    with pytest.raises(ValueError, match="paged=True is not supported"):
+        JaxReplayEngine(ec, ep, FrameworkConfig(), paged=True, retry_buffer=8)
+
+
+def test_whatif_rejects_node_shards():
+    from kubernetes_simulator_tpu.sim.whatif import (
+        WhatIfEngine,
+        uniform_scenarios,
+    )
+
+    ec, ep = _case(n_pods=64)
+    scen = uniform_scenarios(ec, 2, seed=0)
+    with pytest.raises(NotImplementedError, match="node_shards"):
+        WhatIfEngine(ec, ep, scen, FrameworkConfig(), node_shards=2)
+
+
+# ── DCN gather payload compression (round-14 satellite) ──────────────
+
+
+def _roundtrip(payload):
+    from kubernetes_simulator_tpu.parallel.dcn import (
+        _pack_leaf,
+        _unpack_leaf,
+        _walk_payload,
+    )
+
+    packed = _walk_payload(payload, _pack_leaf)
+    return packed, _walk_payload(packed, _unpack_leaf)
+
+
+def test_dcn_compression_byte_parity():
+    from kubernetes_simulator_tpu.parallel.dcn import _PackedArray
+
+    rng = np.random.default_rng(0)
+    payload = {
+        "assignments": rng.integers(-1, 500, size=(4, 4096), dtype=np.int32),
+        "placed": rng.integers(0, 4096, size=(4,), dtype=np.int64),
+        "util": rng.random((4,), dtype=np.float32),
+        "nested": [np.arange(2048, dtype=np.int64), None],
+        "tiny": np.arange(8, dtype=np.int32),  # below the size floor
+    }
+    packed, out = _roundtrip(payload)
+    # The large int planes actually took the packed path...
+    assert isinstance(packed["assignments"], _PackedArray)
+    assert packed["assignments"].codec == "delta-zlib"
+    # ...small/float leaves pass through untouched...
+    assert packed["util"] is payload["util"]
+    assert packed["tiny"] is payload["tiny"]
+    # ...and the decode is byte-exact, dtype and shape included.
+    for k in ("assignments", "placed", "util", "tiny"):
+        assert out[k].dtype == payload[k].dtype
+        np.testing.assert_array_equal(out[k], payload[k])
+    np.testing.assert_array_equal(out["nested"][0], payload["nested"][0])
+    assert out["nested"][1] is None
+
+
+def test_dcn_compression_delta_overflow_fallback():
+    """int64 values whose DELTAS fit int32 use the delta codec even when
+    the values don't; deltas past int32 fall back to raw zlib — both
+    byte-exact."""
+    from kubernetes_simulator_tpu.parallel.dcn import _PackedArray
+
+    # Monotone int64 whose VALUES overflow int32 but whose deltas (the
+    # first delta is the first value — prepend 0) all fit -> delta-zlib.
+    big_sorted = np.cumsum(np.full(4096, 1 << 20, dtype=np.int64))
+    assert big_sorted.max() > np.iinfo(np.int32).max
+    # Alternating extremes: deltas overflow int32 -> raw zlib fallback.
+    extremes = np.empty(4096, dtype=np.int64)
+    extremes[0::2], extremes[1::2] = np.iinfo(np.int64).min // 2, \
+        np.iinfo(np.int64).max // 2
+    packed, out = _roundtrip({"a": big_sorted, "b": extremes})
+    assert isinstance(packed["a"], _PackedArray)
+    assert packed["a"].codec == "delta-zlib"
+    if isinstance(packed["b"], _PackedArray):  # incompressible may pass raw
+        assert packed["b"].codec == "zlib"
+    np.testing.assert_array_equal(out["a"], big_sorted)
+    np.testing.assert_array_equal(out["b"], extremes)
