@@ -33,7 +33,9 @@ durations), BENCH_TUNE_POP / BENCH_TUNE_SCEN (the ``tune_popsweep``
 detail headline: candidate-policies/sec through the policy tuner's
 batched sweep — the config2 search space, i.e. the full default plugin
 set's 5 Score weights plus the NodeResourcesFit strategy selector; 0
-population disables).
+population disables), BENCH_RECOVERY (0 skips the ``detail.dcn_recovery``
+cost block), BENCH_RECOVERY_REPS, BENCH_CKPT_EVERY (cadence for the
+fleet-only publication-overhead run).
 
 Round 12: ``--profile`` (or ``KSIM_PROFILE_DIR=<dir>``) wraps the timed
 headline runs in ``jax.profiler.trace`` with TraceAnnotation markers on
@@ -187,6 +189,81 @@ def main():
                     "run bench.py without dcn_launch.py for the "
                     "weak/strong + continuity anchors"
                 ),
+            }
+        }
+
+    # Elastic-recovery costs (round 15) — informational detail only
+    # (bench_compare.py never gates on it). The headline timed runs
+    # above keep checkpoint publication OFF (KSIM_DCN_CKPT_EVERY
+    # defaults to 0), so ``value`` and the dcn_scaling block are
+    # byte-unchanged by this block existing; it prices what turning
+    # recovery on would cost:
+    #   * codec walls: pack→pickle→b64 round-trip of a carrier-shaped
+    #     snapshot (states [S_head, pods] + outs) — the per-publication
+    #     CPU cost, and the restore cost a claimant pays before
+    #     re-entering the chunk loop (failure DETECTION adds
+    #     KSIM_DCN_STALL_S on top — a knob, not a measurement).
+    #   * publish_overhead_pct: one extra replay with publication
+    #     forced on (BENCH_CKPT_EVERY, default 8) against the headline
+    #     median. Fleet-only — publish_checkpoint no-ops single-process
+    #     — so the key is null outside dcn_launch.py.
+    rec_block = {}
+    if int(os.environ.get("BENCH_RECOVERY", "1") or 0):
+        from kubernetes_simulator_tpu.parallel.dcn import (
+            _decode_payload,
+            _encode_payload,
+        )
+
+        rng = np.random.default_rng(15)
+        snap = {
+            "cursor": 7,
+            "leaves": {
+                "states": rng.integers(
+                    -1, nodes, size=(S_head, len(pods)), dtype=np.int32
+                ),
+            },
+            "outs": rng.random((S_head, 8)).astype(np.float32),
+        }
+        raw_mib = (
+            snap["leaves"]["states"].nbytes + snap["outs"].nbytes
+        ) / 2**20
+        reps = max(1, int(os.environ.get("BENCH_RECOVERY_REPS", 3)))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            chunks = _encode_payload(snap)
+        enc_s = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _decode_payload(chunks)
+        dec_s = (time.perf_counter() - t0) / reps
+
+        publish_overhead_pct = None
+        if nproc > 1 and med_wall > 0:
+            prev_ck = os.environ.get("KSIM_DCN_CKPT_EVERY")
+            os.environ["KSIM_DCN_CKPT_EVERY"] = str(
+                max(1, int(os.environ.get("BENCH_CKPT_EVERY", 8)))
+            )
+            try:
+                wall_ck = eng_head.run().wall_clock_s
+            finally:
+                if prev_ck is None:
+                    os.environ.pop("KSIM_DCN_CKPT_EVERY", None)
+                else:
+                    os.environ["KSIM_DCN_CKPT_EVERY"] = prev_ck
+            publish_overhead_pct = round(
+                100.0 * (wall_ck - med_wall) / med_wall, 1
+            )
+        rec_block = {
+            "dcn_recovery": {
+                "recover_enabled": dcn.recover_enabled(),
+                "ckpt_every": dcn.ckpt_every(),
+                "ckpt_raw_mib": round(raw_mib, 2),
+                "ckpt_blob_mib": round(
+                    sum(len(c) for c in chunks) / 2**20, 2
+                ),
+                "ckpt_encode_s": round(enc_s, 4),
+                "ckpt_publish_overhead_pct": publish_overhead_pct,
+                "recovery_restore_wall_s": round(dec_s, 4),
             }
         }
 
@@ -419,6 +496,7 @@ def main():
                         {"profile_dir": prof_dir} if prof_dir else {}
                     ),
                     **dcn_block,
+                    **rec_block,
                     **scaling,
                     **cont,
                     **tune_sweep,

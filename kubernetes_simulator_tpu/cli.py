@@ -256,6 +256,45 @@ def cmd_tune(args) -> int:
     return 0
 
 
+def _recovery_errors(cfg) -> list:
+    """Actionable refusals for the ``dcn.recovery`` section (round 15).
+    Shared by validate_config and the pre-dispatch env export in main():
+    enabling survivor recovery outside a DCN fleet, or with the liveness
+    heartbeats its failure detector rides on disabled, must fail with a
+    message naming the fix — not silently no-op."""
+    rec = getattr(cfg, "dcn_recovery", None)
+    if rec is None:
+        return []
+    errors = []
+    if rec.checkpoint_every < 0:
+        errors.append(
+            "dcn.recovery.checkpointEvery: must be >= 0 (0 disables "
+            "checkpoint publication; a claimed block then re-executes "
+            "from chunk 0)"
+        )
+    if rec.max_claims < 1:
+        errors.append(
+            "dcn.recovery.maxClaims: must be >= 1 (each dead block "
+            "needs at least one claim generation)"
+        )
+    if not rec.enable:
+        return errors
+    if int(os.environ.get("KSIM_DCN_NPROC", "1") or 1) <= 1:
+        errors.append(
+            "dcn.recovery.enable: survivor recovery needs a multi-process "
+            "DCN fleet — launch through scripts/dcn_launch.py (--elastic N "
+            "adds spare claimants); KSIM_DCN_NPROC is unset/1, so there is "
+            "no sibling to claim a dead block"
+        )
+    if dcn.heartbeat_every() == 0:
+        errors.append(
+            "dcn.recovery.enable: recovery needs liveness heartbeats — "
+            "remove KSIM_DCN_HEARTBEAT_EVERY=0 (stale beacons are the "
+            "failure detector that opens claims)"
+        )
+    return errors
+
+
 def validate_config(cfg) -> list:
     """Structural checks → list of actionable error strings (empty = ok)."""
     from .framework.registry import available_strategies
@@ -483,6 +522,7 @@ def validate_config(cfg) -> list:
             "devicePreemption requires strategy: jax (the cpu engine runs "
             "kube PostFilter preemption instead)"
         )
+    errors.extend(_recovery_errors(cfg))
     return errors
 
 
@@ -526,6 +566,30 @@ def main(argv=None) -> int:
             )
         p.set_defaults(fn=fn)
     args = ap.parse_args(argv)
+    # Config-driven recovery knobs (round 15, dcn.recovery:) must land in
+    # the env BEFORE jax.distributed bring-up — the coordination-service
+    # failure-detector widening reads KSIM_DCN_RECOVER at initialize.
+    # setdefault: an operator's explicit env always wins over the YAML.
+    if args.cmd != "validate":
+        try:
+            cfg_pre = SimConfig.load(args.config)
+        except Exception:
+            cfg_pre = None  # the command fn reports config errors itself
+        rec = cfg_pre.dcn_recovery if cfg_pre is not None else None
+        if rec is not None and rec.enable:
+            errors = _recovery_errors(cfg_pre)
+            if errors:
+                for e in errors:
+                    log.error("config: %s", e)
+                return 2
+            os.environ.setdefault("KSIM_DCN_RECOVER", "1")
+            if rec.checkpoint_every:
+                os.environ.setdefault(
+                    "KSIM_DCN_CKPT_EVERY", str(rec.checkpoint_every)
+                )
+            os.environ.setdefault(
+                "KSIM_DCN_MAX_CLAIMS", str(rec.max_claims)
+            )
     # Multi-host DCN bring-up (round 11): a no-op without the
     # KSIM_DCN_* env set by scripts/dcn_launch.py. Enables the compile
     # cache BEFORE jax.distributed.initialize (documented ordering).

@@ -174,16 +174,44 @@ def process_info() -> tuple:
     return jax.process_count(), jax.process_index()
 
 
+def spare_count() -> int:
+    """Processes at the TAIL of the pid range that own no scenario block
+    (``KSIM_DCN_SPARES``, round 15). Spares skip the chunk loop, sit in
+    the gather, and exist only to claim dead/straggling workers' blocks
+    — the ``--elastic`` late-joiner capacity of scripts/dcn_launch.py."""
+    try:
+        return max(int(os.environ.get("KSIM_DCN_SPARES", "0")), 0)
+    except ValueError:
+        return 0
+
+
+def worker_count() -> int:
+    """Processes that own a scenario block (process_count - spares)."""
+    nproc, _ = process_info()
+    return max(nproc - spare_count(), 1)
+
+
+def is_spare() -> bool:
+    _, pid = process_info()
+    return pid >= worker_count()
+
+
 def local_slice(n_global: int) -> slice:
     """This process's contiguous block of a length-``n_global`` leading
-    axis (requires ``n_global % process_count == 0``). The block order
+    axis (requires ``n_global % worker_count == 0``). The block order
     matches a global ``make_mesh()`` scenario sharding: ``jax.devices()``
     orders devices by process, so process p's local shards hold exactly
     rows ``[p*n/np, (p+1)*n/np)`` — which is what makes the sliced run's
-    concatenated results bit-identical to the single-process mesh run."""
-    nproc, pid = process_info()
-    per = n_global // nproc
-    return slice(pid * per, (pid + 1) * per)
+    concatenated results bit-identical to the single-process mesh run.
+
+    Spare processes (round 15) own nothing; they are handed the LAST
+    worker's block purely so engine construction sees valid shapes —
+    ``WhatIfEngine`` marks them ``_dcn_spare`` and never runs the chunks."""
+    workers = worker_count()
+    _, pid = process_info()
+    per = n_global // workers
+    p = min(pid, workers - 1)
+    return slice(p * per, (p + 1) * per)
 
 
 def localize_mesh(mesh):
@@ -310,6 +338,7 @@ def heartbeat(
         # tails these instead. Atomic replace — readers never see a torn
         # write.
         try:
+            os.makedirs(hb_dir, exist_ok=True)
             tmp = os.path.join(hb_dir, f".p{pid}.tmp")
             with open(tmp, "w") as f:
                 f.write(blob)
@@ -355,6 +384,247 @@ def read_heartbeats() -> Dict[int, dict]:
     return out
 
 
+# -- recoverable work-queue (round 15) ---------------------------------------
+#
+# The static "process p owns block p forever" slicing becomes recoverable:
+# workers periodically publish compressed checkpoint blobs of their block
+# state to the KV store (riding the round-14 delta+zlib codec), and a
+# survivor that detects a stale sibling beacon while sitting in the gather
+# CLAIMS the dead process's block (compare-and-set on a write-once key —
+# single-claimant), re-executes it from the newest checkpoint, and
+# publishes the dead pid's gather payload in its stead. Everything is
+# deterministic, so the gathered result is byte-identical to a no-failure
+# run. All of it is opt-in: with KSIM_DCN_RECOVER unset the round-12
+# attributed DcnGatherTimeout behavior is unchanged.
+
+CKPT_PREFIX = "ksim/ckpt"
+CLAIM_PREFIX = "ksim/claim"
+
+
+def recover_enabled() -> bool:
+    """Survivor rebalance on a stale beacon (``KSIM_DCN_RECOVER``;
+    default off — the round-12 attributed fail-fast stays the default)."""
+    return str(
+        os.environ.get("KSIM_DCN_RECOVER", "0")
+    ).strip().lower() in ("1", "true", "yes", "on")
+
+
+def ckpt_every() -> int:
+    """Chunk cadence for :func:`publish_checkpoint` (``KSIM_DCN_CKPT_EVERY``,
+    default 0 = no checkpoint publication; recovery then re-executes a
+    claimed block from chunk 0 — still byte-identical, just slower)."""
+    try:
+        return max(int(os.environ.get("KSIM_DCN_CKPT_EVERY", "0")), 0)
+    except ValueError:
+        return 0
+
+
+def max_claims() -> int:
+    """Claim generations per dead block (``KSIM_DCN_MAX_CLAIMS``): if the
+    claimant of generation g itself goes stale mid-recovery, survivors
+    open generation g+1, up to this cap (then the attributed timeout)."""
+    try:
+        return max(int(os.environ.get("KSIM_DCN_MAX_CLAIMS", "2")), 1)
+    except ValueError:
+        return 2
+
+
+def _encode_payload(payload) -> list:
+    """pack → pickle → base64 → gRPC-cap-sized chunks (shared by the
+    gather publication and the checkpoint blobs)."""
+    packed = _walk_payload(payload, _pack_leaf)
+    blob = base64.b64encode(
+        pickle.dumps(packed, protocol=pickle.HIGHEST_PROTOCOL)
+    ).decode("ascii")
+    return [
+        blob[i : i + _KV_CHUNK] for i in range(0, len(blob), _KV_CHUNK)
+    ] or [""]
+
+
+def _decode_payload(chunks) -> object:
+    return _walk_payload(
+        pickle.loads(base64.b64decode("".join(chunks))), _unpack_leaf
+    )
+
+
+def _mirror_event(event: dict) -> None:
+    """Append one claim/recovery event line to ``$KSIM_DCN_HB_DIR/
+    events.jsonl`` so out-of-fleet monitors (dcn_launch --watch) can
+    surface a rebalance live. Best-effort; single ``write`` of one line
+    keeps concurrent appenders from tearing each other."""
+    hb_dir = os.environ.get("KSIM_DCN_HB_DIR")
+    if not hb_dir:
+        return
+    try:
+        os.makedirs(hb_dir, exist_ok=True)
+        line = json.dumps(dict(event, t=time.time()), sort_keys=True)
+        with open(os.path.join(hb_dir, "events.jsonl"), "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+# Pids this process observed dead past the stall window with recovery on
+# (claimed by us or by a sibling). Non-empty ⇒ the fleet is DEGRADED: the
+# collective jax.distributed shutdown can never complete (a dead task
+# never joins the shutdown barrier) and must be skipped at exit.
+DEGRADED: set = set()
+_EXIT_CODE = [0]
+_degraded_exit_armed = [False]
+
+
+def _arm_degraded_exit() -> None:
+    """A fleet that lost a process must never reach the jax.distributed
+    client teardown: the dead task cannot join the shutdown barrier, and
+    the coordination service's propagated error ABORTS every healthy
+    task (xla's client.h "Terminating process ... fatal errors" —
+    SIGABRT after the survivor already printed its byte-identical
+    result). Armed the moment a stale sibling is detected with recovery
+    on: an atexit hook — registered after jax's machinery, so it runs
+    FIRST — flushes stdio and hard-exits. An uncaught exception still
+    exits nonzero (sys.excepthook runs before atexit and records it)."""
+    if _degraded_exit_armed[0]:
+        return
+    _degraded_exit_armed[0] = True
+    import atexit
+    import sys
+
+    prev_hook = sys.excepthook
+
+    def _failing_hook(tp, val, tb):
+        _EXIT_CODE[0] = 1
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = _failing_hook
+
+    def _hard_exit():
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os._exit(_EXIT_CODE[0])
+
+    atexit.register(_hard_exit)
+
+
+def checkpoint_epoch() -> int:
+    """Namespace for this replay's checkpoints: the sequence number the
+    end-of-replay gather WILL use (``_seq + 1``). Keeps a resumed claim
+    from ever reading a previous replay's blobs."""
+    return _seq + 1
+
+
+def gather_seq() -> int:
+    """Sequence number of the gather currently in flight — equal to the
+    epoch under which this replay's checkpoints were published. Valid
+    while inside :func:`gather` (recovery callbacks capture it so the
+    resume path reads THIS replay's blobs, not a previous one's)."""
+    return _seq
+
+
+def publish_checkpoint(
+    cursor: int, payload, block: tuple, epoch: Optional[int] = None
+) -> bool:
+    """Publish this process's block-state checkpoint at chunk ``cursor``
+    under ``ksim/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>``. The chunk-count
+    manifest key (``/n``) is written LAST, so a reader that finds a
+    manifest never sees a torn blob. Defensive like :func:`heartbeat`:
+    returns False (never raises) outside DCN or on any KV failure."""
+    try:
+        nproc, pid = process_info()
+        if nproc <= 1:
+            return False
+        c = _client()
+        chunks = _encode_payload(payload)
+        lo, hi = int(block[0]), int(block[1])
+        ep = checkpoint_epoch() if epoch is None else int(epoch)
+        prefix = f"{CKPT_PREFIX}/{ep}/{pid}/{lo}-{hi}/{int(cursor)}"
+        for j, ch in enumerate(chunks):
+            c.key_value_set(f"{prefix}/{j}", ch, allow_overwrite=True)
+        c.key_value_set(
+            f"{prefix}/n", str(len(chunks)), allow_overwrite=True
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load_checkpoint(pid: int, epoch: Optional[int] = None):
+    """Newest complete checkpoint published by ``pid`` this replay:
+    ``{"cursor", "block": (lo, hi), "payload"}``, or None when ``pid``
+    never published one (the claimant then re-executes from chunk 0).
+    One directory read, no blocking waits — the publisher is dead."""
+    try:
+        c = _client()
+        ep = checkpoint_epoch() if epoch is None else int(epoch)
+        entries = c.key_value_dir_get(f"{CKPT_PREFIX}/{ep}/{int(pid)}")
+    except Exception:
+        return None
+    table: Dict[tuple, Dict[str, str]] = {}
+    for key, val in entries:
+        parts = str(key).strip("/").split("/")
+        if len(parts) < 3:
+            continue
+        blk, cur, leaf = parts[-3], parts[-2], parts[-1]
+        table.setdefault((blk, cur), {})[leaf] = val
+    best = None
+    for (blk, cur), kv in table.items():
+        if "n" not in kv:
+            continue  # manifest not yet written — torn/in-flight blob
+        try:
+            cursor = int(cur)
+            n = int(kv["n"])
+            lo, hi = (int(x) for x in blk.split("-"))
+            chunks = [kv[str(j)] for j in range(n)]
+        except (KeyError, ValueError):
+            continue
+        if best is None or cursor > best[0]:
+            best = (cursor, (lo, hi), chunks)
+    if best is None:
+        return None
+    try:
+        payload = _decode_payload(best[2])
+    except Exception:
+        return None
+    return {"cursor": best[0], "block": best[1], "payload": payload}
+
+
+def try_claim(dead_pid: int, gen: int, name: str = "whatif") -> bool:
+    """Compare-and-set claim on ``dead_pid``'s block for the CURRENT
+    gather: ``key_value_set`` without ``allow_overwrite`` fails when the
+    key exists, so exactly one process wins generation ``gen``. Claim
+    metadata (claimant pid, block owner, generation, wall time) is the
+    value, for attribution of a second failure during recovery."""
+    nproc, pid = process_info()
+    meta = {
+        "claimant": int(pid),
+        "for": int(dead_pid),
+        "gen": int(gen),
+        "t": time.time(),
+    }
+    try:
+        _client().key_value_set(
+            f"{CLAIM_PREFIX}/{_seq}/{name}/{int(dead_pid)}/{int(gen)}",
+            json.dumps(meta, sort_keys=True),
+        )
+        return True
+    except Exception:
+        return False
+
+
+def read_claim(dead_pid: int, gen: int, name: str = "whatif"):
+    """Metadata of an existing claim (None when absent/unreadable)."""
+    try:
+        val = _client().blocking_key_value_get(
+            f"{CLAIM_PREFIX}/{_seq}/{name}/{int(dead_pid)}/{int(gen)}",
+            2000,
+        )
+        return json.loads(val)
+    except Exception:
+        return None
+
+
 class DcnGatherTimeout(RuntimeError):
     """gather() abandoned: a sibling never published its payload. Carries
     the missing pids and the heartbeat table for programmatic use."""
@@ -384,18 +654,106 @@ def _describe_process(p: int, hb: Dict[int, dict], now: float) -> str:
     return ", ".join(parts)
 
 
-def _get_attributed(c, key: str, p: int, name: str):
+def _publish_for(c, prefix: str, pid: int, payload) -> None:
+    """Publish a gather payload under ``pid``'s keys (used by a claimant
+    standing in for a dead sibling, and by :func:`gather` itself). When
+    recovery is enabled an already-existing key is tolerated: a presumed-
+    dead straggler that publishes after its block was absorbed collides
+    with the claimant's byte-identical publication — first writer wins."""
+    chunks = _encode_payload(payload)
+    tolerant = recover_enabled()
+    try:
+        for j, ch in enumerate(chunks):
+            c.key_value_set(f"{prefix}/{pid}/{j}", ch)
+        c.key_value_set(f"{prefix}/{pid}/n", str(len(chunks)))
+    except Exception:
+        if not tolerant:
+            raise
+        from ..utils.metrics import log
+
+        log.warning(
+            "dcn: gather keys for process %d already exist — block was "
+            "published by another claimant (or the straggler itself); "
+            "keeping the first write",
+            pid,
+        )
+
+
+def _maybe_recover(c, prefix: str, p: int, name: str, recover) -> bool:
+    """Survivor rebalance (round 15): ``p``'s beacon is stale and recovery
+    is on. Claim generations 0..max_claims-1 of ``p``'s block; on a CAS
+    win, rebuild the block via ``recover(p)`` (checkpoint resume inside)
+    and publish it under ``p``'s gather keys. On a CAS loss, defer to a
+    LIVE claimant (keep polling for its publication); a claimant that is
+    itself stale opens the next generation — the second-failure-during-
+    recovery path. Returns False when generations are exhausted (caller
+    raises the attributed timeout)."""
+    from ..utils.metrics import log
+
+    _, me = process_info()
+    stall = _stall_s()
+    for gen in range(max_claims()):
+        if try_claim(p, gen, name=name):
+            log.warning(
+                "dcn: process %d claims dead process %d's block "
+                "(gen %d) — resuming from its newest checkpoint",
+                me, p, gen,
+            )
+            _mirror_event(
+                {"event": "claim", "claimant": int(me), "for": int(p),
+                 "gen": int(gen)}
+            )
+            t0 = time.monotonic()
+            payload = recover(p)
+            _publish_for(c, prefix, p, payload)
+            log.warning(
+                "dcn: process %d resumed and republished process %d's "
+                "block in %.1fs", me, p, time.monotonic() - t0,
+            )
+            _mirror_event(
+                {"event": "recovered", "claimant": int(me), "for": int(p),
+                 "gen": int(gen),
+                 "wall_s": round(time.monotonic() - t0, 3)}
+            )
+            return True
+        claim = read_claim(p, gen, name=name)
+        claimant = None if claim is None else int(claim.get("claimant", -1))
+        if claimant is None or claimant == me:
+            return True  # our own (or unreadable) claim — poll for keys
+        # A claim younger than the stall window gets the benefit of the
+        # doubt even without a fresh beacon — the claimant may still be
+        # building its recovery engine (compile warm-up beats nothing).
+        claim_age = time.time() - float(claim.get("t", 0.0))
+        b = read_heartbeats().get(claimant)
+        beat_age = (
+            None if b is None else time.time() - float(b.get("t", 0.0))
+        )
+        if claim_age <= stall or beat_age is None or beat_age <= stall:
+            return True  # live claimant is recovering — wait for it
+        # Claimant died mid-recovery too: open the next generation.
+        log.warning(
+            "dcn: claimant %d of process %d's block (gen %d) went stale "
+            "itself — opening generation %d", claimant, p, gen, gen + 1,
+        )
+    return False
+
+
+def _get_attributed(c, key: str, p: int, name: str, recover=None):
     """``blocking_key_value_get`` as a short poll loop: each expiry
     inspects sibling heartbeats. A sibling whose beacon has gone stale
-    past KSIM_DCN_STALL_S while we sit in the gather is presumed dead and
-    the wait is abandoned IMMEDIATELY with an attributed
-    :class:`DcnGatherTimeout` — instead of the anonymous hang to the full
-    KSIM_DCN_TIMEOUT_S. A sibling with a fresh beacon (or none at all —
-    heartbeats may be disabled) keeps the round-11 semantics: wait to the
-    full deadline, then raise with whatever attribution exists."""
+    past KSIM_DCN_STALL_S while we sit in the gather is presumed dead.
+    With recovery off (default) the wait is abandoned IMMEDIATELY with an
+    attributed :class:`DcnGatherTimeout` — instead of the anonymous hang
+    to the full KSIM_DCN_TIMEOUT_S. With KSIM_DCN_RECOVER on and a
+    ``recover`` callback, the dead block is claimed and re-executed
+    (:func:`_maybe_recover`) and the wait continues. A sibling with a
+    fresh beacon (or none at all — heartbeats may be disabled) keeps the
+    round-11 semantics: wait to the full deadline, then raise with
+    whatever attribution exists."""
     deadline = time.monotonic() + _timeout_ms() / 1000.0
     poll_ms = max(int(_poll_s() * 1000), 50)
     stall = _stall_s()
+    prefix = key.rsplit("/", 2)[0]
     while True:
         remaining_ms = int((deadline - time.monotonic()) * 1000)
         if remaining_ms <= 0:
@@ -416,6 +774,11 @@ def _get_attributed(c, key: str, p: int, name: str):
             if b is not None and (
                 time.time() - float(b.get("t", 0.0))
             ) > stall:
+                if recover is not None and recover_enabled():
+                    DEGRADED.add(p)
+                    _arm_degraded_exit()
+                    if _maybe_recover(c, prefix, p, name, recover):
+                        continue  # claimed/claimant publishing — poll again
                 raise DcnGatherTimeout(
                     f"gather({name!r}): process {p} looks DEAD — "
                     f"{_describe_process(p, hb, time.time())}; its beacon "
@@ -431,7 +794,7 @@ def _get_attributed(c, key: str, p: int, name: str):
             # (heartbeats disabled) — keep waiting toward the deadline.
 
 
-def gather(name: str, payload) -> list:
+def gather(name: str, payload, recover=None) -> list:
     """THE cross-process gather: publish this process's ``payload`` and
     return every process's, in process order. Called at most once per
     replay (result assembly); the chunk loop never reaches it.
@@ -441,18 +804,24 @@ def gather(name: str, payload) -> list:
     coordination service's gRPC message cap. Keys carry a monotonically
     increasing sequence number, so repeated replays in one process
     lifetime never collide — provided every process gathers in the same
-    order (SPMD discipline, same as collectives)."""
+    order (SPMD discipline, same as collectives).
+
+    ``recover`` (round 15): ``recover(dead_pid) -> payload`` rebuilds a
+    dead sibling's block deterministically. With KSIM_DCN_RECOVER on, a
+    stale beacon routes through the claim protocol (:func:`_maybe_recover`)
+    instead of raising, and the gather still completes in full."""
     global GATHER_COUNT, _seq
     nproc, pid = process_info()
     _seq += 1
     GATHER_COUNT += 1
     c = _client()
     # Round 14: delta+zlib the large integer tensors before the KV put —
-    # remote payloads decode through _unpack_leaf below; the LOCAL payload
-    # is returned as-is (it never crosses the wire), so compression is
-    # invisible to callers either way.
+    # remote payloads decode through _unpack_leaf in _decode_payload; the
+    # LOCAL payload is returned as-is (it never crosses the wire), so
+    # compression is invisible to callers either way.
     raw0, comp0 = COMPRESS_BYTES
-    packed = _walk_payload(payload, _pack_leaf)
+    prefix = f"ksim/gather/{_seq}/{name}"
+    _publish_for(c, prefix, pid, payload)
     if COMPRESS_BYTES[0] > raw0:
         from ..utils.metrics import log
 
@@ -464,31 +833,22 @@ def gather(name: str, payload) -> list:
             (COMPRESS_BYTES[1] - comp0) / 1024,
             (COMPRESS_BYTES[0] - raw0) / max(COMPRESS_BYTES[1] - comp0, 1),
         )
-    blob = base64.b64encode(
-        pickle.dumps(packed, protocol=pickle.HIGHEST_PROTOCOL)
-    ).decode("ascii")
-    chunks = [
-        blob[i : i + _KV_CHUNK] for i in range(0, len(blob), _KV_CHUNK)
-    ] or [""]
-    prefix = f"ksim/gather/{_seq}/{name}"
-    for j, ch in enumerate(chunks):
-        c.key_value_set(f"{prefix}/{pid}/{j}", ch)
-    c.key_value_set(f"{prefix}/{pid}/n", str(len(chunks)))
     out = []
     for p in range(nproc):
         if p == pid:
             out.append(payload)
             continue
-        n = int(_get_attributed(c, f"{prefix}/{p}/n", p, name))
-        remote = pickle.loads(
-            base64.b64decode(
-                "".join(
-                    _get_attributed(c, f"{prefix}/{p}/{j}", p, name)
-                    for j in range(n)
+        n = int(
+            _get_attributed(c, f"{prefix}/{p}/n", p, name, recover=recover)
+        )
+        out.append(
+            _decode_payload(
+                _get_attributed(
+                    c, f"{prefix}/{p}/{j}", p, name, recover=recover
                 )
+                for j in range(n)
             )
         )
-        out.append(_walk_payload(remote, _unpack_leaf))
     return out
 
 

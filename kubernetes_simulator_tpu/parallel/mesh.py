@@ -44,13 +44,43 @@ def init_distributed(
 ) -> None:
     """Multi-host bring-up over DCN ([K8S]-world has no equivalent; this is
     the TPU-native answer to a distributed communication backend). No-op for
-    single-process runs."""
-    if num_processes and num_processes > 1:
+    single-process runs.
+
+    With survivor recovery on (``KSIM_DCN_RECOVER``, round 15) the
+    coordination service's OWN failure detector is widened past the
+    gather deadline: its default ~100s tolerance would propagate a fatal
+    error that aborts every healthy task while a survivor is still
+    rebalancing the dead process's block. parallel.dcn's liveness
+    beacons (KSIM_DCN_STALL_S) stay the fast detector."""
+    if not (num_processes and num_processes > 1):
+        return
+    from . import dcn
+
+    if not dcn.recover_enabled():
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+        return
+    import os
+
+    from jax._src import distributed as _dist
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        raise RuntimeError(
+            "init_distributed() must be called before any JAX "
+            "computations are executed."
+        )
+    timeout_s = float(os.environ.get("KSIM_DCN_TIMEOUT_S", "300"))
+    _dist.global_state.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        service_heartbeat_interval_seconds=10,
+        service_max_missing_heartbeats=max(int(timeout_s / 5), 10),
+    )
 
 
 def make_mesh(num_devices: Optional[int] = None, axis: str = SCENARIO_AXIS) -> Mesh:

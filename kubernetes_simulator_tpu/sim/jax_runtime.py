@@ -750,6 +750,55 @@ def rebuild_fork_state(pods: EncodedPods, idx: np.ndarray, C: int, outs,
     return host_assign, released
 
 
+def snapshot_carriers(tree) -> list:
+    """Host-layout leaf list of a chunk-loop carrier tree (round 15 DCN
+    recovery checkpoints). Flattening drops the container structure on
+    purpose: the restoring process rebuilds an IDENTICAL fresh carrier
+    tree (same engine ctor args, deterministic dict order) and matches
+    leaves positionally, so NamedTuple/dataclass containers never need to
+    round-trip through the gather payload walker."""
+    import jax
+
+    return [
+        np.asarray(jax.device_get(leaf))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]
+
+
+def restore_carriers(tree, host_leaves):
+    """Inverse of :func:`snapshot_carriers` against a freshly-built
+    carrier ``tree`` of identical structure: each host leaf is cast to
+    the fresh leaf's dtype and ``device_put`` with the fresh leaf's
+    sharding, so the restored tree is layout-identical to one the chunk
+    loop produced locally. Raises ValueError on any structural mismatch —
+    callers treat that as \"checkpoint unusable\" and re-execute the
+    block from chunk 0 (still byte-identical, just slower)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    if len(flat) != len(host_leaves):
+        raise ValueError(
+            f"checkpoint carries {len(host_leaves)} leaves but the fresh "
+            f"carriers have {len(flat)} — engine modes differ"
+        )
+    out = []
+    for k, (fresh, host) in enumerate(zip(flat, host_leaves)):
+        host = np.asarray(host)
+        shape = tuple(getattr(fresh, "shape", np.shape(fresh)))
+        if shape != tuple(host.shape):
+            raise ValueError(
+                f"checkpoint leaf {k}: shape {tuple(host.shape)} != fresh "
+                f"{shape}"
+            )
+        dtype = getattr(fresh, "dtype", None)
+        if dtype is not None and host.dtype != np.dtype(dtype):
+            host = host.astype(dtype)
+        if isinstance(fresh, jax.Array):
+            host = jax.device_put(host, fresh.sharding)
+        out.append(host)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def rep_slots_for(static3, pods: EncodedPods):
     """(tol_reps, na_reps) PodSlot batches of class representatives. Empty
     gathers when the class path is off — keeps unused (possibly huge)

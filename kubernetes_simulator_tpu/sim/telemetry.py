@@ -247,7 +247,17 @@ class ReplayTelemetry:
         Raw ``bind_latency`` values are re-keyed by running index: merged
         parts span scenarios, so original pod ids collide and are not
         preserved. ``None`` parts (telemetry off) are skipped; returns
-        None when nothing remains."""
+        None when nothing remains.
+
+        Elastic recovery (round 15) keeps this merge byte-stable: a
+        survivor that claims a dead process's block republishes that
+        block's telemetry under the DEAD pid's gather slot, so parts
+        still arrive one per scenario block in global scenario order and
+        the result-bearing fields (latency/reasons/series/events)
+        bit-match the no-failure fleet. Only the ``p<pid>/<phase>``
+        timers are attributed to the block's pid while having been
+        *measured* on the claimant's host — wall clocks are
+        host-relative either way and are never compared across parts."""
         if process_ids is not None and len(process_ids) != len(parts):
             raise ValueError(
                 f"process_ids ({len(process_ids)}) must align 1:1 with "

@@ -522,6 +522,7 @@ class WhatIfEngine:
         telemetry=None,
         policies=None,
         node_shards: int = 0,
+        _dcn_recovery: Optional[dict] = None,
     ):
         """``fork_checkpoint``: path to a JaxReplayEngine checkpoint — the
         what-if FORK POINT (SURVEY.md §5 checkpoint/resume): every scenario
@@ -632,6 +633,13 @@ class WhatIfEngine:
         scenarios = list(scenarios)
         self.S_global = len(scenarios)
         self._dcn_sliced = False
+        self._dcn_spare = False
+        self._dcn_recovery = dict(_dcn_recovery) if _dcn_recovery else None
+        # Everything a survivor needs to rebuild a DEAD sibling's engine
+        # bit-identically (round 15): the FULL scenario list plus the raw
+        # ctor knobs. Captured only on the sliced path — recovery re-runs
+        # through a second WhatIfEngine with ``_dcn_recovery`` set.
+        self._dcn_rebuild: Optional[dict] = None
         self._proc_lo = 0
         self._dcn_prefer_taint = False
         self._dcn_scales_pods = False
@@ -641,7 +649,26 @@ class WhatIfEngine:
         # replay, at result assembly, never per chunk.
         self._replicate_count = 0
         nproc = jax.process_count()
-        if nproc > 1 and self.S_global:
+        if self._dcn_recovery is not None:
+            # Round 15 survivor rebalance: this engine re-executes a dead
+            # sibling's contiguous block. Slicing and the engine gates are
+            # dictated by the claimant (they were derived from the full
+            # list once, in the original ctor) — never re-derived, so the
+            # compiled chunk programs match the dead process's exactly.
+            lo, hi = (int(x) for x in self._dcn_recovery["block"])
+            self._dcn_prefer_taint = bool(
+                self._dcn_recovery.get("prefer_taint", False)
+            )
+            self._dcn_scales_pods = bool(
+                self._dcn_recovery.get("scales_pods", False)
+            )
+            scenarios = scenarios[lo:hi]
+            self._proc_lo = lo
+            if policies is not None:
+                pol_g = np.asarray(policies)
+                if pol_g.ndim == 2 and pol_g.shape[0] == self.S_global:
+                    policies = pol_g[lo:hi]
+        elif nproc > 1 and self.S_global:
             if any(
                 pt.op == "set_label"
                 for sc in scenarios
@@ -655,7 +682,8 @@ class WhatIfEngine:
                     "across process-local slices. Run label sweeps "
                     "single-process, or split them into their own batch."
                 )
-            if self.S_global % nproc == 0:
+            workers = dcn.worker_count()
+            if self.S_global % workers == 0:
                 self._dcn_prefer_taint = any(
                     pt.op == "add_taint"
                     and int(Effect.parse(pt.effect))
@@ -671,9 +699,30 @@ class WhatIfEngine:
                     for pt in sc.perturbations
                 )
                 sl = dcn.local_slice(self.S_global)
+                self._dcn_rebuild = dict(
+                    scenarios=list(scenarios),
+                    config=config,
+                    wave_width=wave_width,
+                    chunk_waves=chunk_waves,
+                    collect_assignments=collect_assignments,
+                    fork_checkpoint=fork_checkpoint,
+                    preemption=pmode,
+                    completions=completions,
+                    retry_buffer=retry_buffer,
+                    granularity_guard=granularity_guard,
+                    telemetry=telemetry,
+                    policies=(
+                        None if policies is None else np.asarray(policies)
+                    ),
+                )
                 scenarios = scenarios[sl]
                 self._proc_lo = sl.start
                 self._dcn_sliced = True
+                # Spare processes (KSIM_DCN_SPARES tail pids, round 15)
+                # own no block: construction proceeds on the mirrored
+                # slice for shapes only; run() skips the chunk loop and
+                # sits in the gather as claim-eligible elastic capacity.
+                self._dcn_spare = dcn.is_spare()
                 if policies is not None:
                     pol_g = np.asarray(policies)
                     if pol_g.ndim == 2 and pol_g.shape[0] == self.S_global:
@@ -682,11 +731,11 @@ class WhatIfEngine:
                 from ..utils.metrics import log
 
                 log.warning(
-                    "DCN: %d scenarios do not divide over %d processes — "
-                    "running fully replicated (every process computes "
-                    "all scenarios; no gather). Pad the batch to a "
-                    "multiple of the process count to scale.",
-                    self.S_global, nproc,
+                    "DCN: %d scenarios do not divide over %d worker "
+                    "processes — running fully replicated (every process "
+                    "computes all scenarios; no gather). Pad the batch to "
+                    "a multiple of the worker count to scale.",
+                    self.S_global, workers,
                 )
         mesh = dcn.localize_mesh(mesh)
         # Per-scenario timed failure/recovery timelines (chaos campaigns,
@@ -2150,10 +2199,168 @@ class WhatIfEngine:
             ]
         return stg
 
+    def _dcn_recover_block(self, dead_pid: int) -> dict:
+        """``recover`` callback for :func:`parallel.dcn.gather` (round
+        15): rebuild ``dead_pid``'s contiguous scenario block through a
+        fresh engine over THIS process's local mesh, resuming from the
+        dead process's newest published checkpoint when one exists. The
+        replay is deterministic, so the returned payload is byte-
+        identical to what ``dead_pid`` would have published itself."""
+        rb = self._dcn_rebuild
+        if rb is None:
+            raise RuntimeError(
+                "DCN recovery callback invoked on an engine that was "
+                "never scenario-sliced"
+            )
+        per = self.S_global // dcn.worker_count()
+        lo, hi = int(dead_pid) * per, (int(dead_pid) + 1) * per
+        if dcn.heartbeat_every() > 0:
+            # Immediate liveness under OUR pid with the claimed block
+            # named, BEFORE the (possibly compile-heavy) engine build —
+            # a second failure during recovery must be attributed to the
+            # claimant, and siblings must not open the next claim
+            # generation while we are still warming up.
+            dcn.heartbeat(
+                -1, block=(lo, hi), state="recover",
+                extra={"recovering_for": int(dead_pid)},
+            )
+        eng = WhatIfEngine(
+            self.ec, self.pods, rb["scenarios"],
+            config=rb["config"],
+            wave_width=rb["wave_width"],
+            chunk_waves=rb["chunk_waves"],
+            mesh=self.mesh,
+            collect_assignments=rb["collect_assignments"],
+            fork_checkpoint=rb["fork_checkpoint"],
+            preemption=rb["preemption"],
+            completions=rb["completions"],
+            retry_buffer=rb["retry_buffer"],
+            granularity_guard=rb["granularity_guard"],
+            telemetry=rb["telemetry"],
+            policies=rb["policies"],
+            _dcn_recovery=dict(
+                block=(lo, hi),
+                for_pid=int(dead_pid),
+                epoch=dcn.gather_seq(),
+                prefer_taint=self._dcn_prefer_taint,
+                scales_pods=self._dcn_scales_pods,
+            ),
+        )
+        res = eng.run()
+        return dict(
+            placed=res.placed,
+            assignments=res.assignments,
+            util=res.utilization_cpu,
+            preemptions=res.preemptions,
+            dropped=res.retry_dropped,
+            evictions=res.evictions,
+            resched=res.evict_rescheduled,
+            stranded=res.evict_stranded,
+            evict_lat=res.evict_latency_mean,
+            lat50=res.latency_p50,
+            lat90=res.latency_p90,
+            lat99=res.latency_p99,
+            frag_stranded=res.stranded_cpu,
+            frag_index=res.frag_index_cpu,
+            frag_pack=res.packing_efficiency,
+            telemetry=res.scenario_telemetry,
+            fleet=res.fleet_telemetry,
+        )
+
+    def _run_spare(self) -> WhatIfResult:
+        """Round 15 elastic spare (tail pids under ``KSIM_DCN_SPARES``):
+        owns no scenario block — publish liveness, enter the gather
+        immediately as claim-eligible capacity (its sentinel payload is
+        available at once, so no worker ever waits on a spare), and
+        assemble the same gathered result every worker returns. Fork
+        checkpoints are not supported on the spare path."""
+        from .telemetry import ReplayTelemetry
+
+        t0 = time.perf_counter()
+        if dcn.heartbeat_every() > 0:
+            dcn.heartbeat(-1, state="spare", wall_s=0.0)
+        parts = dcn.gather(
+            "whatif",
+            {"spare": True},
+            recover=(
+                self._dcn_recover_block
+                if self._dcn_rebuild is not None
+                else None
+            ),
+        )
+        parts = [
+            p for p in parts
+            if not (isinstance(p, dict) and p.get("spare"))
+        ]
+
+        def _cat(k):
+            if parts[0][k] is None:
+                return None
+            return np.concatenate([p[k] for p in parts], axis=0)
+
+        placed = _cat("placed")
+        fleet_tel = None
+        if parts[0].get("fleet") is not None:
+            fleet_tel = ReplayTelemetry.merge(
+                [p["fleet"] for p in parts],
+                process_ids=list(range(len(parts))),
+            )
+        wall = time.perf_counter() - t0
+        to_schedule = int((self.waves.idx >= 0).sum())
+        total = int(placed.sum())
+        ndev_local = (
+            int(self.mesh.devices.size) if self.mesh is not None else 1
+        )
+        dev_scale = len(parts)
+        return WhatIfResult(
+            placed=placed,
+            unschedulable=(to_schedule - placed).astype(np.int32),
+            total_placed=total,
+            wall_clock_s=wall,
+            placements_per_sec=total / wall if wall > 0 else 0.0,
+            assignments=_cat("assignments"),
+            utilization_cpu=_cat("util"),
+            completions_on=self.completions_on,
+            engine=self.engine,
+            preemptions=_cat("preemptions"),
+            retry_dropped=_cat("dropped"),
+            evictions=_cat("evictions"),
+            evict_rescheduled=_cat("resched"),
+            evict_stranded=_cat("stranded"),
+            evict_latency_mean=_cat("evict_lat"),
+            latency_p50=_cat("lat50"),
+            latency_p90=_cat("lat90"),
+            latency_p99=_cat("lat99"),
+            stranded_cpu=_cat("frag_stranded"),
+            frag_index_cpu=_cat("frag_index"),
+            packing_efficiency=_cat("frag_pack"),
+            scenario_telemetry=(
+                None
+                if parts[0]["telemetry"] is None
+                else [t for p in parts for t in p["telemetry"]]
+            ),
+            fleet_telemetry=fleet_tel,
+            n_devices=ndev_local * dev_scale,
+            mesh_shape=(
+                dict(zip(
+                    self.mesh.axis_names,
+                    (
+                        int(d) * dev_scale
+                        for d in self.mesh.devices.shape
+                    ),
+                ))
+                if self.mesh is not None
+                else None
+            ),
+            process_count=jax.process_count(),
+        )
+
     def run(self) -> WhatIfResult:
         # Per-run counter for the round-11 contract test: full-tensor
         # cross-process replication in _fetch must be 0 for this replay.
         self._replicate_count = 0
+        if self._dcn_spare:
+            return self._run_spare()
         states = self._init_states()  # sets fork bookkeeping first
         idx = self.waves.idx
         if self._fork_waves_done:
@@ -2360,6 +2567,15 @@ class WhatIfEngine:
             # non-gang failure count; the full choices fetch + mirror
             # folds run AFTER the next dispatch (overlapped) unless some
             # scenario's retry pass will actually read its mirror.
+            # Series telemetry disables the deferral entirely: every
+            # boundary SAMPLES the mirror's occupancy planes
+            # (BoundaryOps.boundary's tel.sample), so the fold must land
+            # pre-boundary at every chunk — otherwise WHICH boundaries
+            # see chunk ci-1's binds depends on the batch-mates' failure
+            # clustering, and the per-scenario gauge series would differ
+            # across DCN slicings of the same scenario list (round 15:
+            # survivor-rebuilt blocks must bit-match the dead process).
+            kwant_series = self.telemetry_cfg.want_series
             kube_ng = jnp.asarray(self.pods.group_id == PAD)
             if getattr(self, "_kfail_jit", None) is None:
                 self._kfail_jit = jax.jit(
@@ -2531,11 +2747,120 @@ class WhatIfEngine:
         _pann = _prof_ann if _prof else (lambda name: _null)
         n_chunks = len(range(0, idx.shape[0], C))
         # Liveness heartbeats (round 12): one overwritten KV beacon per
-        # process on a chunk cadence — plain puts, never a gather.
-        hb_on = self._dcn_sliced and dcn.heartbeat_every() > 0
+        # process on a chunk cadence — plain puts, never a gather. A
+        # recovery engine (round 15) beats too, under the CLAIMANT's own
+        # pid with state="recover" and the claimed block named, so a
+        # SECOND failure during recovery is attributed to the claimant.
+        recovering = self._dcn_recovery is not None
+        hb_on = (
+            self._dcn_sliced or recovering
+        ) and dcn.heartbeat_every() > 0
         hb_block = (self._proc_lo, self._proc_lo + self.S)
+        hb_kw = (
+            dict(
+                state="recover",
+                extra={
+                    "recovering_for": int(
+                        self._dcn_recovery.get("for_pid", -1)
+                    )
+                },
+            )
+            if recovering
+            else {}
+        )
+        # Recoverable work-queue (round 15, parallel.dcn): on a chunk
+        # cadence, publish a compressed host snapshot of the loop
+        # carriers so a survivor can resume THIS block mid-replay after
+        # a host loss. Supported on the device-carrier paths (plain
+        # v3/v2 and device-release ± retry, where the whole block state
+        # lives in `states`/`vassign`/retry tensors plus `outs`); the
+        # host-fold modes (completions host path, kube mirrors) carry
+        # state in per-scenario host structures instead — a claimed
+        # block there re-executes from chunk 0, still byte-identical.
+        ck_ok = kbops is None and not comp_on
+        ck_every = (
+            dcn.ckpt_every()
+            if (self._dcn_sliced and not self._dcn_spare and ck_ok)
+            else 0
+        )
+
+        def _carriers():
+            c = {"states": states}
+            if dev_rel:
+                c["vassign"] = vassign_d
+                if self.retry_buffer:
+                    c["retry"] = (
+                        rbuf_d, rcount_d, pend_id_d, pend_node_d,
+                        pend_relb_d, rdrop_d,
+                    )
+            return c
+
+        _ck_sig = [
+            self.engine, bool(dev_rel), int(self.retry_buffer),
+            int(self.S), int(C), int(n_chunks),
+        ]
+        start_ci = 0
+        if recovering and ck_ok:
+            from ..utils.metrics import log as _log
+            from .jax_runtime import restore_carriers
+
+            dead = int(self._dcn_recovery.get("for_pid", -1))
+            ckd = dcn.load_checkpoint(
+                dead, epoch=self._dcn_recovery.get("epoch")
+            )
+            pay = None if ckd is None else ckd["payload"]
+            if (
+                isinstance(pay, dict)
+                and tuple(ckd["block"])
+                == (int(hb_block[0]), int(hb_block[1]))
+                and pay.get("sig") == _ck_sig
+            ):
+                try:
+                    carr = restore_carriers(_carriers(), pay["leaves"])
+                except ValueError as e:
+                    _log.warning(
+                        "dcn: process %d's checkpoint is unusable (%s) — "
+                        "re-executing its block from chunk 0", dead, e,
+                    )
+                else:
+                    states = carr["states"]
+                    if dev_rel:
+                        vassign_d = carr["vassign"]
+                        if self.retry_buffer:
+                            (
+                                rbuf_d, rcount_d, pend_id_d, pend_node_d,
+                                pend_relb_d, rdrop_d,
+                            ) = carr["retry"]
+                    outs = list(pay["outs"])
+                    start_ci = int(pay["cursor"])
+                    _log.warning(
+                        "dcn: resumed process %d's block [%d, %d) from "
+                        "its newest checkpoint at chunk %d/%d",
+                        dead, hb_block[0], hb_block[1], start_ci, n_chunks,
+                    )
+            elif ckd is not None:
+                _log.warning(
+                    "dcn: ignoring mismatched checkpoint for process %d "
+                    "— re-executing its block from chunk 0", dead,
+                )
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
+            if ci < start_ci:
+                continue  # chunks already carried by the resumed state
+            if ck_every and ci and ci % ck_every == 0:
+                from .jax_runtime import snapshot_carriers
+
+                with run_phases.tick("checkpoint"):
+                    dcn.publish_checkpoint(
+                        ci,
+                        {
+                            "cursor": ci,
+                            "sig": _ck_sig,
+                            "leaves": snapshot_carriers(_carriers()),
+                            "outs": jax.device_get(outs),
+                        },
+                        hb_block,
+                    )
             if hb_on:
                 dcn.maybe_heartbeat(
                     ci - 1,
@@ -2543,6 +2868,7 @@ class WhatIfEngine:
                     block=hb_block,
                     wall_s=time.perf_counter() - t0,
                     phases=run_phases.acc,
+                    **hb_kw,
                 )
             if kbops is not None:
                 t_now = kube_wave_t[c0]
@@ -2552,7 +2878,8 @@ class WhatIfEngine:
                     for s in range(self.S)
                 )
                 if kpending is not None and (
-                    np.asarray(kpending[3]).any()
+                    kwant_series
+                    or np.asarray(kpending[3]).any()
                     or any(b.retry_q for b in kbops)
                     or due_any
                 ):
@@ -3028,7 +3355,23 @@ class WhatIfEngine:
                     telemetry=sc_telemetry,
                     fleet=fleet_local,
                 ),
+                # Survivor rebalance (round 15): with KSIM_DCN_RECOVER on,
+                # a stale sibling's block is claimed and re-executed
+                # through this callback instead of failing the fleet.
+                recover=(
+                    self._dcn_recover_block
+                    if self._dcn_rebuild is not None
+                    else None
+                ),
             )
+            # Spare processes contribute liveness, not scenarios — their
+            # sentinel parts are dropped before concatenation (worker
+            # parts are the contiguous pids 0..workers-1, still in global
+            # scenario order).
+            parts = [
+                p for p in parts
+                if not (isinstance(p, dict) and p.get("spare"))
+            ]
 
             def _cat(k):
                 if parts[0][k] is None:
@@ -3058,16 +3401,35 @@ class WhatIfEngine:
             if parts[0].get("fleet") is not None:
                 # Fleet merge: phases land under "p<pid>/<phase>", the
                 # aggregates are exact merges over the global scenario
-                # order — bit-matching the single-process oracle.
+                # order — bit-matching the single-process oracle. A part
+                # recovered by a claimant arrives with its phases ALREADY
+                # scoped "p<claimant>/..." (see _dcn_recover_block) —
+                # merge passes "/"-scoped keys through unprefixed, so
+                # recovered wall clock lands under the pid that spent it.
                 fleet_tel = ReplayTelemetry.merge(
                     [p["fleet"] for p in parts],
                     process_ids=list(range(len(parts))),
                 )
             process_count = jax.process_count()
+            # Device-footprint provenance counts block-owning workers
+            # only: spares ran no scenario over their devices.
+            dev_scale = len(parts)
         elif fleet_local is not None:
             # Single-process runs get the SAME shape ("p0/..." phase keys)
-            # so consumers never branch on process_count.
-            fleet_tel = ReplayTelemetry.merge([fleet_local], process_ids=[0])
+            # so consumers never branch on process_count. A recovery
+            # engine (round 15) scopes its phases under the CLAIMANT's
+            # pid, keeping per-process attribution honest after a merge.
+            fleet_tel = ReplayTelemetry.merge(
+                [fleet_local],
+                process_ids=[
+                    jax.process_index()
+                    if self._dcn_recovery is not None
+                    else 0
+                ],
+            )
+            dev_scale = process_count
+        else:
+            dev_scale = process_count
         total = int(placed.sum())
         ndev_local = int(self.mesh.devices.size) if self.mesh is not None else 1
         return WhatIfResult(
@@ -3094,15 +3456,16 @@ class WhatIfEngine:
             packing_efficiency=frag_pack,
             scenario_telemetry=sc_telemetry,
             fleet_telemetry=fleet_tel,
-            # Global footprint: process_count × local devices when the
-            # scenario axis was DCN-sliced (the local mesh is 1/nproc of
-            # the fleet that produced the gathered result).
-            n_devices=ndev_local * process_count,
+            # Global footprint: worker count × local devices when the
+            # scenario axis was DCN-sliced (the local mesh is one worker's
+            # share of the fleet that produced the gathered result; spare
+            # processes contribute no compute).
+            n_devices=ndev_local * dev_scale,
             mesh_shape=(
                 dict(zip(
                     self.mesh.axis_names,
                     (
-                        int(d) * process_count
+                        int(d) * dev_scale
                         for d in self.mesh.devices.shape
                     ),
                 ))

@@ -149,6 +149,24 @@ class TuneSpec:
 
 
 @dataclass
+class DcnRecoverySpec:
+    """Elastic fleet recovery (``dcn.recovery:`` YAML section, round 15 —
+    parallel.dcn). Config-level spelling of the ``KSIM_DCN_RECOVER`` /
+    ``KSIM_DCN_CKPT_EVERY`` / ``KSIM_DCN_MAX_CLAIMS`` env knobs: the CLI
+    exports them (setdefault — an operator's explicit env wins) BEFORE
+    ``jax.distributed`` bring-up, so the coordination-service failure
+    detector is widened in the same run. ``checkpoint_every`` is the
+    chunk cadence of compressed checkpoint publication (0 = off; a
+    claimed block then re-executes from chunk 0); ``max_claims`` bounds
+    the claim generations per dead block (a stale claimant's claim can
+    be superseded that many times before the gather fails attributed)."""
+
+    enable: bool = False
+    checkpoint_every: int = 0
+    max_claims: int = 2
+
+
+@dataclass
 class TelemetrySpec:
     """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
 
@@ -185,6 +203,7 @@ class SimConfig:
     whatif: WhatIfSpec = field(default_factory=WhatIfSpec)
     tune: Optional[TuneSpec] = None
     chaos: Optional[ChaosSpec] = None
+    dcn_recovery: Optional[DcnRecoverySpec] = None
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     output: Optional[str] = None
     wave_width: int = 8
@@ -311,6 +330,14 @@ class SimConfig:
                     int(ch["maxEvents"]) if ch.get("maxEvents") is not None
                     else None
                 ),
+            )
+        dc = d.get("dcn")
+        if dc is not None:
+            rec = dc.get("recovery", dc) or {}
+            cfg.dcn_recovery = DcnRecoverySpec(
+                enable=bool(rec.get("enable", False)),
+                checkpoint_every=int(rec.get("checkpointEvery", 0)),
+                max_claims=int(rec.get("maxClaims", 2)),
             )
         tl = d.get("telemetry")
         if tl is not None:

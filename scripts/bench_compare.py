@@ -23,7 +23,11 @@ BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
   drop in ``whatif_util_cpu_mean`` / ``cpu_baseline_util_cpu`` /
   packing efficiency beyond the threshold is a REGRESSION; growth in
   stranded capacity or the fragmentation index is informational (those
-  gauges move whenever the workload mix does).
+  gauges move whenever the workload mix does);
+- elastic-recovery costs (``detail.dcn_recovery``, round 15): checkpoint
+  codec walls and publication overhead are printed informationally and
+  NEVER gate — the headline runs with checkpoint publication off, so
+  these price an opt-in feature.
 
 Accepts both the archived wrapper shape ``{"n", "cmd", "rc", "parsed"}``
 and a raw bench JSON line ``{"metric", "value", ...}``. Exits nonzero
@@ -193,6 +197,29 @@ def compare_pair(
                 regressions.append(line + "  REGRESSION")
             else:
                 notes.append(line)
+
+    # Elastic-recovery costs (round 15): NEVER a regression — checkpoint
+    # publication is off in the headline, so these walls price an opt-in
+    # feature, and codec walls on shared CI hosts are noise-dominated.
+    ra, rb = da.get("dcn_recovery"), db.get("dcn_recovery")
+    if isinstance(rb, dict) and not isinstance(ra, dict):
+        notes.append(
+            "dcn_recovery: first appearance "
+            f"(ckpt blob {rb.get('ckpt_blob_mib')} MiB, "
+            f"encode {rb.get('ckpt_encode_s')}s, "
+            f"restore {rb.get('recovery_restore_wall_s')}s)"
+        )
+    elif isinstance(ra, dict) and isinstance(rb, dict):
+        for key in (
+            "ckpt_encode_s",
+            "recovery_restore_wall_s",
+            "ckpt_publish_overhead_pct",
+        ):
+            ga, gb = ra.get(key), rb.get(key)
+            if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
+                notes.append(
+                    f"dcn_recovery {key}: {ga} -> {gb} (informational)"
+                )
     return regressions, notes
 
 

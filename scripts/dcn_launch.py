@@ -23,6 +23,16 @@ prints fleet progress to stderr every couple of seconds: last completed
 chunk and chunks/sec per process, a live-buffer gauge, and a straggler
 flag for any process whose beacon went stale or whose chunk cursor trails
 the fleet.
+
+``--elastic N`` (round 15) launches N SPARE processes at the tail of the
+pid range and turns survivor recovery on (``KSIM_DCN_RECOVER=1`` unless
+already set): spares own no scenario block — they sit in the gather as
+claim-eligible capacity — and a worker dying mid-replay no longer kills
+the fleet; a survivor claims the dead block, resumes its newest
+checkpoint (``KSIM_DCN_CKPT_EVERY``), and the launcher succeeds as long
+as ANY process completes the gathered replay. ``--watch`` surfaces the
+rebalance live: claim/recovered events from the KV mirror's
+``events.jsonl`` plus ``recovering-p<dead>`` beacon states.
 """
 
 from __future__ import annotations
@@ -88,6 +98,46 @@ class FleetWatch:
         self.stall_s = stall_s
         self.lag_frac = lag_frac
         self._prev: dict = {}  # pid -> (chunk, t) of the last rate sample
+        self._ev_pos = 0  # bytes of events.jsonl already surfaced
+
+    def events(self) -> list:
+        """New claim/recovery events from the KV mirror's append-only
+        ``events.jsonl`` (round 15: parallel.dcn._mirror_event) since the
+        last call — the operator-visible trail of a live rebalance."""
+        path = os.path.join(self.hb_dir, "events.jsonl")
+        try:
+            with open(path) as f:
+                f.seek(self._ev_pos)
+                blob = f.read()
+                self._ev_pos = f.tell()
+        except OSError:
+            return []
+        out = []
+        for line in blob.splitlines():
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+    @staticmethod
+    def event_line(e: dict) -> str:
+        kind = e.get("event", "?")
+        who = f"p{e.get('claimant', '?')}"
+        dead = f"p{e.get('for', '?')}"
+        if kind == "claim":
+            msg = (
+                f"{who} CLAIMS dead {dead}'s block "
+                f"(gen {e.get('gen', '?')})"
+            )
+        elif kind == "recovered":
+            msg = (
+                f"{who} RECOVERED {dead}'s block "
+                f"in {float(e.get('wall_s', 0.0)):.1f}s"
+            )
+        else:
+            msg = json.dumps(e, sort_keys=True)
+        return f"dcn_launch[watch]: {msg}"
 
     def read(self) -> dict:
         beats = {}
@@ -123,8 +173,13 @@ class FleetWatch:
             straggler = age > self.stall_s or (
                 total and lag > max(2, self.lag_frac * int(total))
             )
+            state = b.get("state", "?")
+            if state == "recover" and "recovering_for" in b:
+                # Round 15: a claimant re-executing a dead sibling's
+                # block beats under its OWN pid with the dead pid named.
+                state = f"recovering-p{b['recovering_for']}"
             seg = (
-                f"p{pid} {b.get('state', '?')} "
+                f"p{pid} {state} "
                 f"chunk {chunk}"
                 + (f"/{total}" if total is not None else "")
                 + rate
@@ -159,7 +214,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--watch", action="store_true",
         help="tail worker heartbeats and print fleet progress "
-             "(chunks/sec per process, stragglers flagged) to stderr",
+             "(chunks/sec per process, stragglers flagged) plus round-15 "
+             "claim/recovery events to stderr",
+    )
+    ap.add_argument(
+        "--elastic", type=int, default=0, metavar="SPARES",
+        help="launch SPARES extra spare processes (no scenario block; "
+             "claim-eligible capacity) and enable survivor recovery: a "
+             "worker dying mid-replay no longer kills the fleet — the "
+             "launch succeeds as long as any process completes "
+             "(KSIM_DCN_SPARES / KSIM_DCN_RECOVER)",
     )
     ap.add_argument(
         "--watch-interval", type=float, default=2.0,
@@ -175,20 +239,32 @@ def main(argv=None) -> int:
         ap.error("no command given (append: -- python -m ... )")
     if args.nproc < 1:
         ap.error("--nproc must be >= 1")
+    if args.elastic < 0:
+        ap.error("--elastic must be >= 0")
+    nproc = args.nproc + args.elastic
+    elastic = args.elastic > 0
+    if elastic:
+        # Spares own no scenario block (parallel.dcn.spare_count); the
+        # recovery knob defaults on so survivors/spare claim dead blocks.
+        os.environ["KSIM_DCN_SPARES"] = str(args.elastic)
+        os.environ.setdefault("KSIM_DCN_RECOVER", "1")
+    tolerant = elastic or str(
+        os.environ.get("KSIM_DCN_RECOVER", "0")
+    ).strip().lower() in ("1", "true", "yes", "on")
 
     hb_dir = ""
     watch = None
     if args.watch:
         hb_dir = tempfile.mkdtemp(prefix="ksim_hb_")
         watch = FleetWatch(
-            hb_dir, args.nproc,
+            hb_dir, nproc,
             stall_s=float(os.environ.get("KSIM_DCN_STALL_S", "60")),
         )
     port = free_port()
     procs, tails = [], []
-    for pid in range(args.nproc):
+    for pid in range(nproc):
         env = child_env(
-            pid, args.nproc, port, args.devices_per_proc, hb_dir
+            pid, nproc, port, args.devices_per_proc, hb_dir
         )
         if pid == 0:
             p = subprocess.Popen(cmd, env=env)
@@ -211,11 +287,15 @@ def main(argv=None) -> int:
     deadline = time.monotonic() + args.timeout
     next_watch = time.monotonic() + args.watch_interval
     rc = 0
+    ok_exits = 0
+    first_bad = 0
     try:
-        pending = set(range(args.nproc))
+        pending = set(range(nproc))
         while pending:
             if watch is not None and time.monotonic() >= next_watch:
                 next_watch = time.monotonic() + args.watch_interval
+                for e in watch.events():
+                    print(watch.event_line(e), file=sys.stderr)
                 beats = watch.read()
                 if beats:
                     print(watch.line(beats), file=sys.stderr)
@@ -231,19 +311,49 @@ def main(argv=None) -> int:
                 if r is None:
                     continue
                 pending.discard(i)
-                if r != 0 and rc == 0:
-                    rc = r
+                if r == 0:
+                    ok_exits += 1
+                    continue
+                if first_bad == 0:
+                    first_bad = r
+                if tolerant:
+                    # Round 15: with recovery on a dead worker's block is
+                    # claimed by a survivor — the replay can still finish.
+                    # Succeed iff ANY process completes the gathered
+                    # result (checked after the loop).
                     print(
-                        f"dcn_launch: process {i} exited {r} — "
-                        "killing the fleet", file=sys.stderr,
+                        f"dcn_launch: process {i} exited {r} — recovery "
+                        "enabled, fleet continues (a survivor claims the "
+                        "block)", file=sys.stderr,
                     )
                     if tails[i]:
                         sys.stderr.writelines(
-                            f"[p{i}] {line}" for line in tails[i][-50:]
+                            f"[p{i}] {line}" for line in tails[i][-20:]
                         )
+                    continue
+                rc = r
+                print(
+                    f"dcn_launch: process {i} exited {r} — "
+                    "killing the fleet", file=sys.stderr,
+                )
+                if tails[i]:
+                    sys.stderr.writelines(
+                        f"[p{i}] {line}" for line in tails[i][-50:]
+                    )
             if rc:
                 break
             time.sleep(0.1)
+        if watch is not None:
+            for e in watch.events():
+                print(watch.event_line(e), file=sys.stderr)
+        if not rc and tolerant and not pending and ok_exits == 0:
+            # Every process died before completing the gather — nothing
+            # holds the merged replay, so the launch failed after all.
+            rc = first_bad or 1
+            print(
+                "dcn_launch: no process completed the replay — "
+                f"exit {rc}", file=sys.stderr,
+            )
     finally:
         for p in procs:
             if p.poll() is None:

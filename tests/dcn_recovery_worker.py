@@ -1,0 +1,82 @@
+"""Worker + case variant for the round-15 DCN recovery suite
+(tests/test_dcn_recovery.py).
+
+Everything rides tests/dcn_case_worker.py — same production init path
+(``dcn.maybe_init_from_env``), same self-kill arming, same one-JSON-line
+protocol — plus ONE extra case: ``recovery_fleet`` is ``fleetmerge``
+with the strict per-process phase-prefix assertion loosened. Under
+survivor recovery the dead process's part is re-executed by the
+claimant, whose engine scopes its wall-clock phases under the
+CLAIMANT's pid (honest attribution), so the merged fleet telemetry
+carries fewer ``p<pid>/`` namespaces than a no-failure fleet — every
+virtual-time-derived field still bit-matches the oracle, which is what
+the payload compares.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import dcn_case_worker as W  # noqa: E402
+
+
+def case_recovery_fleet():
+    """Round-12 fleetmerge engine (kube+series, no-mesh DCN path) with
+    the recovery-tolerant phase-prefix pin: a subset of the fleet's
+    ``p<pid>/`` namespaces, never an unknown one."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.parallel import dcn
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    nodes = [Node(f"n{i}", {"cpu": 4.0}) for i in range(4)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=20.0)
+        for i in range(24)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    scenarios = [
+        Scenario(),
+        Scenario(events=[
+            NodeEvent(time=6.0, kind="node_down", node=0),
+            NodeEvent(time=14.0, kind="node_up", node=0),
+        ]),
+        Scenario(events=[NodeEvent(time=10.0, kind="node_down", node=1)]),
+        Scenario(),
+    ]
+    eng = WhatIfEngine(
+        ec, ep, scenarios, cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=32, telemetry="series",
+    )
+    res = eng.run()
+    ft = res.fleet_telemetry
+    assert ft is not None, "fleet_telemetry missing from what-if result"
+    nproc, _ = dcn.process_info()
+    prefixes = {k.split("/", 1)[0] for k in ft.phases}
+    fleet = {f"p{i}" for i in range(max(nproc, 1))}
+    assert prefixes and prefixes <= fleet, (prefixes, fleet)
+    return eng, {
+        "granularity": ft.granularity,
+        "latency": ft.latency,
+        "reasons": ft.reasons,
+        "rejection_attempts": ft.rejection_attempts,
+        "zero_latency_binds": int(ft.zero_latency_binds),
+        "bind_values": [float(v) for v in ft.bind_latency.values()],
+        "series_sha": W._sha(
+            json.dumps(ft.series, sort_keys=True).encode()
+        ),
+        "events_len": len(ft.events),
+    }
+
+
+W.CASES["recovery_fleet"] = case_recovery_fleet
+
+
+if __name__ == "__main__":
+    sys.exit(W.main())

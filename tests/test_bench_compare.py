@@ -23,7 +23,7 @@ from bench_compare import (  # noqa: E402
 )
 
 
-def _bench(value, phases=None, dcn=None, borg=None):
+def _bench(value, phases=None, dcn=None, borg=None, recovery=None):
     detail = {}
     if phases is not None:
         detail["phases"] = phases
@@ -31,6 +31,8 @@ def _bench(value, phases=None, dcn=None, borg=None):
         detail["dcn_scaling"] = dcn
     if borg is not None:
         detail["borg_scale"] = borg
+    if recovery is not None:
+        detail["dcn_recovery"] = recovery
     return {"metric": "pps", "value": value, "unit": "1/s",
             "detail": detail}
 
@@ -108,6 +110,31 @@ def test_borg_scale_comparison():
     reg, notes = compare_pair(
         "a", a, "b", _bench(100.0, borg=_borg(1.0, nodes=2000)), 0.10)
     assert reg == [] and any("shape changed" in n for n in notes)
+
+
+def test_dcn_recovery_block_is_informational_only():
+    # Round 15: recovery costs price an OPT-IN feature (checkpoint
+    # publication is off in the headline) — even a 100x wall blowup is a
+    # note, never a regression.
+    rec_a = {"ckpt_blob_mib": 1.2, "ckpt_encode_s": 0.01,
+             "ckpt_publish_overhead_pct": 1.5,
+             "recovery_restore_wall_s": 0.02}
+    rec_b = {"ckpt_blob_mib": 1.2, "ckpt_encode_s": 1.0,
+             "ckpt_publish_overhead_pct": 80.0,
+             "recovery_restore_wall_s": 2.0}
+    reg, notes = compare_pair(
+        "a", _bench(100.0, recovery=rec_a),
+        "b", _bench(100.0, recovery=rec_b), 0.10)
+    assert reg == []
+    assert any(
+        "dcn_recovery ckpt_publish_overhead_pct" in n and "informational"
+        in n for n in notes)
+    assert any("dcn_recovery recovery_restore_wall_s" in n for n in notes)
+    # First appearance: one summary note, no per-key diffs.
+    reg, notes = compare_pair(
+        "a", _bench(100.0), "b", _bench(100.0, recovery=rec_b), 0.10)
+    assert reg == []
+    assert any("dcn_recovery: first appearance" in n for n in notes)
 
 
 def test_main_exit_codes(tmp_path, capsys):

@@ -328,6 +328,10 @@ def _fleet(monkeypatch, nproc=2, pid=1):
     kv = _FakeKV()
     monkeypatch.setattr(dcn, "process_info", lambda: (nproc, pid))
     monkeypatch.setattr(dcn, "_client", lambda: kv)
+    # The degraded-fleet hard exit must never arm inside the TEST
+    # process (it would override pytest's own exit status).
+    monkeypatch.setattr(dcn, "_degraded_exit_armed", [True])
+    monkeypatch.setattr(dcn, "DEGRADED", set())
     return kv
 
 
@@ -497,6 +501,310 @@ def test_jsonl_writer_stamps_process_under_dcn(tmp_path, monkeypatch):
         w.write({"kind": "x"})
     row = json.loads(p2.read_text())
     assert row["process_id"] == 1 and row["process_count"] == 2
+
+
+# -- round-15 recoverable work-queue ----------------------------------------
+
+
+def test_recovery_knob_defaults(monkeypatch):
+    for k in ("KSIM_DCN_RECOVER", "KSIM_DCN_CKPT_EVERY",
+              "KSIM_DCN_MAX_CLAIMS", "KSIM_DCN_SPARES"):
+        monkeypatch.delenv(k, raising=False)
+    assert dcn.recover_enabled() is False
+    assert dcn.ckpt_every() == 0
+    assert dcn.max_claims() == 2
+    assert dcn.spare_count() == 0
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "yes")
+    monkeypatch.setenv("KSIM_DCN_CKPT_EVERY", "3")
+    monkeypatch.setenv("KSIM_DCN_MAX_CLAIMS", "5")
+    assert dcn.recover_enabled() is True
+    assert dcn.ckpt_every() == 3
+    assert dcn.max_claims() == 5
+    monkeypatch.setenv("KSIM_DCN_CKPT_EVERY", "junk")
+    monkeypatch.setenv("KSIM_DCN_MAX_CLAIMS", "0")
+    assert dcn.ckpt_every() == 0
+    assert dcn.max_claims() == 1  # floor: one claim generation always
+
+
+def test_spares_shrink_worker_count_and_mirror_last_block(monkeypatch):
+    monkeypatch.setattr(dcn, "process_info", lambda: (3, 2))
+    monkeypatch.setenv("KSIM_DCN_SPARES", "1")
+    assert dcn.worker_count() == 2
+    assert dcn.is_spare() is True
+    # The spare mirrors the LAST worker's block (shapes only — the
+    # engine marks it _dcn_spare and never runs the chunks).
+    assert dcn.local_slice(8) == slice(4, 8)
+    monkeypatch.setattr(dcn, "process_info", lambda: (3, 1))
+    assert dcn.is_spare() is False
+    assert dcn.local_slice(8) == slice(4, 8)
+    monkeypatch.setattr(dcn, "process_info", lambda: (3, 0))
+    assert dcn.local_slice(8) == slice(0, 4)
+
+
+def test_checkpoint_publish_load_roundtrip(monkeypatch):
+    """publish_checkpoint → load_checkpoint round-trips the payload
+    through the delta+zlib codec; the newest cursor wins; a torn blob
+    (no ``/n`` manifest) is skipped; epochs are isolated."""
+    kv = _fleet(monkeypatch, nproc=2, pid=1)
+    pay0 = {"cursor": 1, "leaves": [np.arange(4096, dtype=np.int32)]}
+    pay1 = {"cursor": 3, "leaves": [np.arange(4096, dtype=np.int32) * 2]}
+    assert dcn.publish_checkpoint(1, pay0, (4, 8), epoch=7)
+    assert dcn.publish_checkpoint(3, pay1, (4, 8), epoch=7)
+    got = dcn.load_checkpoint(1, epoch=7)
+    assert got is not None
+    assert got["cursor"] == 3 and got["block"] == (4, 8)
+    np.testing.assert_array_equal(
+        got["payload"]["leaves"][0], pay1["leaves"][0]
+    )
+    assert got["payload"]["leaves"][0].dtype == np.int32
+    # Torn blob: drop the manifest of the newest cursor — the reader
+    # falls back to the older complete one.
+    del kv.store[f"{dcn.CKPT_PREFIX}/7/1/4-8/3/n"]
+    assert dcn.load_checkpoint(1, epoch=7)["cursor"] == 1
+    # Epoch isolation: a previous replay's blobs are invisible.
+    assert dcn.load_checkpoint(1, epoch=8) is None
+    assert dcn.load_checkpoint(0, epoch=7) is None
+
+
+def test_checkpoint_publish_noop_single_process(monkeypatch):
+    kv = _FakeKV()
+    monkeypatch.setattr(dcn, "_client", lambda: kv)
+    assert dcn.publish_checkpoint(1, {"x": 1}, (0, 4)) is False
+    assert kv.store == {}
+
+
+def test_claim_cas_single_claimant_and_metadata_roundtrip(monkeypatch):
+    """The write-once claim key admits exactly ONE claimant per
+    generation; the loser reads the winner's metadata (claimant pid,
+    block owner, generation) for attribution of a second failure."""
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    assert dcn.try_claim(2, 0) is True
+    # Same key from another pid: CAS loss.
+    monkeypatch.setattr(dcn, "process_info", lambda: (3, 1))
+    assert dcn.try_claim(2, 0) is False
+    meta = dcn.read_claim(2, 0)
+    assert meta["claimant"] == 0
+    assert meta["for"] == 2
+    assert meta["gen"] == 0
+    assert isinstance(meta["t"], float)
+    # Next generation is open, and namespaced separately.
+    assert dcn.try_claim(2, 1) is True
+    assert dcn.read_claim(2, 1)["claimant"] == 1
+    assert dcn.read_claim(2, 2) is None
+
+
+def test_recovery_heartbeat_names_claimed_block(monkeypatch):
+    """Satellite: a recovering process beats under its OWN pid with the
+    claimed block and the dead pid named, so a second failure during
+    recovery is attributed to the claimant — round-tripped through
+    read_heartbeats exactly as the stall detector reads it."""
+    _fleet(monkeypatch, nproc=2, pid=0)
+    assert dcn.heartbeat(
+        -1, block=(4, 8), state="recover", extra={"recovering_for": 1}
+    )
+    beats = dcn.read_heartbeats()
+    assert set(beats) == {0}
+    beat = beats[0]
+    assert beat["pid"] == 0  # the claimant's pid, never the dead one's
+    assert beat["state"] == "recover"
+    assert beat["recovering_for"] == 1
+    assert beat["block"] == [4, 8]
+
+
+def test_gather_wait_recovers_stale_sibling(monkeypatch, tmp_path):
+    """With KSIM_DCN_RECOVER on and a recover callback, a stale sibling
+    beacon triggers claim + re-execution + publication under the dead
+    pid's keys instead of the attributed DcnGatherTimeout — and the
+    claim/recovered events land in the KSIM_DCN_HB_DIR mirror."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "30")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.05")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "1")
+    monkeypatch.setenv("KSIM_DCN_HB_DIR", str(tmp_path))
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 0, "state": "run", "t": time.time() - 10.0,
+         "block": [4, 8]}
+    )
+    calls = []
+
+    def _recover(p):
+        calls.append(p)
+        return {"placed": np.array([1, 2], np.int32)}
+
+    got = dcn._get_attributed(
+        kv, "ksim/gather/1/whatif/1/n", 1, "whatif", recover=_recover
+    )
+    assert calls == [1]
+    assert got == "1"  # the published manifest (one KV chunk)
+    # Single-claimant key exists with our metadata.
+    meta = dcn.read_claim(1, 0)
+    assert meta["claimant"] == 0 and meta["for"] == 1
+    # The dead pid's payload is decodable from its gather keys.
+    part = dcn._decode_payload(
+        [kv.store["ksim/gather/1/whatif/1/0"]]
+    )
+    np.testing.assert_array_equal(part["placed"], [1, 2])
+    events = [
+        json.loads(l)
+        for l in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    assert [e["event"] for e in events] == ["claim", "recovered"]
+    assert all(e["claimant"] == 0 and e["for"] == 1 for e in events)
+
+
+def test_gather_wait_defers_to_live_claimant(monkeypatch):
+    """A CAS loser never re-executes the block: with a LIVE claimant
+    (fresh claim or fresh beacon) it keeps polling for the claimant's
+    publication of the dead pid's keys."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "30")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.05")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "1")
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 0, "t": time.time() - 10.0}
+    )
+    # pid 2 already claimed gen 0 (fresh claim → benefit of the doubt
+    # even before its first recovery beacon).
+    kv.store[f"{dcn.CLAIM_PREFIX}/{dcn._seq}/whatif/1/0"] = json.dumps(
+        {"claimant": 2, "for": 1, "gen": 0, "t": time.time()}
+    )
+    calls = {"n": 0}
+    real_get = kv.blocking_key_value_get
+
+    def _late_get(key, timeout_ms):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            kv.store.setdefault("ksim/gather/1/whatif/1/n", "1")
+        return real_get(key, timeout_ms)
+
+    kv.blocking_key_value_get = _late_get
+
+    def _never(p):  # pragma: no cover - must not fire
+        raise AssertionError("CAS loser re-executed the block")
+
+    got = dcn._get_attributed(
+        kv, "ksim/gather/1/whatif/1/n", 1, "whatif", recover=_never
+    )
+    assert got == "1"
+
+
+def test_gather_wait_opens_next_generation_on_stale_claimant(monkeypatch):
+    """Second failure during recovery: the gen-0 claimant's claim is old
+    AND its beacon is stale → survivors open generation 1 and recover."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "30")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.05")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "1")
+    now = time.time()
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 0, "t": now - 10.0}
+    )
+    kv.store[f"{dcn.CLAIM_PREFIX}/{dcn._seq}/whatif/1/0"] = json.dumps(
+        {"claimant": 2, "for": 1, "gen": 0, "t": now - 10.0}
+    )
+    kv.store[f"{dcn.HB_PREFIX}/2"] = json.dumps(
+        {"pid": 2, "chunk": -1, "state": "recover", "t": now - 10.0}
+    )
+    calls = []
+
+    def _recover(p):
+        calls.append(p)
+        return {"placed": np.array([7], np.int32)}
+
+    got = dcn._get_attributed(
+        kv, "ksim/gather/1/whatif/1/n", 1, "whatif", recover=_recover
+    )
+    assert got == "1" and calls == [1]
+    assert dcn.read_claim(1, 1)["claimant"] == 0
+
+
+def test_gather_wait_exhausted_claims_raise_attributed(monkeypatch):
+    """All claim generations stale → the attributed DcnGatherTimeout of
+    round 12 fires after all (recovery never hides a lost fleet)."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "30")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.05")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "1")
+    monkeypatch.setenv("KSIM_DCN_MAX_CLAIMS", "2")
+    now = time.time()
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 0, "t": now - 10.0}
+    )
+    kv.store[f"{dcn.HB_PREFIX}/2"] = json.dumps(
+        {"pid": 2, "chunk": -1, "t": now - 10.0}
+    )
+    for gen in range(2):
+        kv.store[f"{dcn.CLAIM_PREFIX}/{dcn._seq}/whatif/1/{gen}"] = (
+            json.dumps({"claimant": 2, "for": 1, "gen": gen,
+                        "t": now - 10.0})
+        )
+    with pytest.raises(dcn.DcnGatherTimeout, match="looks DEAD"):
+        dcn._get_attributed(
+            kv, "ksim/gather/1/whatif/1/n", 1, "whatif",
+            recover=lambda p: {},
+        )
+
+
+def test_gather_wait_stale_beacon_still_fails_without_recover_knob(
+    monkeypatch,
+):
+    """Recovery requires BOTH the env knob and a callback: with a
+    callback but KSIM_DCN_RECOVER unset, round-12 fail-fast holds."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.delenv("KSIM_DCN_RECOVER", raising=False)
+    monkeypatch.setenv("KSIM_DCN_TIMEOUT_S", "30")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.05")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 2, "t": time.time() - 10.0}
+    )
+    with pytest.raises(dcn.DcnGatherTimeout, match="looks DEAD"):
+        dcn._get_attributed(
+            kv, "ksim/gather/1/whatif/1/n", 1, "whatif",
+            recover=lambda p: {},
+        )
+
+
+def test_snapshot_restore_carriers_roundtrip():
+    """sim.jax_runtime snapshot/restore: positional leaf lists survive
+    the host round-trip bit-exactly; shape/count mismatches refuse
+    (callers then re-execute from chunk 0)."""
+    from kubernetes_simulator_tpu.sim.jax_runtime import (
+        restore_carriers,
+        snapshot_carriers,
+    )
+
+    tree = {
+        "states": (jax.numpy.arange(6).reshape(2, 3),
+                   jax.numpy.ones((4,), jax.numpy.float32)),
+        "retry": [jax.numpy.zeros((2, 2), jax.numpy.int32)],
+    }
+    leaves = snapshot_carriers(tree)
+    assert all(isinstance(l, np.ndarray) for l in leaves)
+    fresh = jax.tree_util.tree_map(lambda x: x * 0, tree)
+    back = restore_carriers(fresh, leaves)
+    np.testing.assert_array_equal(back["states"][0], tree["states"][0])
+    np.testing.assert_array_equal(back["retry"][0], tree["retry"][0])
+    with pytest.raises(ValueError, match="leaves"):
+        restore_carriers(fresh, leaves[:-1])
+    bad = list(leaves)
+    bad[0] = np.zeros((9, 9))
+    with pytest.raises(ValueError, match="shape"):
+        restore_carriers(fresh, bad)
 
 
 def test_schema_accepts_process_stamp():

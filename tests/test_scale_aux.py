@@ -266,6 +266,69 @@ class TestValidate:
             {"whatIf": {"completions": False}}
         ).whatif.completions is False
 
+    def test_recovery_requires_dcn_fleet_and_heartbeats(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """Round 15: dcn.recovery.enable outside a DCN fleet (no
+        KSIM_DCN_NPROC) or with heartbeats disabled must refuse with a
+        message naming the fix; inside a fleet with beacons on, the same
+        config validates clean."""
+        from kubernetes_simulator_tpu.cli import main
+
+        monkeypatch.delenv("KSIM_DCN_NPROC", raising=False)
+        monkeypatch.delenv("KSIM_DCN_HEARTBEAT_EVERY", raising=False)
+        cfg = self._write(
+            tmp_path,
+            {
+                "strategy": "jax",
+                "whatIf": {"scenarios": 4},
+                "dcn": {"recovery": {"enable": True, "checkpointEvery": 2}},
+            },
+        )
+        rc = main(["validate", cfg])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "dcn_launch" in out and "KSIM_DCN_NPROC" in out
+
+        monkeypatch.setenv("KSIM_DCN_NPROC", "2")
+        monkeypatch.setenv("KSIM_DCN_HEARTBEAT_EVERY", "0")
+        rc = main(["validate", cfg])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "KSIM_DCN_HEARTBEAT_EVERY" in out and "heartbeat" in out
+
+        monkeypatch.delenv("KSIM_DCN_HEARTBEAT_EVERY", raising=False)
+        rc = main(["validate", cfg])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert '"errors": []' in out
+
+    def test_recovery_value_checks_apply_even_disabled(
+        self, tmp_path, capsys
+    ):
+        """checkpointEvery/maxClaims sanity is structural — it must not
+        hide behind enable: true (a disabled-but-broken section would
+        explode the day someone flips the switch)."""
+        from kubernetes_simulator_tpu.cli import main
+
+        cfg = self._write(
+            tmp_path,
+            {
+                "dcn": {
+                    "recovery": {
+                        "enable": False,
+                        "checkpointEvery": -1,
+                        "maxClaims": 0,
+                    }
+                },
+            },
+        )
+        rc = main(["validate", cfg])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "dcn.recovery.checkpointEvery" in out
+        assert "dcn.recovery.maxClaims" in out
+
     def test_compile_cache_repeat_enable_reports_configured_dir(
         self, tmp_path
     ):
