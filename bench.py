@@ -35,7 +35,11 @@ batched sweep — the config2 search space, i.e. the full default plugin
 set's 5 Score weights plus the NodeResourcesFit strategy selector; 0
 population disables), BENCH_RECOVERY (0 skips the ``detail.dcn_recovery``
 cost block), BENCH_RECOVERY_REPS, BENCH_CKPT_EVERY (cadence for the
-fleet-only publication-overhead run).
+fleet-only publication-overhead run), BENCH_BORG / BENCH_BORG_NODES /
+BENCH_BORG_PODS (borg_scale detail block), BENCH_HEADLINE /
+BENCH_HEADLINE_NODES / BENCH_HEADLINE_PODS / BENCH_HEADLINE_FLIGHT
+(round 16 ``borg_headline`` composed run — Borg-shaped trace through
+nodeShards × pagedWaves with the flight recorder on).
 
 Round 12: ``--profile`` (or ``KSIM_PROFILE_DIR=<dir>``) wraps the timed
 headline runs in ``jax.profiler.trace`` with TraceAnnotation markers on
@@ -434,6 +438,103 @@ def main():
             }
         }
 
+    # Borg-headline composed run (round 16): make_borg_encoded at the
+    # BASELINE shape (BENCH_HEADLINE_NODES/PODS; CPU meshes downscale so
+    # the CI gate stays in budget) through the FULL composed stack —
+    # nodeShards over every local device × pagedWaves — with the flight
+    # recorder ON. This is the 10k×1M run ROADMAP item 1 calls for,
+    # instrumented: wall, pps, peak residency, per-phase shares and the
+    # recorded stream's event count land in detail.borg_headline, and
+    # the stream itself (path stamped) feeds scripts/bottleneck_report.py.
+    # BENCH_HEADLINE=0 disables; BENCH_HEADLINE_FLIGHT overrides the sink.
+    headline_block = {}
+    if int(os.environ.get("BENCH_HEADLINE", 1)) and nproc == 1 and ndev > 1:
+        import tempfile
+
+        from kubernetes_simulator_tpu.sim.borg import (
+            BorgSpec,
+            make_borg_encoded,
+        )
+        from kubernetes_simulator_tpu.sim.flight import read_stream
+        from kubernetes_simulator_tpu.sim.jax_runtime import (
+            JaxReplayEngine,
+            replicated_resident_bytes,
+        )
+
+        on_cpu = jax.devices()[0].platform == "cpu"
+        h_nodes = int(
+            os.environ.get("BENCH_HEADLINE_NODES", 1000 if on_cpu else 10_000)
+        )
+        h_pods = int(
+            os.environ.get(
+                "BENCH_HEADLINE_PODS", 20_000 if on_cpu else 1_000_000
+            )
+        )
+        ec_h, ep_h, _ = make_borg_encoded(
+            BorgSpec(nodes=h_nodes, tasks=h_pods, seed=0)
+        )
+        fl_path = os.environ.get("BENCH_HEADLINE_FLIGHT") or os.path.join(
+            tempfile.mkdtemp(prefix="ksim_flight_"), "flight.jsonl"
+        )
+        eng_h = JaxReplayEngine(
+            ec_h, ep_h, cfg, chunk_waves=512, node_shards=ndev, paged=True,
+            telemetry="summary",
+        )
+        eng_h.replay()  # warmup: compile + first execution, recorder off
+        eng_h.flight_recorder = fl_path  # record the timed run only
+        t0_h = time.perf_counter()
+        res_h = eng_h.replay()
+        wall_h = time.perf_counter() - t0_h
+        ph = dict(res_h.telemetry.phases) if res_h.telemetry else {}
+        ph_total = sum(ph.values()) or 1.0
+        flight_rows = read_stream(fl_path)
+        headline_block = {
+            "borg_headline": {
+                "nodes": h_nodes,
+                "pods": h_pods,
+                "node_shards": ndev,
+                "paged": True,
+                "pps": round(
+                    res_h.placed / wall_h if wall_h > 0 else 0.0, 1
+                ),
+                "wall_s": round(wall_h, 3),
+                "placed": int(res_h.placed),
+                "replicated_resident_mib": round(
+                    replicated_resident_bytes(ec_h, ep_h) / 2**20, 1
+                ),
+                "phase_shares": {
+                    k: round(v / ph_total, 3) for k, v in sorted(ph.items())
+                },
+                "flight_path": fl_path,
+                "flight_events": len(flight_rows),
+                "pager_stalls": max(
+                    (
+                        int(r.get("pager_stalls", 0))
+                        for r in flight_rows
+                        if r.get("event") == "chunk"
+                    ),
+                    default=0,
+                ),
+            }
+        }
+
+    # Memory watermarks (round 16): host RSS high-water + the PEAK
+    # replicated-residency estimate across every workload this invocation
+    # encoded — stamped at the TOP level of every bench JSON so the
+    # BENCH_r* trajectory captures memory, not just pps.
+    from kubernetes_simulator_tpu.sim.flight import rss_peak_mib
+    from kubernetes_simulator_tpu.sim.jax_runtime import (
+        replicated_resident_bytes as _rrb,
+    )
+
+    resident_peak_mib = _rrb(ec, ep) / 2**20
+    for blk, key in (
+        (borg_block.get("borg_scale"), "replicated_resident_mib"),
+        (headline_block.get("borg_headline"), "replicated_resident_mib"),
+    ):
+        if blk:
+            resident_peak_mib = max(resident_peak_mib, blk[key])
+
     line = json.dumps(
             {
                 "metric": "pod-placements/sec (what-if %d scenarios x %d nodes x %d pods, full default plugin set, %s, %d device%s)"
@@ -455,6 +556,9 @@ def main():
                 "mesh_shape": mesh_shape,
                 "scenarios": S_head,
                 "process_count": nproc,
+                # Round 16: memory watermarks on every bench line.
+                "rss_peak_mib": rss_peak_mib(),
+                "replicated_resident_peak_mib": round(resident_peak_mib, 1),
                 "detail": {
                     "jax_wall_median_s": round(med_wall, 3),
                     "jax_wall_min_s": round(walls[0], 3),
@@ -501,6 +605,7 @@ def main():
                     **cont,
                     **tune_sweep,
                     **borg_block,
+                    **headline_block,
                 },
             }
         )

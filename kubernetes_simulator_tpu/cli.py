@@ -99,6 +99,13 @@ def cmd_run(args) -> int:
                    "retry_buffer": cfg.whatif.retry_buffer,
                    "node_shards": cfg.node_shards,
                    "paged": cfg.paged_waves})
+        if cfg.flight_recorder is not None:
+            from .sim.flight import FlightRecorderConfig
+
+            kw["flight_recorder"] = FlightRecorderConfig(
+                path=cfg.flight_recorder.path,
+                every=cfg.flight_recorder.every,
+            )
     engine = factory(ec, ep, cfg.framework, **kw)
     events = None
     if cfg.chaos is not None and cfg.chaos.enabled:
@@ -522,6 +529,31 @@ def validate_config(cfg) -> list:
             "devicePreemption requires strategy: jax (the cpu engine runs "
             "kube PostFilter preemption instead)"
         )
+    if cfg.flight_recorder is not None:
+        fr = cfg.flight_recorder
+        if cfg.strategy != "jax":
+            errors.append(
+                "flightRecorder requires strategy: jax (the cpu engine "
+                "has no chunk loop to record)"
+            )
+        d = os.path.dirname(fr.path) or "."
+        if not os.path.isdir(d):
+            errors.append(
+                f"flightRecorder.path: directory not found: {d}"
+            )
+        elif not os.access(d, os.W_OK):
+            errors.append(
+                f"flightRecorder.path: directory not writable: {d}"
+            )
+        if fr.every <= 0:
+            errors.append("flightRecorder.every: must be > 0")
+        if cfg.borg is not None and cfg.node_shards <= 1:
+            errors.append(
+                "flightRecorder on a borg headline workload without "
+                "nodeShards: the replicated planes bust one device at "
+                "Borg scale — set nodeShards > 1 (and usually "
+                "pagedWaves: true)"
+            )
     errors.extend(_recovery_errors(cfg))
     return errors
 

@@ -523,6 +523,18 @@ def gather_seq() -> int:
     return _seq
 
 
+# Cumulative checkpoint-publication accounting for THIS process (flight
+# recorder round 16): number of publications, wall spent encoding +
+# pushing KV chunks, and encoded bytes on the wire. Read via
+# :func:`publish_stats`; the flight recorder diffs it per chunk.
+PUBLISH_STATS = {"count": 0, "wall_s": 0.0, "bytes": 0}
+
+
+def publish_stats() -> dict:
+    """Snapshot of :data:`PUBLISH_STATS` (copy — callers diff it)."""
+    return dict(PUBLISH_STATS)
+
+
 def publish_checkpoint(
     cursor: int, payload, block: tuple, epoch: Optional[int] = None
 ) -> bool:
@@ -530,11 +542,16 @@ def publish_checkpoint(
     under ``ksim/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>``. The chunk-count
     manifest key (``/n``) is written LAST, so a reader that finds a
     manifest never sees a torn blob. Defensive like :func:`heartbeat`:
-    returns False (never raises) outside DCN or on any KV failure."""
+    returns False (never raises) outside DCN or on any KV failure.
+
+    Each successful publication is clocked into :data:`PUBLISH_STATS`
+    (encode + KV push wall, encoded bytes) and mirrored as a
+    ``ckpt_publish`` event for ``dcn_launch --watch``."""
     try:
         nproc, pid = process_info()
         if nproc <= 1:
             return False
+        t0 = time.perf_counter()
         c = _client()
         chunks = _encode_payload(payload)
         lo, hi = int(block[0]), int(block[1])
@@ -544,6 +561,20 @@ def publish_checkpoint(
             c.key_value_set(f"{prefix}/{j}", ch, allow_overwrite=True)
         c.key_value_set(
             f"{prefix}/n", str(len(chunks)), allow_overwrite=True
+        )
+        wall = time.perf_counter() - t0
+        nbytes = sum(len(ch) for ch in chunks)
+        PUBLISH_STATS["count"] += 1
+        PUBLISH_STATS["wall_s"] += wall
+        PUBLISH_STATS["bytes"] += nbytes
+        _mirror_event(
+            {
+                "kind": "ckpt_publish",
+                "pid": pid,
+                "cursor": int(cursor),
+                "bytes": nbytes,
+                "wall_s": round(wall, 6),
+            }
         )
         return True
     except Exception:

@@ -1,0 +1,327 @@
+"""Flight recorder (round 16): streaming in-flight observability for
+long replays — one JSONL event per chunk boundary (plus per page-stall /
+checkpoint / boundary-fold) so an hour-scale Borg-headline run is
+watchable while it executes and attributable afterwards.
+
+Every row carries: virtual time at the chunk boundary, placements /
+slots dispatched so far, a rolling placements-per-second gauge,
+PHASE_NAMES phase-timer deltas since the previous event, pager state
+(prefetch depth, cumulative stall count, stall wall-time), the
+selection-exchange probe wall under nodeShards, checkpoint blob bytes,
+and memory residency (the ``replicated_resident_bytes`` estimate plus
+the host RSS high-water from ``getrusage``).
+
+The recorder is OFF by default and bit-parity pinned
+(tests/test_flight.py): placements, deterministic JSONL and checkpoint
+blobs are identical with the recorder on or off — it never changes a
+device program, a fold ordering or a checkpoint payload; it only reads
+clocks and counters at chunk cadence. Rows are written through
+:class:`utils.metrics.JsonlWriter` (schema-stamped, process-stamped
+under DCN); ``KSIM_DETERMINISTIC_JSONL=1`` zeroes every wall-clock-
+derived field (``FLIGHT_WALL_FIELDS``) so a fixed-seed recorder stream
+is byte-stable — the flight twin of the replay-row scrub.
+
+Consumers: ``scripts/bottleneck_report.py`` (dominant-regime naming),
+``scripts/dcn_launch.py --watch`` (live recorder lines), and bench.py's
+``borg_headline`` mode.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .telemetry import PhaseTimers
+
+# Wall-clock-derived row fields zeroed under KSIM_DETERMINISTIC_JSONL
+# (kept PRESENT as numbers so schema-v5 validation still sees them).
+# Values inside the "phases" delta dict are zeroed too — phase timers
+# are perf_counter deltas. Everything else in a flight row (chunk
+# cursor, virtual time, dispatch/placement counts, pager stall COUNTS,
+# prefetch depth, checkpoint blob bytes, residency estimate) is
+# deterministic for a fixed seed and stays.
+FLIGHT_WALL_FIELDS = (
+    "wall_s",
+    "rolling_pps",
+    "stall_s",
+    "pager_stall_s",
+    "exchange_probe_s",
+    "exchange_est_s",
+    "ckpt_wall_s",
+    "rss_peak_mib",
+)
+
+# Rolling placements/sec window: events, not seconds — chunk cadence is
+# workload-dependent and the gauge should react within a few chunks.
+_ROLL_WINDOW = 8
+
+
+def rss_peak_mib() -> float:
+    """Host RSS high-water in MiB (``getrusage`` ``ru_maxrss``; KiB on
+    Linux, bytes on macOS). 0.0 where the resource module is absent —
+    never raises, the recorder must not take a run down."""
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        scale = 2**20 if sys.platform == "darwin" else 2**10
+        return round(peak * scale / 2**20, 1)
+    except Exception:
+        return 0.0
+
+
+@dataclass
+class FlightRecorderConfig:
+    """``flightRecorder:`` YAML section / ``flight_recorder=`` engine
+    kwarg. ``path`` is the JSONL sink (suffixed ``.p<pid>`` per process
+    under DCN, like every other sink); ``every`` is the chunk cadence
+    (1 = every chunk boundary; page/checkpoint/fold events always
+    emit)."""
+
+    path: str
+    every: int = 1
+
+    @classmethod
+    def resolve(cls, v) -> Optional["FlightRecorderConfig"]:
+        """None stays None (recorder off — the default); a path string
+        becomes a config; a config or live recorder passes through."""
+        if v is None or isinstance(v, (FlightRecorderConfig, FlightRecorder)):
+            return v
+        if isinstance(v, str):
+            return cls(path=v)
+        raise ValueError(
+            f"flight_recorder: expected a path, FlightRecorderConfig or "
+            f"None, got {v!r}"
+        )
+
+
+class FlightRecorder:
+    """Streaming JSONL emitter for one replay. Construct via
+    :meth:`open` (engines) or directly with a config; call
+    :meth:`chunk` once per chunk boundary and :meth:`page` /
+    :meth:`checkpoint` / :meth:`fold` as those events occur, then
+    :meth:`close`. Owns a :class:`PhaseTimers` so a telemetry-off run
+    still gets phase deltas (the engine routes its ``_tick`` here when
+    no collector exists)."""
+
+    def __init__(self, cfg: FlightRecorderConfig, meta: Optional[dict] = None):
+        from ..parallel import dcn
+        from ..utils.metrics import JsonlWriter
+
+        self.cfg = cfg
+        self.phases = PhaseTimers()  # used when telemetry is off
+        self._meta = dict(meta or {})
+        self._writer = JsonlWriter(dcn.output_path_for_process(cfg.path))
+        self._t0 = time.perf_counter()
+        self._last_phases: Dict[str, float] = {}
+        self._roll: deque = deque(maxlen=_ROLL_WINDOW)  # (wall, progressed)
+        self._events = 0
+        self._emit(
+            {
+                "event": "start",
+                "chunk": -1,
+                "wall_s": 0.0,
+                "rss_peak_mib": rss_peak_mib(),
+                **self._meta,
+            }
+        )
+
+    @classmethod
+    def open(cls, spec, meta: Optional[dict] = None) -> Optional["FlightRecorder"]:
+        """Engine entry point: ``spec`` is whatever the ``flight_recorder``
+        kwarg carried (None / path / config / live recorder). Returns a
+        live recorder or None (off). A recorder instance passes through
+        so callers can share one across resume legs."""
+        cfg = FlightRecorderConfig.resolve(spec)
+        if cfg is None:
+            return None
+        if isinstance(cfg, FlightRecorder):
+            return cfg
+        return cls(cfg, meta=meta)
+
+    # -- event emitters ----------------------------------------------------
+
+    def chunk(
+        self,
+        ci: int,
+        t_virtual: Optional[float] = None,
+        dispatched: Optional[int] = None,
+        placed: Optional[int] = None,
+        phase_acc: Optional[Dict[str, float]] = None,
+        pager=None,
+        exchange_probe_s: Optional[float] = None,
+        exchange_slots: Optional[int] = None,
+        ckpt_publish: Optional[dict] = None,
+    ) -> None:
+        """One chunk-boundary row. ``phase_acc`` is the CUMULATIVE phase
+        accumulator (the collector's or this recorder's own) — the row
+        carries deltas since the previous chunk row. ``pager`` is a
+        ``_PodPager`` (or anything with stalls/stall_s/prefetches/depth).
+        ``exchange_probe_s`` is one timed round of the selection-exchange
+        probe; ``exchange_est_s`` scales it to the chunk's slot count
+        (the per-slot all_gather runs once per slot inside the scan)."""
+        self._events += 1
+        if self.cfg.every > 1 and (ci % self.cfg.every) != 0:
+            return
+        wall = time.perf_counter() - self._t0
+        acc = dict(phase_acc if phase_acc is not None else self.phases.acc)
+        delta = {
+            k: round(v - self._last_phases.get(k, 0.0), 6)
+            for k, v in sorted(acc.items())
+        }
+        self._last_phases = acc
+        progressed = placed if placed is not None else dispatched
+        rolling = 0.0
+        if progressed is not None:
+            self._roll.append((wall, int(progressed)))
+            if len(self._roll) >= 2:
+                (w0, p0), (w1, p1) = self._roll[0], self._roll[-1]
+                if w1 > w0:
+                    rolling = (p1 - p0) / (w1 - w0)
+        row = {
+            "event": "chunk",
+            "chunk": int(ci),
+            "wall_s": round(wall, 6),
+            "rolling_pps": round(rolling, 1),
+            "phases": delta,
+            "rss_peak_mib": rss_peak_mib(),
+        }
+        if t_virtual is not None:
+            import math
+
+            row["t_virtual"] = (
+                round(float(t_virtual), 6)
+                if math.isfinite(float(t_virtual))
+                else None
+            )
+        if dispatched is not None:
+            row["dispatched"] = int(dispatched)
+        if placed is not None:
+            row["placed"] = int(placed)
+        if pager is not None:
+            row["pager_depth"] = int(getattr(pager, "depth", 0))
+            row["pager_stalls"] = int(getattr(pager, "stalls", 0))
+            row["pager_stall_s"] = round(
+                float(getattr(pager, "stall_s", 0.0)), 6
+            )
+        if exchange_probe_s is not None:
+            row["exchange_probe_s"] = round(float(exchange_probe_s), 6)
+            if exchange_slots:
+                row["exchange_slots"] = int(exchange_slots)
+                row["exchange_est_s"] = round(
+                    float(exchange_probe_s) * int(exchange_slots), 6
+                )
+        if ckpt_publish:
+            row["dcn_publish"] = dict(ckpt_publish)
+        self._emit(row)
+
+    def page(self, ci: int, stall_s: float, stalls: int) -> None:
+        """A pager prefetch MISS (the synchronous fetch the prefetch
+        exists to hide) — emitted per stall, they are the exceptional
+        case the report looks for."""
+        self._emit(
+            {
+                "event": "page",
+                "chunk": int(ci),
+                "stall_s": round(float(stall_s), 6),
+                "pager_stalls": int(stalls),
+                "wall_s": round(time.perf_counter() - self._t0, 6),
+            }
+        )
+
+    def checkpoint(
+        self, ci: int, nbytes: int, wall_s: float, sink: str = "local"
+    ) -> None:
+        """A checkpoint left the engine: ``sink`` is "local" (npz blob on
+        disk) or "dcn" (KV publication). ``nbytes`` is the blob size —
+        deterministic, so it survives the JSONL scrub."""
+        self._emit(
+            {
+                "event": "checkpoint",
+                "chunk": int(ci),
+                "ckpt_bytes": int(nbytes),
+                "ckpt_wall_s": round(float(wall_s), 6),
+                "ckpt_sink": sink,
+                "wall_s": round(time.perf_counter() - self._t0, 6),
+            }
+        )
+
+    def fold(self, ci: int, wall_s: float) -> None:
+        """A boundary-mode mirror fold resolved (the host-side D2H +
+        bookkeeping the lazy path tries to overlap)."""
+        self._emit(
+            {
+                "event": "boundary_fold",
+                "chunk": int(ci),
+                "stall_s": round(float(wall_s), 6),
+                "wall_s": round(time.perf_counter() - self._t0, 6),
+            }
+        )
+
+    def close(self, summary: Optional[dict] = None) -> None:
+        if self._writer is None:
+            return
+        row = {
+            "event": "end",
+            "chunk": -1,
+            "wall_s": round(time.perf_counter() - self._t0, 6),
+            "rss_peak_mib": rss_peak_mib(),
+            "events": self._events,
+        }
+        if summary:
+            row.update(summary)
+        self._emit(row)
+        self._writer.close()
+        self._writer = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _emit(self, row: dict) -> None:
+        from ..utils.metrics import deterministic_jsonl
+
+        if self._writer is None:
+            return
+        row = {"kind": "flight", **row}
+        if deterministic_jsonl():
+            for k in FLIGHT_WALL_FIELDS:
+                if k in row:
+                    row[k] = 0.0
+            if isinstance(row.get("phases"), dict):
+                row["phases"] = {k: 0.0 for k in row["phases"]}
+            if isinstance(row.get("dcn_publish"), dict):
+                row["dcn_publish"] = {
+                    k: (0.0 if k.endswith("_s") else v)
+                    for k, v in row["dcn_publish"].items()
+                }
+        try:
+            self._writer.write(row)
+        except OSError:
+            # Telemetry must never take the replay down mid-flight; a
+            # full disk degrades to a truncated stream, not a crash.
+            self._writer = None
+
+
+def read_stream(path: str):
+    """Parsed flight rows from ``path`` (list of dicts, malformed lines
+    skipped). Shared by bottleneck_report and the tests."""
+    import json
+
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and row.get("kind") == "flight":
+                    rows.append(row)
+    except OSError:
+        return []
+    return rows

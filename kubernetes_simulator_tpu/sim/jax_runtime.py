@@ -97,6 +97,17 @@ def _chunk_ann(ci: int):
         return _NULL_CTX
     return annotate(f"chunk:{ci}")
 
+
+def _file_bytes(path: str) -> int:
+    """Blob size for flight-recorder checkpoint rows (0 when unreadable —
+    observability never takes the replay down)."""
+    import os
+
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
 DEFAULT_PLUGINS = (
     "NodeResourcesFit",
     "TaintToleration",
@@ -603,21 +614,44 @@ class _PodPager:
     the prefetch missed — first chunk, resume jumps); ``prefetch(ci)`` is
     called right after dispatching a chunk, so the next page's H2D copies
     are issued while the device is still scanning — the paged twin of the
-    double-buffered boundary staging."""
+    double-buffered boundary staging.
+
+    Round 16 attribution (flight recorder): ``stalls`` counts prefetch
+    misses (synchronous fetches the pipeline had to wait for),
+    ``stall_s`` is their cumulative wall, ``prefetches`` counts issued
+    prefetches and ``last_stall_s`` the most recent miss's wall. The
+    counters are pure host bookkeeping around the existing fetch — the
+    staged pages and fetch order are unchanged, so paged placements stay
+    bit-identical with or without anyone reading them."""
 
     def __init__(self, fetch):
         self._fetch = fetch
         self._next = None
+        self.stalls = 0
+        self.stall_s = 0.0
+        self.last_stall_s = 0.0
+        self.prefetches = 0
+
+    @property
+    def depth(self) -> int:
+        """Pages currently staged ahead (0 or 1 — the prefetcher is
+        two-deep counting the in-flight chunk's own page)."""
+        return 0 if self._next is None else 1
 
     def get(self, ci: int):
         if self._next is not None and self._next[0] == ci:
             page = self._next[1]
         else:
+            t0 = time.perf_counter()
             page = self._fetch(ci)
+            self.last_stall_s = time.perf_counter() - t0
+            self.stall_s += self.last_stall_s
+            self.stalls += 1
         self._next = None
         return page
 
     def prefetch(self, ci: int) -> None:
+        self.prefetches += 1
         self._next = (ci, self._fetch(ci))
 
 
@@ -829,6 +863,7 @@ class JaxReplayEngine:
         telemetry=None,
         node_shards: int = 0,
         paged: bool = False,
+        flight_recorder=None,
     ):
         """``engine``: "v3" (domain-space state, wave-deferred commits — the
         fast path) or "v2" (node-space planes; also the whatif fallback when
@@ -876,7 +911,13 @@ class JaxReplayEngine:
         via an instrumented reference (v2) chunk program on the plain
         path — plus boundary-sampled depth series; "timeline" adds the
         event log for the Chrome-trace export. "off" disables everything
-        (``ReplayResult.telemetry`` is None)."""
+        (``ReplayResult.telemetry`` is None).
+        ``flight_recorder`` (round 16): None (default, off), a JSONL path,
+        or a :class:`sim.flight.FlightRecorderConfig` — streams one
+        in-flight event per chunk boundary (sim.flight docstring).
+        Bit-parity pinned: placements, deterministic JSONL and checkpoint
+        blobs are identical with the recorder on or off
+        (tests/test_flight.py)."""
         from ..ops import tpu3 as V3
         from .greedy import normalize_preemption
 
@@ -937,6 +978,16 @@ class JaxReplayEngine:
         self.completions = completions
         self.granularity_guard = granularity_guard
         self.telemetry_cfg = TelemetryConfig.resolve(telemetry)
+        # Flight recorder (round 16): validate the spec up front (a bad
+        # path string should fail at construction, not mid-replay); each
+        # replay() opens its own stream from it.
+        from .flight import FlightRecorder, FlightRecorderConfig
+
+        self.flight_recorder = (
+            flight_recorder
+            if isinstance(flight_recorder, FlightRecorder)
+            else FlightRecorderConfig.resolve(flight_recorder)
+        )
         # Replicated-residency refusal (Borg-scale guard): with a per-device
         # byte budget set, a replicated run whose single-scenario planes
         # exceed it is refused UP FRONT with the fix spelled out, instead of
@@ -1119,6 +1170,84 @@ class JaxReplayEngine:
             T.domain_to_node_space(host.pref_wsum, gdom),
             host.match_count.sum(axis=1).astype(np.float32),
         )
+
+    def _open_recorder(self):
+        """(recorder, owns) for this replay: a fresh stream per replay()
+        from the configured spec (owns=True → this replay closes it), or
+        a live shared recorder passed in by the caller (owns=False), or
+        (None, False) — the default, recorder off."""
+        from .flight import FlightRecorder, FlightRecorderConfig
+
+        # Re-resolve here (not just in __init__): callers may assign a
+        # raw path onto .flight_recorder between replays (bench.py turns
+        # the recorder on for the timed run only).
+        spec = FlightRecorderConfig.resolve(self.flight_recorder)
+        if spec is None:
+            return None, False
+        if isinstance(spec, FlightRecorder):
+            return spec, False
+        meta = {
+            "nodes": int(self.ec.num_nodes),
+            "pods": int(self.pods.num_pods),
+            "node_shards": int(self.node_shards),
+            "paged": bool(self.paged),
+            "engine": self.engine,
+            "chunk_waves": int(self.chunk_waves),
+            "resident_bytes": int(
+                replicated_resident_bytes(
+                    self.ec, self.pods,
+                    pods_resident=(self.engine == "v3" and not self.paged),
+                )
+            ),
+        }
+        self._last_flight = FlightRecorder(spec, meta=meta)
+        return self._last_flight, True
+
+    def _make_exchange_probe(self):
+        """Timed probe of the per-slot selection exchange (round 16):
+        a jitted shard_map running the EXACT collective the sharded wave
+        step compiles — one ``all_gather`` of a ``[2 + 2G]`` f32 row
+        over the node axis plus the static (max score, min id) fold
+        (ops.tpu.select_node_sharded). The production chunk program is
+        untouched (the exchange runs inside its scan, where a host clock
+        cannot reach without changing the compiled program — and the
+        compiled program is exactly what bit-parity pins); the probe
+        prices one exchange round at chunk cadence, and the recorder
+        scales it by the chunk's slot count for the per-chunk estimate.
+        Returns a zero-arg callable → seconds for one probed round."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        G = max(self.ec.num_groups, 1)
+        n = self.node_shards
+        axis = self._shard_ctx.axis
+
+        def body(row):
+            allrows = jax.lax.all_gather(row, axis)
+            best = allrows[0]
+            for k in range(1, n):
+                cand = allrows[k]
+                better = (cand[0] > best[0]) | (
+                    (cand[0] == best[0]) & (cand[1] < best[1])
+                )
+                best = jnp.where(better, cand, best)
+            return best
+
+        fn = jax.jit(
+            shard_map(
+                body, mesh=self._node_mesh, in_specs=P(), out_specs=P(),
+                check_rep=False,
+            )
+        )
+        row = jnp.zeros(2 + 2 * G, jnp.float32)
+        jax.block_until_ready(fn(row))  # compile outside the timed loop
+
+        def probe() -> float:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(row))
+            return time.perf_counter() - t0
+
+        return probe
 
     def _save_checkpoint(self, state, cursor: int, all_choices, path: str,
                          released=None, boundary=None) -> None:
@@ -1328,7 +1457,15 @@ class JaxReplayEngine:
             if self.telemetry_cfg.enabled
             else None
         )
-        _tick = _make_tick(tel)
+        # Flight recorder (round 16): same contract as the plain path —
+        # host-side observation only, parity-pinned against recorder-off.
+        rec, rec_own = self._open_recorder()
+        _tick = _make_tick(tel if tel is not None else rec)
+        probe = (
+            self._make_exchange_probe()
+            if rec is not None and self.node_shards > 1
+            else None
+        )
         bops = BoundaryOps(
             self.ec, self.pods, fw,
             WaveBatch(idx=idx, wave_width=self.wave_width),
@@ -1414,13 +1551,31 @@ class JaxReplayEngine:
             nonlocal pending
             if pending is not None:
                 ci_p, rows_p, ch_d, _nf = pending
+                t_f = time.perf_counter()
                 with _tick("device_wait"):
                     ch_np = np.asarray(ch_d)
                 with _tick("boundary_fold"):
                     bops.fold_chunk(ci_p, rows_p, ch_np)
+                if rec is not None:
+                    rec.fold(ci_p, time.perf_counter() - t_f)
                 pending = None
 
         dbuf = self.double_buffer and lazy
+        rec_valid = (
+            np.add.accumulate(
+                [
+                    int((idx[c0 : c0 + C] >= 0).sum())
+                    for c0 in range(0, idx.shape[0], C)
+                ]
+            )
+            if rec is not None
+            else None
+        )
+        rec_pub = None
+        if rec is not None:
+            from ..parallel import dcn as _dcn
+
+            rec_pub = _dcn.publish_stats()
         t0 = time.perf_counter()
         try:
             for ci, c0 in enumerate(range(0, idx.shape[0], C)):
@@ -1556,10 +1711,13 @@ class JaxReplayEngine:
                     # Eager fold: one blocking fetch per chunk. (The
                     # choices buffer is fully consumed here — the mirror
                     # carries the placements, so checkpoints save NO outs.)
+                    t_f = time.perf_counter()
                     with _tick("device_wait"):
                         ch_np = np.asarray(choices)
                     with _tick("boundary_fold"):
                         bops.fold_chunk(ci, idx[c0 : c0 + C], ch_np)
+                    if rec is not None:
+                        rec.fold(ci, time.perf_counter() - t_f)
                 if (
                     checkpoint_path
                     and checkpoint_every
@@ -1575,9 +1733,48 @@ class JaxReplayEngine:
                     # different event list outright.
                     blob["ev_cursor"] = np.asarray([ev_applied], np.int64)
                     blob["ev_hash"] = ev_hash
+                    t_ck = time.perf_counter()
                     self._save_checkpoint(
                         state, ci + 1, [], checkpoint_path,
                         released=bops.released, boundary=blob,
+                    )
+                    if rec is not None:
+                        rec.checkpoint(
+                            ci + 1, _file_bytes(checkpoint_path),
+                            time.perf_counter() - t_ck,
+                        )
+                if rec is not None:
+                    ex_s = probe() if probe is not None else None
+                    if ex_s is not None and tel is not None:
+                        tel.phases.add("selection_exchange", ex_s)
+                    pub_now = _dcn.publish_stats()
+                    ck_pub = None
+                    if pub_now != rec_pub:
+                        ck_pub = {
+                            "count": pub_now["count"] - rec_pub["count"],
+                            "wall_s": round(
+                                pub_now["wall_s"] - rec_pub["wall_s"], 6
+                            ),
+                            "bytes": pub_now["bytes"] - rec_pub["bytes"],
+                        }
+                        rec_pub = pub_now
+                    rec.chunk(
+                        ci,
+                        t_virtual=wave_times[c0],
+                        dispatched=int(rec_valid[ci]),
+                        # Mirror bookkeeping lags one chunk under lazy —
+                        # a liveness gauge, not the parity-bearing count.
+                        placed=int(bops.placed_total),
+                        phase_acc=(
+                            tel.phases.acc
+                            if tel is not None
+                            else rec.phases.acc
+                        ),
+                        exchange_probe_s=ex_s,
+                        exchange_slots=(
+                            C * idx.shape[1] if ex_s is not None else None
+                        ),
+                        ckpt_publish=ck_pub,
                     )
             _fold_pending()
             if self.kube:
@@ -1621,6 +1818,8 @@ class JaxReplayEngine:
             used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
             bound=assignments.copy(),
         )
+        if rec is not None and rec_own:
+            rec.close({"placed": int(placed)})
         return ReplayResult(
             assignments=assignments,
             placed=placed,
@@ -1757,7 +1956,17 @@ class JaxReplayEngine:
             if self.telemetry_cfg.enabled
             else None
         )
-        _tick = _make_tick(tel)
+        # Flight recorder (round 16): pure host-side observation — with
+        # telemetry off it owns the phase timers, so recorder rows still
+        # carry PHASE_NAMES deltas without a collector. Nothing below
+        # changes a device program, a fold order or a checkpoint payload.
+        rec, rec_own = self._open_recorder()
+        _tick = _make_tick(tel if tel is not None else rec)
+        probe = (
+            self._make_exchange_probe()
+            if rec is not None and self.node_shards > 1
+            else None
+        )
         # In-scan rejection attribution (series+): thread a [K] i32 reject
         # counter through the scan carry via the instrumented reference
         # chunk program — one extra fetch per REPLAY, never per pod. The
@@ -1829,8 +2038,10 @@ class JaxReplayEngine:
         wave_times = (
             self._wave_start_times(idx)
             # use_rej: series telemetry also samples utilization at chunk
-            # boundaries, which needs the chunk start times.
-            if (pending_events or completions_on or use_rej)
+            # boundaries, which needs the chunk start times. The recorder
+            # stamps the chunk's virtual time on every row (host numpy
+            # only — no program effect).
+            if (pending_events or completions_on or use_rej or rec is not None)
             else None
         )
         pending_fold = None  # (rows, choices) of the not-yet-folded chunk
@@ -1915,6 +2126,22 @@ class JaxReplayEngine:
                         self.pods, idx[pci * C : (pci + 1) * C]
                     )
             pager = _PodPager(_fetch_page)
+        rec_valid = (
+            np.add.accumulate(
+                [
+                    int((idx[c0 : c0 + C] >= 0).sum())
+                    for c0 in range(0, idx.shape[0], C)
+                ]
+            )
+            if rec is not None
+            else None
+        )
+        rec_stalls_seen = 0
+        rec_pub = None
+        if rec is not None:
+            from ..parallel import dcn as _dcn
+
+            rec_pub = _dcn.publish_stats()
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_chunk:
@@ -2023,6 +2250,7 @@ class JaxReplayEngine:
                     host_assign[rows_p[v]] = ch[v]
                 pending_fold = (idx[c0 : c0 + C], choices)
             if checkpoint_path and checkpoint_every and (ci + 1) % checkpoint_every == 0:
+                t_ck = time.perf_counter()
                 self._save_checkpoint(
                     state, ci + 1, all_choices, checkpoint_path,
                     released=(
@@ -2030,6 +2258,50 @@ class JaxReplayEngine:
                         if completions_on
                         else np.zeros(self.pods.num_pods, bool)
                     ),
+                )
+                if rec is not None:
+                    rec.checkpoint(
+                        ci + 1, _file_bytes(checkpoint_path),
+                        time.perf_counter() - t_ck,
+                    )
+            if rec is not None:
+                if pager is not None and pager.stalls > rec_stalls_seen:
+                    rec.page(ci, pager.last_stall_s, pager.stalls)
+                    rec_stalls_seen = pager.stalls
+                ex_s = probe() if probe is not None else None
+                if ex_s is not None and tel is not None:
+                    tel.phases.add("selection_exchange", ex_s)
+                pub_now = _dcn.publish_stats()
+                ck_pub = None
+                if pub_now != rec_pub:
+                    ck_pub = {
+                        "count": pub_now["count"] - rec_pub["count"],
+                        "wall_s": round(
+                            pub_now["wall_s"] - rec_pub["wall_s"], 6
+                        ),
+                        "bytes": pub_now["bytes"] - rec_pub["bytes"],
+                    }
+                    rec_pub = pub_now
+                rec.chunk(
+                    ci,
+                    t_virtual=(
+                        wave_times[c0] if wave_times is not None else None
+                    ),
+                    dispatched=int(rec_valid[ci]),
+                    placed=(
+                        int((host_assign >= 0).sum())
+                        if completions_on
+                        else None
+                    ),
+                    phase_acc=(
+                        tel.phases.acc if tel is not None else rec.phases.acc
+                    ),
+                    pager=pager,
+                    exchange_probe_s=ex_s,
+                    exchange_slots=(
+                        C * idx.shape[1] if ex_s is not None else None
+                    ),
+                    ckpt_publish=ck_pub,
                 )
         with _tick("device_wait"):
             jax.block_until_ready(all_choices[-1] if all_choices else state)
@@ -2108,6 +2380,14 @@ class JaxReplayEngine:
             used=used, match_count=mc, anti_active=aa, pref_wsum=pw,
             bound=assignments.copy(),
         )
+        if rec is not None:
+            # Pager-stall wall joins the phase accumulators (a key only
+            # present when paging is on AND the recorder observed it, so
+            # the canonical PHASE_NAMES-only runs are unchanged).
+            if pager is not None and tel is not None:
+                tel.phases.add("pager_stall", pager.stall_s)
+            if rec_own:
+                rec.close({"placed": int(placed)})
         return ReplayResult(
             assignments=assignments,
             placed=placed,

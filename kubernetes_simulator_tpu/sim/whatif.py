@@ -2737,6 +2737,10 @@ class WhatIfEngine:
         from ..utils.profiling import profiling_active as _prof_on
 
         run_phases = PhaseTimers()
+        # PUBLISH_STATS is cumulative module state — snapshot it so the
+        # fleet phases below surface only THIS run's publications (a prior
+        # run in the same process must not leak into the phase map).
+        _ps_start = dcn.publish_stats()
         import contextlib as _ctxlib
 
         _null = _ctxlib.nullcontext()
@@ -3302,6 +3306,22 @@ class WhatIfEngine:
                     granularity=self.telemetry_cfg.granularity
                 )
             fleet_local.phases = run_phases.summary()
+            # DCN checkpoint-publication attribution (round 16): the
+            # cumulative encode+push wall, publication count and encoded
+            # MiB ride the fleet phase map (merged under this pid's
+            # namespace). Only present when this process actually
+            # published — single-process runs keep the pinned phase set.
+            _ps = dcn.publish_stats()
+            if _ps["count"] > _ps_start["count"]:
+                fleet_local.phases["ckpt_publish"] = round(
+                    _ps["wall_s"] - _ps_start["wall_s"], 6
+                )
+                fleet_local.phases["ckpt_publish_count"] = float(
+                    _ps["count"] - _ps_start["count"]
+                )
+                fleet_local.phases["ckpt_publish_mib"] = round(
+                    (_ps["bytes"] - _ps_start["bytes"]) / 2**20, 3
+                )
         fleet_tel = None
         # ---- THE end-of-replay gather (round 11, parallel.dcn) ----
         # The one point per replay where processes exchange data: every

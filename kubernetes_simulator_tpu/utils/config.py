@@ -167,6 +167,18 @@ class DcnRecoverySpec:
 
 
 @dataclass
+class FlightRecorderSpec:
+    """Flight recorder (``flightRecorder:`` YAML section, round 16 —
+    sim.flight). ``path`` is the JSONL stream sink (suffixed per process
+    under DCN); ``every`` is the chunk-row cadence (1 = every chunk
+    boundary; page/checkpoint/fold events always emit). jax strategy
+    only — the CPU engine has no chunk loop to record."""
+
+    path: str = "flight.jsonl"
+    every: int = 1
+
+
+@dataclass
 class TelemetrySpec:
     """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
 
@@ -218,6 +230,11 @@ class SimConfig:
     # host->device instead of whole-trace residency (`pagedWaves`).
     node_shards: int = 0
     paged_waves: bool = False
+    # Flight recorder (round 16, jax strategy only): streaming JSONL
+    # observability for long replays (sim.flight). None = off (the
+    # default — the recorder is bit-parity pinned but still costs a
+    # stream).
+    flight_recorder: Optional[FlightRecorderSpec] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SimConfig":
@@ -363,6 +380,14 @@ class SimConfig:
         cfg.device_preemption = dp if isinstance(dp, str) else bool(dp)
         cfg.node_shards = int(d.get("nodeShards", 0))
         cfg.paged_waves = bool(d.get("pagedWaves", False))
+        fr = d.get("flightRecorder")
+        if fr is not None:
+            if isinstance(fr, str):
+                fr = {"path": fr}
+            cfg.flight_recorder = FlightRecorderSpec(
+                path=str(fr.get("path", "flight.jsonl")),
+                every=int(fr.get("every", 1)),
+            )
         return cfg
 
     @classmethod

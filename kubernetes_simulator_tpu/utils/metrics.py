@@ -48,7 +48,15 @@ if not log.handlers:
 #        packing_efficiency (None on paths without host mirrors). All new
 #        fields are virtual-time-deterministic — KSIM_DETERMINISTIC_JSONL
 #        needs no new scrubs.
-SCHEMA_VERSION = 4
+#   v5 — flight recorder (round 16): a new "flight" row kind
+#        (sim.flight.FlightRecorder) with a relaxed base — flight streams
+#        are engine-internal, so rows carry ts/schema/kind but no CLI
+#        context (seed/engine/config_hash). Non-flight rows keep the v4
+#        rules; v1–v4 files validate byte-unchanged.
+#        KSIM_DETERMINISTIC_JSONL zeroes every wall-clock-derived flight
+#        field (sim.flight.FLIGHT_WALL_FIELDS) so fixed-seed recorder
+#        streams are byte-stable.
+SCHEMA_VERSION = 5
 TUNE_SCHEMA_VERSION = 3
 
 

@@ -27,7 +27,13 @@ BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
 - elastic-recovery costs (``detail.dcn_recovery``, round 15): checkpoint
   codec walls and publication overhead are printed informationally and
   NEVER gate — the headline runs with checkpoint publication off, so
-  these price an opt-in feature.
+  these price an opt-in feature;
+- Borg-headline composed block (``detail.borg_headline``, round 16):
+  ``pps`` compared with the headline threshold when both rounds ran the
+  same composed shape (nodes/pods/node_shards/paged); first appearance
+  or a reshape is informational, and the wall / pager-stall / memory-
+  watermark lines (top-level ``rss_peak_mib`` /
+  ``replicated_resident_peak_mib``) never gate.
 
 Accepts both the archived wrapper shape ``{"n", "cmd", "rc", "parsed"}``
 and a raw bench JSON line ``{"metric", "value", ...}``. Exits nonzero
@@ -197,6 +203,66 @@ def compare_pair(
                 regressions.append(line + "  REGRESSION")
             else:
                 notes.append(line)
+
+    # Borg-headline composed run (round 16): same contract as borg_scale
+    # — pps/wall regress only when both rounds ran the same composed
+    # shape; first appearance or a reshape is informational. Memory
+    # watermarks and pager stalls ride along as notes (they move when
+    # the workload mix does, never gate).
+    bha, bhb = da.get("borg_headline"), db.get("borg_headline")
+    if isinstance(bhb, dict) and not isinstance(bha, dict):
+        notes.append(
+            f"borg_headline: first appearance ({bhb.get('nodes')} nodes x "
+            f"{bhb.get('pods')} pods, {bhb.get('node_shards')} shards, "
+            f"pps={bhb.get('pps')}, "
+            f"resident={bhb.get('replicated_resident_mib')} MiB)"
+        )
+    elif isinstance(bha, dict) and isinstance(bhb, dict):
+        same_shape = all(
+            bha.get(k) == bhb.get(k)
+            for k in ("nodes", "pods", "node_shards", "paged")
+        )
+        pa, pb = bha.get("pps"), bhb.get("pps")
+        if not same_shape:
+            notes.append(
+                "borg_headline: shape changed "
+                f"({bha.get('nodes')}x{bha.get('pods')}/"
+                f"{bha.get('node_shards')} -> {bhb.get('nodes')}x"
+                f"{bhb.get('pods')}/{bhb.get('node_shards')}) — "
+                "pps not compared"
+            )
+        elif (
+            isinstance(pa, (int, float))
+            and isinstance(pb, (int, float))
+            and pa > 0
+        ):
+            delta = (pb - pa) / pa
+            line = f"borg_headline pps: {pa:.1f} -> {pb:.1f} ({delta:+.1%})"
+            if pb < pa * (1.0 - threshold):
+                regressions.append(line + "  REGRESSION")
+            else:
+                notes.append(line)
+            wa, wb = bha.get("wall_s"), bhb.get("wall_s")
+            if isinstance(wa, (int, float)) and isinstance(wb, (int, float)):
+                notes.append(
+                    f"borg_headline wall_s: {wa} -> {wb} (informational)"
+                )
+            st_a, st_b = bha.get("pager_stalls"), bhb.get("pager_stalls")
+            if isinstance(st_a, int) and isinstance(st_b, int) and st_b > st_a:
+                notes.append(
+                    f"borg_headline pager_stalls: {st_a} -> {st_b} "
+                    "(informational)"
+                )
+
+    # Memory watermarks (round 16): top-level rss_peak_mib /
+    # replicated_resident_peak_mib — informational trajectory, never a
+    # gate (RSS moves with the allocator, residency with the shape).
+    for key in ("rss_peak_mib", "replicated_resident_peak_mib"):
+        ma, mb = a.get(key), b.get(key)
+        if isinstance(ma, (int, float)) and isinstance(mb, (int, float)):
+            notes.append(f"{key}: {ma} -> {mb} (informational)")
+        elif isinstance(mb, (int, float)) and ma is None:
+            notes.append(f"{key}: first appearance ({mb})")
 
     # Elastic-recovery costs (round 15): NEVER a regression — checkpoint
     # publication is off in the headline, so these walls price an opt-in

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Name the dominant bottleneck regime of a recorded replay.
+
+    python scripts/bottleneck_report.py flight.jsonl [more.jsonl ...]
+
+Reads one or more flight-recorder streams (sim.flight, schema v5
+``kind: "flight"`` rows) and attributes the run's wall clock to the
+four contention surfaces the composed Borg-headline stack exposes,
+then names the DOMINANT regime with its evidence lines:
+
+* ``exchange-bound`` — the per-slot selection exchange under nodeShards
+  (``exchange_est_s``: the timed collective probe scaled to the chunk's
+  slot count) dominates. Remedy direction: fewer/wider shards, chunk
+  fusion.
+* ``pager-bound``    — pagedWaves prefetch stalls (``pager_stall_s`` +
+  per-stall ``page`` events) dominate. Remedy: deeper prefetch,
+  larger pages.
+* ``host-fold-bound`` — boundary folds / host mirrors (phase timers
+  ``boundary_fold`` + ``host_mirror`` + per-fold events) dominate.
+  Remedy: lazier folding, larger chunk_waves.
+* ``dispatch-bound`` — chunk dispatch + device compute dominate; the
+  run is doing the work it exists to do (healthy at scale). Remedy:
+  kernel-level speed work, not orchestration.
+
+Optional: when ``KSIM_PROFILE_DIR`` (or ``--profile-dir <dir>``) holds
+device-profiler traces from the same run, the report lists them next to
+the verdict so the kernel-level follow-up starts from the right files.
+
+Exit 0 with a report when the stream has flight rows, 1 when it has
+none (missing/empty/non-flight file — the recorder was off).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from kubernetes_simulator_tpu.sim.flight import read_stream  # noqa: E402
+
+REGIMES = (
+    "exchange-bound",
+    "pager-bound",
+    "host-fold-bound",
+    "dispatch-bound",
+)
+
+
+def aggregate(rows: List[dict]) -> dict:
+    """Fold a flight stream into the attribution totals the verdict
+    reads. Phase values in chunk rows are DELTAS (sim.flight) — summing
+    them over the stream reconstructs the cumulative accumulator."""
+    agg: dict = {
+        "chunks": 0,
+        "wall_s": 0.0,
+        "placed": None,
+        "dispatched": None,
+        "phases": {},
+        "pager_stalls": 0,
+        "pager_stall_s": 0.0,
+        "exchange_est_s": 0.0,
+        "exchange_probe_s": [],
+        "fold_s": 0.0,
+        "folds": 0,
+        "ckpt_s": 0.0,
+        "ckpt_bytes": 0,
+        "ckpts": 0,
+        "dcn_publish_s": 0.0,
+        "dcn_publishes": 0,
+        "rolling_pps_last": 0.0,
+        "rss_peak_mib": 0.0,
+    }
+    phases: Dict[str, float] = agg["phases"]
+    for r in rows:
+        ev = r.get("event")
+        agg["wall_s"] = max(agg["wall_s"], float(r.get("wall_s", 0.0) or 0.0))
+        agg["rss_peak_mib"] = max(
+            agg["rss_peak_mib"], float(r.get("rss_peak_mib", 0.0) or 0.0)
+        )
+        if ev == "chunk":
+            agg["chunks"] += 1
+            for k, v in (r.get("phases") or {}).items():
+                phases[k] = phases.get(k, 0.0) + float(v)
+            if r.get("placed") is not None:
+                agg["placed"] = int(r["placed"])
+            if r.get("dispatched") is not None:
+                agg["dispatched"] = int(r["dispatched"])
+            agg["pager_stalls"] = max(
+                agg["pager_stalls"], int(r.get("pager_stalls", 0) or 0)
+            )
+            agg["pager_stall_s"] = max(
+                agg["pager_stall_s"], float(r.get("pager_stall_s", 0.0) or 0.0)
+            )
+            if r.get("exchange_est_s") is not None:
+                agg["exchange_est_s"] += float(r["exchange_est_s"])
+            if r.get("exchange_probe_s") is not None:
+                agg["exchange_probe_s"].append(float(r["exchange_probe_s"]))
+            agg["rolling_pps_last"] = float(
+                r.get("rolling_pps", 0.0) or 0.0
+            )
+            pub = r.get("dcn_publish")
+            if isinstance(pub, dict):
+                agg["dcn_publish_s"] += float(pub.get("wall_s", 0.0) or 0.0)
+                agg["dcn_publishes"] += int(pub.get("count", 0) or 0)
+        elif ev == "page":
+            agg["pager_stalls"] = max(
+                agg["pager_stalls"], int(r.get("pager_stalls", 0) or 0)
+            )
+        elif ev == "boundary_fold":
+            agg["folds"] += 1
+            agg["fold_s"] += float(r.get("stall_s", 0.0) or 0.0)
+        elif ev == "checkpoint":
+            agg["ckpts"] += 1
+            agg["ckpt_s"] += float(r.get("ckpt_wall_s", 0.0) or 0.0)
+            agg["ckpt_bytes"] += int(r.get("ckpt_bytes", 0) or 0)
+        elif ev == "end" and r.get("placed") is not None:
+            agg["placed"] = int(r["placed"])
+    return agg
+
+
+def attribute(agg: dict) -> List[Tuple[str, float]]:
+    """(regime, attributed seconds) for the four surfaces, descending.
+    The phase timers and the event walls overlap (folds tick the
+    boundary_fold phase too) — each surface takes the LARGER of its two
+    witnesses, never the sum, so no second is double-counted within a
+    surface."""
+    ph = agg["phases"]
+    exchange = max(
+        agg["exchange_est_s"], ph.get("selection_exchange", 0.0)
+    )
+    pager = max(agg["pager_stall_s"], ph.get("pager_stall", 0.0))
+    fold = max(
+        agg["fold_s"],
+        ph.get("boundary_fold", 0.0) + ph.get("host_mirror", 0.0),
+    )
+    dispatch = ph.get("dispatch", 0.0) + ph.get("device_wait", 0.0)
+    pairs = [
+        ("exchange-bound", exchange),
+        ("pager-bound", pager),
+        ("host-fold-bound", fold),
+        ("dispatch-bound", dispatch),
+    ]
+    return sorted(pairs, key=lambda kv: -kv[1])
+
+
+def profile_traces(profile_dir: Optional[str]) -> List[str]:
+    """Device-profiler trace files under ``profile_dir`` (newest-first),
+    [] when the dir is unset/absent."""
+    if not profile_dir or not os.path.isdir(profile_dir):
+        return []
+    out = []
+    for root, _dirs, files in os.walk(profile_dir):
+        for f in files:
+            if f.endswith((".pb", ".json.gz", ".trace.json.gz", ".xplane.pb")):
+                out.append(os.path.join(root, f))
+    out.sort(key=lambda p: -os.path.getmtime(p))
+    return out
+
+
+def report(paths: List[str], profile_dir: Optional[str] = None) -> Tuple[str, int]:
+    """(report text, exit code) over the concatenated streams."""
+    rows: List[dict] = []
+    for p in paths:
+        rows.extend(read_stream(p))
+    if not rows:
+        return (
+            "bottleneck_report: no flight rows in %s — was the recorder on "
+            "(flightRecorder:/flight_recorder=)?" % ", ".join(paths),
+            1,
+        )
+    agg = aggregate(rows)
+    ranked = attribute(agg)
+    regime, top_s = ranked[0]
+    total = sum(s for _, s in ranked) or 1.0
+    lines = [
+        "== bottleneck report ==",
+        "streams: %s (%d flight rows, %d chunks)"
+        % (", ".join(paths), len(rows), agg["chunks"]),
+        "wall: %.3fs  placed: %s  dispatched: %s  rolling_pps(last): %.1f"
+        % (
+            agg["wall_s"],
+            agg["placed"] if agg["placed"] is not None else "n/a",
+            agg["dispatched"] if agg["dispatched"] is not None else "n/a",
+            agg["rolling_pps_last"],
+        ),
+        "rss_peak: %.1f MiB" % agg["rss_peak_mib"],
+        "",
+        "DOMINANT REGIME: %s (%.3fs attributed, %.0f%% of attributed wall)"
+        % (regime, top_s, 100.0 * top_s / total),
+        "",
+        "evidence:",
+    ]
+    for name, s in ranked:
+        lines.append(
+            "  %-16s %8.3fs  %5.1f%%%s"
+            % (name, s, 100.0 * s / total, "  <-- dominant" if name == regime else "")
+        )
+    lines.append("")
+    if agg["exchange_probe_s"]:
+        probes = agg["exchange_probe_s"]
+        lines.append(
+            "  selection exchange: probe mean %.6fs over %d chunks, "
+            "est total %.3fs"
+            % (sum(probes) / len(probes), len(probes), agg["exchange_est_s"])
+        )
+    lines.append(
+        "  pager: %d stalls, %.3fs stalled" % (agg["pager_stalls"], agg["pager_stall_s"])
+    )
+    lines.append(
+        "  boundary folds: %d events, %.3fs" % (agg["folds"], agg["fold_s"])
+    )
+    if agg["ckpts"]:
+        lines.append(
+            "  checkpoints: %d blobs, %.2f MiB, %.3fs save wall"
+            % (agg["ckpts"], agg["ckpt_bytes"] / 2**20, agg["ckpt_s"])
+        )
+    if agg["dcn_publishes"]:
+        lines.append(
+            "  dcn publications: %d, %.3fs encode+push wall"
+            % (agg["dcn_publishes"], agg["dcn_publish_s"])
+        )
+    for k, v in sorted(agg["phases"].items()):
+        lines.append("  phase %-18s %8.3fs" % (k, v))
+    traces = profile_traces(profile_dir)
+    if traces:
+        lines.append("")
+        lines.append("device-profiler traces (newest first):")
+        for t in traces[:8]:
+            lines.append("  %s" % t)
+    return "\n".join(lines), 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    profile_dir = os.environ.get("KSIM_PROFILE_DIR")
+    if "--profile-dir" in argv:
+        i = argv.index("--profile-dir")
+        try:
+            profile_dir = argv[i + 1]
+        except IndexError:
+            print("--profile-dir requires a directory argument")
+            return 2
+        del argv[i : i + 2]
+    if not argv:
+        print(__doc__.strip())
+        return 2
+    text, code = report(argv, profile_dir)
+    print(text)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

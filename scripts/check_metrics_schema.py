@@ -93,6 +93,70 @@ _OPTIONAL_V4 = {
     "packing_efficiency": (*_NUM, type(None)),
 }
 
+# v5 (flight recorder, round 16): a new "flight" row kind
+# (sim.flight.FlightRecorder) with a RELAXED base — flight streams are
+# engine-internal (not CLI result files), so rows carry ts/schema/kind
+# but no seed/engine/config_hash context. Non-flight v5 rows follow the
+# v4 rules unchanged; v1–v4 files validate byte-unchanged.
+_FLIGHT_REQUIRED = {
+    "event": str,
+    "chunk": int,
+}
+_FLIGHT_EVENTS = (
+    "start", "chunk", "page", "checkpoint", "boundary_fold", "end",
+)
+_OPTIONAL_FLIGHT = {
+    "wall_s": _NUM,
+    "rolling_pps": _NUM,
+    "phases": dict,
+    "rss_peak_mib": _NUM,
+    "t_virtual": (*_NUM, type(None)),
+    "dispatched": int,
+    "placed": int,
+    "pager_depth": int,
+    "pager_stalls": int,
+    "pager_stall_s": _NUM,
+    "stall_s": _NUM,
+    "exchange_probe_s": _NUM,
+    "exchange_slots": int,
+    "exchange_est_s": _NUM,
+    "ckpt_bytes": int,
+    "ckpt_wall_s": _NUM,
+    "ckpt_sink": str,
+    "dcn_publish": dict,
+    "events": int,
+    "resident_bytes": _NUM,
+    "nodes": int,
+    "pods": int,
+    "node_shards": int,
+    "paged": bool,
+    "engine": str,
+    "chunk_waves": int,
+    "process_id": int,
+    "process_count": int,
+}
+
+
+def _validate_flight(row: dict) -> List[str]:
+    errs = []
+    if not isinstance(row.get("ts"), _NUM):
+        errs.append(f"ts: expected a number, got {row.get('ts')!r}")
+    for k, t in _FLIGHT_REQUIRED.items():
+        v = row.get(k)
+        if not isinstance(v, t) or isinstance(v, bool):
+            errs.append(f"{k}: expected {t}, got {v!r}")
+    ev = row.get("event")
+    if isinstance(ev, str) and ev not in _FLIGHT_EVENTS:
+        errs.append(f"event: unknown {ev!r}")
+    for k, t in _OPTIONAL_FLIGHT.items():
+        if k in row and (
+            not isinstance(row[k], t)
+            or (isinstance(row[k], bool) and t is not bool)
+        ):
+            errs.append(f"{k}: expected {t}, got {row[k]!r}")
+    return errs
+
+
 # v3 (policy tuner, sim.tuner): "run_type" is required and "ts" becomes
 # OPTIONAL — trajectory rows are bit-deterministic for a fixed seed +
 # config, so the writer omits the wall-clock stamp (JsonlWriter
@@ -217,7 +281,9 @@ def validate_row(row: dict) -> List[str]:
         return [] if isinstance(row.get("ts"), _NUM) else ["ts: missing"]
     if schema == 3:
         return _validate_v3(row)
-    if schema == 4:
+    if schema == 5 and row.get("kind") == "flight":
+        return _validate_flight(row)
+    if schema in (4, 5):
         for k, t in _OPTIONAL_V4.items():
             if k in row and not isinstance(row[k], t):
                 errs.append(f"{k}: expected {t}, got {row[k]!r}")
@@ -286,7 +352,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     for e in all_errs:
         print(e)
     if not all_errs:
-        print(f"ok: {len(argv)} file(s) validate against schema v2/v3/v4")
+        print(f"ok: {len(argv)} file(s) validate against schema v2/v3/v4/v5")
     return 1 if all_errs else 0
 
 

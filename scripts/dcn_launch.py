@@ -92,13 +92,69 @@ class FleetWatch:
         nproc: int,
         stall_s: float = 60.0,
         lag_frac: float = 0.25,
+        flight_path: str = "",
     ):
         self.hb_dir = hb_dir
         self.nproc = nproc
         self.stall_s = stall_s
         self.lag_frac = lag_frac
+        self.flight_path = flight_path
         self._prev: dict = {}  # pid -> (chunk, t) of the last rate sample
         self._ev_pos = 0  # bytes of events.jsonl already surfaced
+        self._fl_pos: dict = {}  # flight stream path -> byte cursor
+
+    def flight_lines(self) -> list:
+        """Round 16: recorder lines for live runs. Tails the flight
+        stream at ``flight_path`` (process 0) and its ``.p<pid>``
+        siblings with a byte cursor per file, and renders the newest
+        chunk row of each as a one-line gauge: rolling placements/sec,
+        pager stalls, exchange ms. Tolerant of a missing/partial stream
+        — the recorder is off by default, and a mid-write tail just
+        waits for the next interval."""
+        if not self.flight_path:
+            return []
+        out = []
+        for pid in range(self.nproc):
+            path = (
+                self.flight_path if pid == 0
+                else f"{self.flight_path}.p{pid}"
+            )
+            try:
+                with open(path) as f:
+                    f.seek(self._fl_pos.get(path, 0))
+                    blob = f.read()
+                    self._fl_pos[path] = f.tell()
+            except OSError:
+                continue
+            last = None
+            stalls = None
+            for line in blob.splitlines():
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail of a mid-write line
+                if not isinstance(row, dict) or row.get("kind") != "flight":
+                    continue
+                if row.get("event") == "chunk":
+                    last = row
+                if row.get("pager_stalls") is not None:
+                    stalls = int(row["pager_stalls"])
+            if last is None:
+                continue
+            seg = (
+                f"p{pid} flight chunk {last.get('chunk', '?')}"
+                f" {float(last.get('rolling_pps', 0.0)):.0f}pps"
+            )
+            if stalls is not None:
+                seg += f" stalls={stalls}"
+            if last.get("exchange_est_s") is not None:
+                seg += (
+                    f" exch={1e3 * float(last['exchange_est_s']):.1f}ms"
+                )
+            if last.get("rss_peak_mib"):
+                seg += f" rss={float(last['rss_peak_mib']):.0f}MiB"
+            out.append(f"dcn_launch[watch]: {seg}")
+        return out
 
     def events(self) -> list:
         """New claim/recovery events from the KV mirror's append-only
@@ -229,6 +285,16 @@ def main(argv=None) -> int:
         "--watch-interval", type=float, default=2.0,
         help="seconds between --watch progress lines",
     )
+    ap.add_argument(
+        "--flight", default=os.environ.get("KSIM_FLIGHT_WATCH", ""),
+        metavar="PATH",
+        help="round 16: with --watch, also tail this flight-recorder "
+             "stream (process 0's path; .p<pid> siblings are tailed "
+             "automatically) and print rolling pps / pager stalls / "
+             "exchange ms per process — point it at the same path the "
+             "children's flightRecorder: config writes. Missing streams "
+             "are tolerated (the recorder is off by default)",
+    )
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to run in every process (after --)")
     args = ap.parse_args(argv)
@@ -259,6 +325,7 @@ def main(argv=None) -> int:
         watch = FleetWatch(
             hb_dir, nproc,
             stall_s=float(os.environ.get("KSIM_DCN_STALL_S", "60")),
+            flight_path=args.flight,
         )
     port = free_port()
     procs, tails = [], []
@@ -299,6 +366,8 @@ def main(argv=None) -> int:
                 beats = watch.read()
                 if beats:
                     print(watch.line(beats), file=sys.stderr)
+                for fl in watch.flight_lines():
+                    print(fl, file=sys.stderr)
             if time.monotonic() > deadline:
                 print(
                     f"dcn_launch: timeout after {args.timeout}s",

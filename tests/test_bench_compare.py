@@ -23,7 +23,8 @@ from bench_compare import (  # noqa: E402
 )
 
 
-def _bench(value, phases=None, dcn=None, borg=None, recovery=None):
+def _bench(value, phases=None, dcn=None, borg=None, recovery=None,
+           headline=None, **top):
     detail = {}
     if phases is not None:
         detail["phases"] = phases
@@ -33,8 +34,10 @@ def _bench(value, phases=None, dcn=None, borg=None, recovery=None):
         detail["borg_scale"] = borg
     if recovery is not None:
         detail["dcn_recovery"] = recovery
+    if headline is not None:
+        detail["borg_headline"] = headline
     return {"metric": "pps", "value": value, "unit": "1/s",
-            "detail": detail}
+            "detail": detail, **top}
 
 
 def _write(tmp_path, name, doc, wrap=False):
@@ -110,6 +113,52 @@ def test_borg_scale_comparison():
     reg, notes = compare_pair(
         "a", a, "b", _bench(100.0, borg=_borg(1.0, nodes=2000)), 0.10)
     assert reg == [] and any("shape changed" in n for n in notes)
+
+
+def _headline(pps, nodes=1000, pods=20000, shards=8, paged=True,
+              wall=4.0, stalls=0):
+    return {"nodes": nodes, "pods": pods, "node_shards": shards,
+            "paged": paged, "pps": pps, "wall_s": wall,
+            "pager_stalls": stalls, "replicated_resident_mib": 12.5}
+
+
+def test_borg_headline_comparison():
+    # Round 16: same composed shape, pps drop beyond threshold regresses.
+    a = _bench(100.0, headline=_headline(5000.0))
+    b = _bench(100.0, headline=_headline(4000.0, wall=5.0, stalls=3))
+    reg, notes = compare_pair("a", a, "b", b, 0.10)
+    assert len(reg) == 1 and "borg_headline pps" in reg[0]
+    # The wall and pager-stall lines ride along as notes, never gating.
+    assert any("borg_headline wall_s" in n for n in notes)
+    assert any("borg_headline pager_stalls" in n for n in notes)
+    # Within threshold: informational note.
+    reg, notes = compare_pair(
+        "a", a, "b", _bench(100.0, headline=_headline(4900.0)), 0.10)
+    assert reg == [] and any("borg_headline pps" in n for n in notes)
+    # First appearance: informational, never a regression.
+    reg, notes = compare_pair("a", _bench(100.0), "b", b, 0.10)
+    assert reg == [] and any(
+        "borg_headline: first appearance" in n for n in notes)
+    # Shape changed: pps not compared.
+    reg, notes = compare_pair(
+        "a", a, "b", _bench(100.0, headline=_headline(1.0, shards=16)), 0.10)
+    assert reg == [] and any(
+        "borg_headline: shape changed" in n for n in notes)
+
+
+def test_memory_watermarks_are_notes():
+    # Round 16: top-level rss/residency watermarks never gate — a 10x RSS
+    # growth is a note (the allocator moves, the gate is the pps).
+    a = _bench(100.0, rss_peak_mib=300.0, replicated_resident_peak_mib=40.0)
+    b = _bench(100.0, rss_peak_mib=3000.0, replicated_resident_peak_mib=80.0)
+    reg, notes = compare_pair("a", a, "b", b, 0.10)
+    assert reg == []
+    assert any("rss_peak_mib: 300.0 -> 3000.0" in n for n in notes)
+    assert any("replicated_resident_peak_mib" in n for n in notes)
+    # First appearance when the old round predates the stamp.
+    reg, notes = compare_pair("a", _bench(100.0), "b", b, 0.10)
+    assert reg == [] and any(
+        "rss_peak_mib: first appearance" in n for n in notes)
 
 
 def test_dcn_recovery_block_is_informational_only():

@@ -1,0 +1,351 @@
+"""Round 16: the flight recorder (sim.flight) and its bit-parity pin.
+
+The contract under test: the recorder is a pure OBSERVER. Turning it on
+changes no placement, no deterministic JSONL byte, and no checkpoint
+blob byte across every engine mode it instruments — plain, nodeShards,
+pagedWaves, kube-boundary — including a cross-mode resume. Its own
+stream is schema-v5 valid, byte-stable for a fixed seed under
+KSIM_DETERMINISTIC_JSONL, and carries the attribution the bottleneck
+report names regimes from. Pager stall counters are pinned on a crafted
+slow-page trace (a sleeping fetch) without any engine in the loop.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+from kubernetes_simulator_tpu.models.encode import encode
+from kubernetes_simulator_tpu.sim.flight import (
+    FLIGHT_WALL_FIELDS,
+    FlightRecorder,
+    FlightRecorderConfig,
+    read_stream,
+    rss_peak_mib,
+)
+from kubernetes_simulator_tpu.sim.jax_runtime import (
+    JaxReplayEngine,
+    _PodPager,
+)
+from kubernetes_simulator_tpu.sim.synthetic import make_cluster, make_workload
+
+sys.path.insert(
+    0,
+    os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "scripts")
+    ),
+)
+
+
+def _case(n_nodes=24, n_pods=160, seed=7):
+    cluster = make_cluster(n_nodes, seed=seed, taint_fraction=0.2)
+    pods, _ = make_workload(
+        n_pods, seed=seed, with_affinity=True, with_spread=True,
+        with_tolerations=True, gang_fraction=0.1, gang_size=4,
+        duration_mean=40.0,
+    )
+    return encode(cluster, pods)
+
+
+@pytest.fixture(scope="module")
+def case():
+    return _case()
+
+
+# Engine-mode matrix: kwargs beyond (ec, ep, cfg, chunk_waves=4).
+MODES = {
+    "plain": {},
+    "nodeShards": {"node_shards": 2},
+    "pagedWaves": {"paged": True},
+    "kube-boundary": {"preemption": "kube", "retry_buffer": 64},
+}
+
+
+def _stable_summary(res):
+    row = dict(res.summary())
+    for k in ("wall_clock_s", "placements_per_sec"):
+        row.pop(k, None)
+    return row
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_recorder_bit_parity(case, tmp_path, mode):
+    """Recorder on vs off: assignments and stable summaries identical in
+    every engine mode — the recorder never touches a device program."""
+    ec, ep = case
+    kw = dict(MODES[mode], chunk_waves=4, telemetry="off")
+    off = JaxReplayEngine(ec, ep, FrameworkConfig(), **kw).replay()
+    on = JaxReplayEngine(
+        ec, ep, FrameworkConfig(),
+        flight_recorder=str(tmp_path / f"{mode}.jsonl"), **kw,
+    ).replay()
+    np.testing.assert_array_equal(
+        on.assignments, off.assignments,
+        err_msg=f"{mode}: recorder-on assignments diverged",
+    )
+    assert _stable_summary(on) == _stable_summary(off)
+    rows = read_stream(str(tmp_path / f"{mode}.jsonl"))
+    assert rows and rows[0]["event"] == "start"
+    assert rows[-1]["event"] == "end"
+    assert any(r["event"] == "chunk" for r in rows)
+
+
+def test_recorder_checkpoint_blobs_identical_and_cross_mode_resume(
+    case, tmp_path
+):
+    """Checkpoint blobs byte-identical recorder on/off, and a blob
+    written recorder-ON under nodeShards resumes recorder-OFF under
+    pagedWaves (cross-mode resume) to the same end state."""
+    ec, ep = case
+    ref = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), chunk_waves=4, telemetry="off",
+    ).replay()
+    digests = {}
+    for tag, rec in (("off", None), ("on", str(tmp_path / "fl.jsonl"))):
+        p = tmp_path / f"ckpt_{tag}.npz"
+        res = JaxReplayEngine(
+            ec, ep, FrameworkConfig(), chunk_waves=4, node_shards=2,
+            telemetry="off", flight_recorder=rec,
+        ).replay(checkpoint_path=str(p), checkpoint_every=2)
+        np.testing.assert_array_equal(res.assignments, ref.assignments)
+        digests[tag] = hashlib.sha256(p.read_bytes()).hexdigest()
+    assert digests["on"] == digests["off"], (
+        "flight recorder changed a checkpoint blob byte — it must be a "
+        "pure observer"
+    )
+    # Recorder-on checkpoint blob events carry the real blob size.
+    rows = read_stream(str(tmp_path / "fl.jsonl"))
+    cks = [r for r in rows if r["event"] == "checkpoint"]
+    assert cks and all(
+        r["ckpt_bytes"] == os.path.getsize(tmp_path / "ckpt_on.npz")
+        for r in cks[-1:]
+    )
+    # Cross-mode resume: sharded+recorded blob under a paged engine.
+    res = JaxReplayEngine(
+        ec, ep, FrameworkConfig(), chunk_waves=4, paged=True,
+        telemetry="off",
+    ).replay(checkpoint_path=str(tmp_path / "ckpt_on.npz"), resume=True)
+    np.testing.assert_array_equal(res.assignments, ref.assignments)
+
+
+def test_deterministic_jsonl_parity_and_byte_stability(
+    case, tmp_path, monkeypatch
+):
+    """Under KSIM_DETERMINISTIC_JSONL: (a) the replay-result JSONL is
+    byte-identical recorder on/off, (b) two recorder streams of the same
+    seed are byte-identical to each other (every wall-derived field is
+    zeroed, counts/virtual-times stay)."""
+    from kubernetes_simulator_tpu.utils.metrics import JsonlWriter, replay_row
+
+    monkeypatch.setenv("KSIM_DETERMINISTIC_JSONL", "1")
+    ec, ep = case
+    blobs = {}
+    for tag, rec in (
+        ("off", None),
+        ("on1", str(tmp_path / "fl1.jsonl")),
+        ("on2", str(tmp_path / "fl2.jsonl")),
+    ):
+        res = JaxReplayEngine(
+            ec, ep, FrameworkConfig(), chunk_waves=4, telemetry="off",
+            flight_recorder=rec,
+        ).replay()
+        p = tmp_path / f"res_{tag}.jsonl"
+        with JsonlWriter(str(p)) as w:
+            w.write(replay_row("replay-jax", res))
+        blobs[tag] = p.read_bytes()
+    assert blobs["off"] == blobs["on1"] == blobs["on2"]
+    fl1 = (tmp_path / "fl1.jsonl").read_bytes()
+    fl2 = (tmp_path / "fl2.jsonl").read_bytes()
+    assert fl1 == fl2, "fixed-seed recorder streams are not byte-stable"
+    for row in read_stream(str(tmp_path / "fl1.jsonl")):
+        for k in FLIGHT_WALL_FIELDS:
+            if k in row:
+                assert row[k] == 0.0, f"{row['event']}: {k} not scrubbed"
+        for v in (row.get("phases") or {}).values():
+            assert v == 0.0
+
+
+def test_flight_stream_validates_against_schema_v5(case, tmp_path):
+    from check_metrics_schema import validate_file  # noqa: E402
+
+    ec, ep = case
+    path = str(tmp_path / "fl.jsonl")
+    JaxReplayEngine(
+        ec, ep, FrameworkConfig(), chunk_waves=4, node_shards=2,
+        paged=False, telemetry="summary", flight_recorder=path,
+    ).replay()
+    assert validate_file(path) == []
+    rows = read_stream(path)
+    assert all(r["schema"] == 5 for r in rows)
+    # The sharded run's chunk rows carry the exchange attribution.
+    cks = [r for r in rows if r["event"] == "chunk"]
+    assert cks and all("exchange_est_s" in r for r in cks)
+
+
+def test_pager_stall_counters_on_crafted_slow_page_trace():
+    """Stall accounting pinned without an engine: a sleeping fetch, a
+    prefetch-miss access pattern, exact stall counts and a wall lower
+    bound. The counters are the recorder's pager evidence."""
+    DELAY = 0.02
+    fetched = []
+
+    def slow_fetch(ci):
+        fetched.append(ci)
+        time.sleep(DELAY)
+        return ci * 10
+
+    pager = _PodPager(slow_fetch)
+    assert (pager.depth, pager.stalls, pager.prefetches) == (0, 0, 0)
+    # Chunk 0: nothing prefetched — a synchronous stall.
+    assert pager.get(0) == 0
+    assert pager.stalls == 1 and pager.stall_s >= DELAY
+    assert pager.last_stall_s >= DELAY
+    # Steady state: prefetch hides the fetch — no new stalls.
+    pager.prefetch(1)
+    assert pager.depth == 1 and pager.prefetches == 1
+    assert pager.get(1) == 10
+    assert pager.stalls == 1 and pager.depth == 0
+    # Resume-style jump (prefetched 2, asked for 5): a second stall.
+    pager.prefetch(2)
+    assert pager.get(5) == 50
+    assert pager.stalls == 2 and pager.stall_s >= 2 * DELAY
+    assert fetched == [0, 1, 2, 5]
+
+
+def test_recorder_page_events_and_stall_rows(case, tmp_path):
+    """A paged replay's recorder stream carries the pager gauges on
+    chunk rows and a page event for the cold-start stall."""
+    ec, ep = case
+    path = str(tmp_path / "fl.jsonl")
+    JaxReplayEngine(
+        ec, ep, FrameworkConfig(), chunk_waves=4, paged=True,
+        telemetry="off", flight_recorder=path,
+    ).replay()
+    rows = read_stream(path)
+    pages = [r for r in rows if r["event"] == "page"]
+    assert pages, "cold-start prefetch miss did not emit a page event"
+    assert pages[0]["pager_stalls"] >= 1
+    cks = [r for r in rows if r["event"] == "chunk"]
+    assert all("pager_stalls" in r and "pager_depth" in r for r in cks)
+
+
+def test_recorder_config_resolve_and_off_by_default(case):
+    ec, ep = case
+    eng = JaxReplayEngine(ec, ep, FrameworkConfig(), chunk_waves=4)
+    assert eng.flight_recorder is None  # OFF by default
+    assert FlightRecorderConfig.resolve(None) is None
+    cfg = FlightRecorderConfig.resolve("x.jsonl")
+    assert isinstance(cfg, FlightRecorderConfig) and cfg.every == 1
+    assert FlightRecorderConfig.resolve(cfg) is cfg
+    with pytest.raises(ValueError, match="flight_recorder"):
+        FlightRecorderConfig.resolve(123)
+    assert rss_peak_mib() > 0.0
+
+
+def test_recorder_every_cadence(tmp_path):
+    """every=N thins chunk rows to the cadence; start/end always emit."""
+    rec = FlightRecorder(
+        FlightRecorderConfig(path=str(tmp_path / "f.jsonl"), every=3)
+    )
+    for ci in range(7):
+        rec.chunk(ci, dispatched=ci)
+    rec.close()
+    rows = read_stream(str(tmp_path / "f.jsonl"))
+    assert [r["chunk"] for r in rows if r["event"] == "chunk"] == [0, 3, 6]
+    assert rows[0]["event"] == "start" and rows[-1]["event"] == "end"
+
+
+def test_bottleneck_report_names_regime(case, tmp_path, capsys):
+    """End to end: record a composed (sharded × paged is refused, so
+    sharded) replay, run the report, get a named dominant regime with
+    evidence."""
+    from bottleneck_report import REGIMES, main as report_main  # noqa: E402
+
+    ec, ep = case
+    path = str(tmp_path / "fl.jsonl")
+    JaxReplayEngine(
+        ec, ep, FrameworkConfig(), chunk_waves=4, node_shards=2,
+        telemetry="summary", flight_recorder=path,
+    ).replay()
+    assert report_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "DOMINANT REGIME:" in out
+    assert any(r in out for r in REGIMES)
+    assert "selection exchange" in out
+    # Missing stream: exit 1 with a pointer, no traceback.
+    assert report_main([str(tmp_path / "missing.jsonl")]) == 1
+
+
+def test_bottleneck_report_synthetic_regimes(tmp_path):
+    """Regime naming pinned on crafted streams: a stream dominated by
+    pager stalls is pager-bound, one dominated by exchange time is
+    exchange-bound, one dominated by folds is host-fold-bound."""
+    from bottleneck_report import aggregate, attribute  # noqa: E402
+
+    def _mk(name, rows):
+        p = tmp_path / f"{name}.jsonl"
+        p.write_text(
+            "\n".join(
+                json.dumps({"kind": "flight", "schema": 5, "ts": 0, **r})
+                for r in rows
+            )
+            + "\n"
+        )
+        return str(p)
+
+    pager_rows = [
+        {"event": "chunk", "chunk": 0, "wall_s": 1.0,
+         "phases": {"dispatch": 0.1}, "pager_stalls": 4,
+         "pager_stall_s": 0.9},
+    ]
+    exch_rows = [
+        {"event": "chunk", "chunk": 0, "wall_s": 1.0,
+         "phases": {"dispatch": 0.1}, "exchange_probe_s": 0.001,
+         "exchange_slots": 900, "exchange_est_s": 0.9},
+    ]
+    fold_rows = [
+        {"event": "boundary_fold", "chunk": 0, "stall_s": 0.9,
+         "wall_s": 0.9},
+        {"event": "chunk", "chunk": 0, "wall_s": 1.0,
+         "phases": {"dispatch": 0.1}},
+    ]
+    for name, rows, want in (
+        ("pager", pager_rows, "pager-bound"),
+        ("exch", exch_rows, "exchange-bound"),
+        ("fold", fold_rows, "host-fold-bound"),
+    ):
+        ranked = attribute(aggregate(
+            [json.loads(line) for line in open(_mk(name, rows))]
+        ))
+        assert ranked[0][0] == want, f"{name}: got {ranked[0]}"
+
+
+def test_fleetwatch_flight_lines_tolerant(tmp_path):
+    """dcn_launch --watch --flight: renders recorder gauges per process
+    and tolerates a missing stream / torn tail entirely."""
+    from dcn_launch import FleetWatch  # noqa: E402
+
+    fl = tmp_path / "fl.jsonl"
+    w = FleetWatch(str(tmp_path), 2, flight_path=str(fl))
+    assert w.flight_lines() == []  # no stream yet: silent
+    fl.write_text(
+        json.dumps({"kind": "flight", "event": "chunk", "chunk": 3,
+                    "rolling_pps": 1234.5, "pager_stalls": 2,
+                    "exchange_est_s": 0.012, "rss_peak_mib": 300.0})
+        + "\n"
+    )
+    (tmp_path / "fl.jsonl.p1").write_text('{"torn json\n')
+    lines = w.flight_lines()
+    assert len(lines) == 1
+    assert "p0 flight chunk 3" in lines[0]
+    assert "1234pps" in lines[0] or "1235pps" in lines[0]
+    assert "stalls=2" in lines[0] and "exch=12.0ms" in lines[0]
+    # Byte cursor: nothing new → nothing repeated.
+    assert w.flight_lines() == []
+    # Recorder off entirely: FleetWatch without a flight path is silent.
+    assert FleetWatch(str(tmp_path), 2).flight_lines() == []
