@@ -271,6 +271,93 @@ def main():
             }
         }
 
+    # Faultline costs (round 17) — informational detail only
+    # (bench_compare.py never gates on it). Prices the hardening layer
+    # under a FIXED injected schedule, no fleet needed:
+    #   * retry_*: kv_retry absorbing a seeded 30% transient-error storm
+    #     (tiny real backoff so the wall is the helper's, not a sleep).
+    #   * crc_frame_*: CRC32+length framing overhead over a carrier-
+    #     shaped blob, as a % of the round-14 codec's encode wall.
+    #   * torn detection + fallback_recovery_wall_s: every blob the
+    #     injector tears must be rejected by the frame check, and the
+    #     wall is the full fallback path — reject the corrupt newest
+    #     cursor, unframe + decode the prior complete one.
+    fault_block = {}
+    if int(os.environ.get("BENCH_FAULTLINE", "1") or 0):
+        from kubernetes_simulator_tpu.parallel import faultline
+        from kubernetes_simulator_tpu.parallel.dcn import (
+            DcnRetryError,
+            _decode_payload,
+            _encode_payload,
+            _frame_chunk,
+            _unframe_chunk,
+            kv_retry,
+        )
+
+        inj = faultline.Injector(seed=17, pid=0, kv_error_rate=0.3)
+
+        def _flaky_op():
+            if inj.hit("kv_error"):
+                raise faultline.FaultlineInjected("bench")
+
+        rs0 = dcn.retry_stats()
+        n_ops, gaveup = 64, 0
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            try:
+                kv_retry(
+                    _flaky_op, op="bench", attempts=4,
+                    base_s=1e-4, cap_s=4e-4,
+                )
+            except DcnRetryError:
+                gaveup += 1
+        retry_wall = time.perf_counter() - t0
+        rs1 = dcn.retry_stats()
+
+        rng_f = np.random.default_rng(17)
+        snap_f = {
+            "cursor": 3,
+            "leaves": {
+                "states": rng_f.integers(
+                    -1, nodes, size=(256, 512), dtype=np.int32
+                )
+            },
+        }
+        t0 = time.perf_counter()
+        raw_f = _encode_payload(snap_f)
+        enc_f = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        framed = [_frame_chunk(c) for c in raw_f]
+        frame_s = time.perf_counter() - t0
+        tear_inj = faultline.Injector(seed=17, pid=0, torn_write_rate=1.0)
+        torn = [tear_inj.tear(c) for c in framed]
+        detected = 0
+        t0 = time.perf_counter()
+        for bad in torn:
+            try:
+                _unframe_chunk(bad)
+            except ValueError:
+                detected += 1
+        _decode_payload(_unframe_chunk(c) for c in framed)
+        fallback_wall = time.perf_counter() - t0
+        fault_block = {
+            "fault_injection": {
+                "injected_kv_error_rate": 0.3,
+                "retry_ops": n_ops,
+                "retry_count": rs1["retries"] - rs0["retries"],
+                "retry_giveups": gaveup,
+                "retry_wall_s": round(retry_wall, 4),
+                "crc_frame_wall_s": round(frame_s, 4),
+                "crc_frame_overhead_pct": round(
+                    100.0 * frame_s / enc_f if enc_f > 0 else 0.0, 1
+                ),
+                "torn_injected": len(torn),
+                "torn_detected": detected,
+                "fallback_count": len(torn),
+                "fallback_recovery_wall_s": round(fallback_wall, 4),
+            }
+        }
+
     scaling = {}
     if mesh is not None and nproc == 1:
         runs_ref = max(1, int(os.environ.get("BENCH_REF_RUNS", 2)))
@@ -601,6 +688,7 @@ def main():
                     ),
                     **dcn_block,
                     **rec_block,
+                    **fault_block,
                     **scaling,
                     **cont,
                     **tune_sweep,

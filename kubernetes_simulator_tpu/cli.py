@@ -302,6 +302,56 @@ def _recovery_errors(cfg) -> list:
     return errors
 
 
+def _faultline_errors(cfg) -> list:
+    """Actionable refusals for the ``faultline:`` section (round 17).
+    Shared by validate_config and the pre-dispatch env export in main().
+    Negative rates/seeds and malformed kill schedules are refused;
+    injection with recovery disabled is LEGAL but warned — every injected
+    kill or retry give-up then fails the fleet attributed instead of
+    recovering, which is occasionally what a drill wants."""
+    fl = getattr(cfg, "faultline", None)
+    if fl is None:
+        return []
+    errors = []
+    if fl.seed < 0:
+        errors.append(
+            "faultline.seed: must be >= 0 (the seed derives every "
+            "per-class injection stream)"
+        )
+    for attr, yaml_key in (
+        ("kv_error_rate", "kvErrorRate"),
+        ("kv_delay_rate", "kvDelayRate"),
+        ("torn_write_rate", "tornWriteRate"),
+        ("stale_read_rate", "staleReadRate"),
+    ):
+        rate = getattr(fl, attr)
+        if not (0.0 <= rate <= 1.0):
+            errors.append(
+                f"faultline.{yaml_key}: must be in [0, 1], got {rate!r} "
+                "(a per-operation injection probability)"
+            )
+    if fl.kv_delay_s < 0:
+        errors.append("faultline.kvDelayS: must be >= 0 seconds")
+    if fl.kill:
+        from .parallel import faultline as _faultline
+
+        try:
+            _faultline.parse_kill_schedule(str(fl.kill))
+        except ValueError as e:
+            errors.append(f"faultline.kill: {e}")
+    if not fl.enabled:
+        return errors
+    rec = getattr(cfg, "dcn_recovery", None)
+    if rec is None or not rec.enable:
+        log.warning(
+            "faultline: injection enabled with dcn.recovery disabled — "
+            "injected kills and retry give-ups will fail the fleet with "
+            "an attributed error instead of recovering (set "
+            "dcn.recovery.enable to drill the recovery path)"
+        )
+    return errors
+
+
 def validate_config(cfg) -> list:
     """Structural checks → list of actionable error strings (empty = ok)."""
     from .framework.registry import available_strategies
@@ -555,6 +605,7 @@ def validate_config(cfg) -> list:
                 "pagedWaves: true)"
             )
     errors.extend(_recovery_errors(cfg))
+    errors.extend(_faultline_errors(cfg))
     return errors
 
 
@@ -622,6 +673,30 @@ def main(argv=None) -> int:
             os.environ.setdefault(
                 "KSIM_DCN_MAX_CLAIMS", str(rec.max_claims)
             )
+        # Faultline injection knobs (round 17, faultline:) ride the same
+        # pre-dispatch export — the KV-client wrapper reads KSIM_FAULTLINE_*
+        # lazily, but a consistent fleet wants them pinned before any
+        # worker touches the coordination plane.
+        fl = cfg_pre.faultline if cfg_pre is not None else None
+        if fl is not None and fl.enabled:
+            errors = _faultline_errors(cfg_pre)
+            if errors:
+                for e in errors:
+                    log.error("config: %s", e)
+                return 2
+            os.environ.setdefault("KSIM_FAULTLINE", "1")
+            os.environ.setdefault("KSIM_FAULTLINE_SEED", str(fl.seed))
+            for val, env in (
+                (fl.kv_error_rate, "KSIM_FAULTLINE_KV_ERROR_RATE"),
+                (fl.kv_delay_rate, "KSIM_FAULTLINE_KV_DELAY_RATE"),
+                (fl.kv_delay_s, "KSIM_FAULTLINE_KV_DELAY_S"),
+                (fl.torn_write_rate, "KSIM_FAULTLINE_TORN_RATE"),
+                (fl.stale_read_rate, "KSIM_FAULTLINE_STALE_RATE"),
+            ):
+                if val:
+                    os.environ.setdefault(env, str(val))
+            if fl.kill:
+                os.environ.setdefault("KSIM_FAULTLINE_KILL", str(fl.kill))
     # Multi-host DCN bring-up (round 11): a no-op without the
     # KSIM_DCN_* env set by scripts/dcn_launch.py. Enables the compile
     # cache BEFORE jax.distributed.initialize (documented ordering).

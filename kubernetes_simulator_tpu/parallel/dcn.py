@@ -34,7 +34,9 @@ import base64
 import json
 import os
 import pickle
+import random
 import time
+import zlib
 from typing import Dict, Optional
 
 import numpy as np
@@ -249,11 +251,109 @@ def _client():
             "parallel.mesh.init_distributed (or run under "
             "scripts/dcn_launch.py) before gathering"
         )
-    return c
+    # Round 17: every KV touch flows through here, so this is the single
+    # injection point for faultline's deterministic fault schedules.
+    # Identity when KSIM_FAULTLINE is off.
+    from . import faultline
+
+    return faultline.wrap_kv(c)
 
 
 def _timeout_ms() -> int:
     return int(float(os.environ.get("KSIM_DCN_TIMEOUT_S", "300")) * 1000)
+
+
+# -- bounded KV retries (round 17) -------------------------------------------
+#
+# Before faultline, every coordination-plane KV call was a single
+# unretried gRPC round trip — one transient error could fail a heartbeat,
+# lose a claim, or abort the end gather. kv_retry is THE retry policy:
+# bounded attempts, exponential backoff with jitter, and an attributed
+# DcnRetryError on give-up. Applied to heartbeats, claims, checkpoint
+# publication and the gather publication; the gather's GETs keep their
+# own poll loop (_get_attributed), which already retries by construction.
+
+RETRY_STATS = {"attempts": 0, "retries": 0, "giveups": 0, "backoff_s": 0.0}
+
+
+def retry_stats() -> dict:
+    """Snapshot of :data:`RETRY_STATS` (copy — callers diff it)."""
+    return dict(RETRY_STATS)
+
+
+class DcnRetryError(RuntimeError):
+    """A bounded KV retry gave up. Carries the operation, key, attempt
+    count and last error so a fleet failure is attributed to the exact
+    coordination op that exhausted its budget."""
+
+    def __init__(self, op: str, key: str, attempts: int, elapsed_s: float, last):
+        super().__init__(
+            f"dcn: {op} on {key!r} gave up after {attempts} attempts over "
+            f"{elapsed_s:.2f}s of bounded backoff "
+            f"(KSIM_DCN_RETRIES/KSIM_DCN_RETRY_BASE_S); last error: {last!r}"
+        )
+        self.op = op
+        self.key = key
+        self.attempts = attempts
+        self.last = last
+
+
+def _retry_attempts() -> int:
+    try:
+        return max(int(os.environ.get("KSIM_DCN_RETRIES", "4")), 1)
+    except ValueError:
+        return 4
+
+
+def _retry_base_s() -> float:
+    return float(os.environ.get("KSIM_DCN_RETRY_BASE_S", "0.05"))
+
+
+def _retry_cap_s() -> float:
+    return float(os.environ.get("KSIM_DCN_RETRY_CAP_S", "2.0"))
+
+
+def kv_retry(
+    fn,
+    *,
+    op: str,
+    key: str = "",
+    attempts: Optional[int] = None,
+    base_s: Optional[float] = None,
+    cap_s: Optional[float] = None,
+    sleep=time.sleep,
+    jitter=None,
+):
+    """Run ``fn()`` with bounded exponential backoff + jitter.
+
+    Delay before retry k (0-based) is ``min(cap_s, base_s * 2**k) * u``
+    with ``u`` uniform in [0.5, 1.0] — full-jitter-lite, bounded both
+    sides so tests can pin the envelope. ``sleep``/``jitter`` are
+    injectable for the timing-bound unit tests. Raises
+    :class:`DcnRetryError` after the last attempt fails."""
+    n = _retry_attempts() if attempts is None else max(int(attempts), 1)
+    base = _retry_base_s() if base_s is None else float(base_s)
+    cap = _retry_cap_s() if cap_s is None else float(cap_s)
+    rnd = random.random if jitter is None else jitter
+    t0 = time.monotonic()
+    last = None
+    for k in range(n):
+        try:
+            out = fn()
+        except Exception as e:
+            RETRY_STATS["attempts"] += 1
+            last = e
+            if k + 1 >= n:
+                break
+            RETRY_STATS["retries"] += 1
+            d = min(cap, base * (2.0 ** k)) * (0.5 + 0.5 * rnd())
+            RETRY_STATS["backoff_s"] += d
+            sleep(d)
+        else:
+            RETRY_STATS["attempts"] += 1
+            return out
+    RETRY_STATS["giveups"] += 1
+    raise DcnRetryError(op, key, n, time.monotonic() - t0, last)
 
 
 # -- liveness heartbeats (round 12) -----------------------------------------
@@ -331,27 +431,41 @@ def heartbeat(
     if extra:
         beat.update(extra)
     blob = json.dumps(beat, sort_keys=True)
+    from . import faultline
+
     hb_dir = os.environ.get("KSIM_DCN_HB_DIR")
     if hb_dir:
         # File mirror for monitors OUTSIDE the fleet (dcn_launch --watch):
         # the launcher parent never joins the coordination service, so it
         # tails these instead. Atomic replace — readers never see a torn
-        # write.
+        # write (faultline may still tear the PAYLOAD to exercise reader
+        # tolerance; monitors must treat unparseable beacons as absent).
         try:
             os.makedirs(hb_dir, exist_ok=True)
             tmp = os.path.join(hb_dir, f".p{pid}.tmp")
             with open(tmp, "w") as f:
-                f.write(blob)
+                f.write(faultline.file_blob(blob))
             os.replace(tmp, os.path.join(hb_dir, f"p{pid}.json"))
         except OSError:
             pass
+    key = f"{HB_PREFIX}/{pid}"
+    ok = True
     try:
-        _client().key_value_set(
-            f"{HB_PREFIX}/{pid}", blob, allow_overwrite=True
+        # Beacons are frequent and best-effort: a short retry budget
+        # absorbs a transient blip, a give-up just means one stale beat.
+        kv_retry(
+            lambda: _client().key_value_set(key, blob, allow_overwrite=True),
+            op="heartbeat",
+            key=key,
+            attempts=2,
         )
     except Exception:
-        return False
-    return True
+        ok = False
+    # Kill schedules fire on the heartbeat cursor whether or not the
+    # publish landed — a deterministic schedule must not drift because a
+    # transient KV error ate one beat.
+    faultline.maybe_kill(int(chunk), str(state))
+    return ok
 
 
 def maybe_heartbeat(chunk_done: int, every: Optional[int] = None, **kw) -> bool:
@@ -371,7 +485,12 @@ def read_heartbeats() -> Dict[int, dict]:
     """All published beacons, ``{pid: beat}``. Empty on any failure —
     callers treat a missing beacon as \"no evidence\", not as death."""
     try:
-        entries = _client().key_value_dir_get(HB_PREFIX)
+        entries = kv_retry(
+            lambda: _client().key_value_dir_get(HB_PREFIX),
+            op="read_heartbeats",
+            key=HB_PREFIX,
+            attempts=2,
+        )
     except Exception:
         return {}
     out: Dict[int, dict] = {}
@@ -445,6 +564,54 @@ def _decode_payload(chunks) -> object:
     return _walk_payload(
         pickle.loads(base64.b64decode("".join(chunks))), _unpack_leaf
     )
+
+
+# -- checkpoint blob integrity (round 17) ------------------------------------
+#
+# Checkpoint chunks carried no integrity check: a torn or corrupted KV
+# value (publisher dying mid-blob, a flipped byte anywhere in transit or
+# storage) either crashed the unpickle or — worse — silently resumed bad
+# state. Every chunk is now framed ``kf1:<crc32>:<len>:<data>`` and the
+# manifest (written LAST) is JSON carrying the chunk count plus the
+# crc32/length of the whole reassembled blob. load_checkpoint validates
+# both layers and on ANY mismatch falls back to the newest PRIOR complete
+# cursor (counted in CRC_STATS["fallbacks"]) instead of crashing.
+
+_FRAME_MAGIC = "kf1"
+
+# frames_ok/frames_bad: per-chunk validation outcomes; fallbacks: cursors
+# skipped (torn/corrupt/undecodable) on the way to a usable checkpoint.
+CRC_STATS = {"frames_ok": 0, "frames_bad": 0, "fallbacks": 0}
+
+
+def crc_stats() -> dict:
+    """Snapshot of :data:`CRC_STATS` (copy — callers diff it)."""
+    return dict(CRC_STATS)
+
+
+def _frame_chunk(data: str) -> str:
+    """Wrap one checkpoint chunk in the CRC32+length frame."""
+    crc = zlib.crc32(data.encode("ascii")) & 0xFFFFFFFF
+    return f"{_FRAME_MAGIC}:{crc:08x}:{len(data)}:{data}"
+
+
+def _unframe_chunk(framed: str) -> str:
+    """Validate + strip one frame; ValueError on torn/truncated/corrupt."""
+    magic, _, rest = framed.partition(":")
+    if magic != _FRAME_MAGIC or not rest:
+        raise ValueError("checkpoint chunk is not framed (torn header?)")
+    crc_s, _, rest = rest.partition(":")
+    len_s, sep, data = rest.partition(":")
+    if not sep:
+        raise ValueError("checkpoint chunk frame is truncated")
+    if len(data) != int(len_s):
+        raise ValueError(
+            f"checkpoint chunk length mismatch: framed {int(len_s)}, "
+            f"got {len(data)} (torn write)"
+        )
+    if (zlib.crc32(data.encode("ascii")) & 0xFFFFFFFF) != int(crc_s, 16):
+        raise ValueError("checkpoint chunk CRC32 mismatch (corrupt blob)")
+    return data
 
 
 def _mirror_event(event: dict) -> None:
@@ -539,10 +706,13 @@ def publish_checkpoint(
     cursor: int, payload, block: tuple, epoch: Optional[int] = None
 ) -> bool:
     """Publish this process's block-state checkpoint at chunk ``cursor``
-    under ``ksim/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>``. The chunk-count
-    manifest key (``/n``) is written LAST, so a reader that finds a
-    manifest never sees a torn blob. Defensive like :func:`heartbeat`:
-    returns False (never raises) outside DCN or on any KV failure.
+    under ``ksim/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>``. Round 17: every
+    chunk is CRC32+length framed and the manifest key (``/n``, written
+    LAST so a reader that finds one never sees an in-flight blob) is JSON
+    carrying the chunk count plus whole-blob crc/length — a torn or
+    corrupted chunk is detected on load, not resumed. Defensive like
+    :func:`heartbeat`: returns False (never raises) outside DCN or when
+    the bounded KV retries give up.
 
     Each successful publication is clocked into :data:`PUBLISH_STATS`
     (encode + KV push wall, encoded bytes) and mirrored as a
@@ -553,14 +723,34 @@ def publish_checkpoint(
             return False
         t0 = time.perf_counter()
         c = _client()
-        chunks = _encode_payload(payload)
+        raw_chunks = _encode_payload(payload)
+        blob_len = sum(len(ch) for ch in raw_chunks)
+        blob_crc = 0
+        for ch in raw_chunks:
+            blob_crc = zlib.crc32(ch.encode("ascii"), blob_crc)
+        chunks = [_frame_chunk(ch) for ch in raw_chunks]
+        manifest = json.dumps(
+            {"n": len(chunks), "crc": f"{blob_crc & 0xFFFFFFFF:08x}",
+             "len": blob_len},
+            sort_keys=True,
+        )
         lo, hi = int(block[0]), int(block[1])
         ep = checkpoint_epoch() if epoch is None else int(epoch)
         prefix = f"{CKPT_PREFIX}/{ep}/{pid}/{lo}-{hi}/{int(cursor)}"
         for j, ch in enumerate(chunks):
-            c.key_value_set(f"{prefix}/{j}", ch, allow_overwrite=True)
-        c.key_value_set(
-            f"{prefix}/n", str(len(chunks)), allow_overwrite=True
+            kv_retry(
+                lambda k=f"{prefix}/{j}", v=ch: c.key_value_set(
+                    k, v, allow_overwrite=True
+                ),
+                op="publish_checkpoint",
+                key=f"{prefix}/{j}",
+            )
+        kv_retry(
+            lambda: c.key_value_set(
+                f"{prefix}/n", manifest, allow_overwrite=True
+            ),
+            op="publish_checkpoint",
+            key=f"{prefix}/n",
         )
         wall = time.perf_counter() - t0
         nbytes = sum(len(ch) for ch in chunks)
@@ -581,17 +771,37 @@ def publish_checkpoint(
         return False
 
 
-def load_checkpoint(pid: int, epoch: Optional[int] = None):
-    """Newest complete checkpoint published by ``pid`` this replay:
-    ``{"cursor", "block": (lo, hi), "payload"}``, or None when ``pid``
-    never published one (the claimant then re-executes from chunk 0).
-    One directory read, no blocking waits — the publisher is dead."""
+def load_checkpoint(
+    pid: int, epoch: Optional[int] = None, before_cursor: Optional[int] = None
+):
+    """Newest VALID checkpoint published by ``pid`` this replay:
+    ``{"cursor", "block": (lo, hi), "payload"}``, or None when nothing
+    usable exists (the claimant then re-executes from chunk 0). One
+    directory read, no blocking waits — the publisher is dead.
+
+    Round 17: candidates are walked newest-cursor-first and each must
+    pass the full integrity stack — JSON manifest (chunk count + whole-
+    blob crc32/length), per-chunk CRC32+length frames, and payload
+    decode. Any failure logs, bumps ``CRC_STATS["fallbacks"]`` and moves
+    on to the next older cursor, so a torn/corrupt newest blob degrades
+    to the prior complete checkpoint instead of crashing or silently
+    resuming bad state. ``before_cursor`` restricts to strictly older
+    cursors — the resume path in sim/whatif.py uses it to retry with an
+    older blob when a decoded payload turns out unusable (signature or
+    carrier-shape mismatch)."""
     try:
         c = _client()
         ep = checkpoint_epoch() if epoch is None else int(epoch)
-        entries = c.key_value_dir_get(f"{CKPT_PREFIX}/{ep}/{int(pid)}")
+        entries = kv_retry(
+            lambda: c.key_value_dir_get(f"{CKPT_PREFIX}/{ep}/{int(pid)}"),
+            op="load_checkpoint",
+            key=f"{CKPT_PREFIX}/{ep}/{int(pid)}",
+            attempts=2,
+        )
     except Exception:
         return None
+    from ..utils.metrics import log
+
     table: Dict[tuple, Dict[str, str]] = {}
     for key, val in entries:
         parts = str(key).strip("/").split("/")
@@ -599,26 +809,56 @@ def load_checkpoint(pid: int, epoch: Optional[int] = None):
             continue
         blk, cur, leaf = parts[-3], parts[-2], parts[-1]
         table.setdefault((blk, cur), {})[leaf] = val
-    best = None
+    candidates = []
     for (blk, cur), kv in table.items():
         if "n" not in kv:
-            continue  # manifest not yet written — torn/in-flight blob
+            continue  # manifest not yet written — in-flight blob
         try:
             cursor = int(cur)
-            n = int(kv["n"])
             lo, hi = (int(x) for x in blk.split("-"))
-            chunks = [kv[str(j)] for j in range(n)]
-        except (KeyError, ValueError):
+        except ValueError:
             continue
-        if best is None or cursor > best[0]:
-            best = (cursor, (lo, hi), chunks)
-    if best is None:
-        return None
-    try:
-        payload = _decode_payload(best[2])
-    except Exception:
-        return None
-    return {"cursor": best[0], "block": best[1], "payload": payload}
+        if before_cursor is not None and cursor >= int(before_cursor):
+            continue
+        candidates.append((cursor, (lo, hi), kv))
+    for cursor, block, kv in sorted(candidates, reverse=True):
+        try:
+            man = json.loads(kv["n"])
+            if isinstance(man, dict):
+                n = int(man["n"])
+                want_crc, want_len = man.get("crc"), man.get("len")
+            else:  # legacy bare-int manifest (pre-round-17 blobs)
+                n, want_crc, want_len = int(man), None, None
+            chunks = []
+            for j in range(n):
+                ch = kv[str(j)]
+                if want_crc is not None:
+                    ch = _unframe_chunk(ch)
+                chunks.append(ch)
+            CRC_STATS["frames_ok"] += len(chunks) if want_crc is not None else 0
+            if want_crc is not None:
+                crc = 0
+                for ch in chunks:
+                    crc = zlib.crc32(ch.encode("ascii"), crc)
+                if (
+                    f"{crc & 0xFFFFFFFF:08x}" != want_crc
+                    or sum(len(ch) for ch in chunks) != int(want_len)
+                ):
+                    raise ValueError(
+                        "manifest crc/length mismatch over reassembled blob"
+                    )
+            payload = _decode_payload(chunks)
+        except Exception as e:
+            CRC_STATS["frames_bad"] += 1
+            CRC_STATS["fallbacks"] += 1
+            log.warning(
+                "dcn: process %d's checkpoint at cursor %d failed "
+                "validation (%s) — falling back to the prior complete "
+                "checkpoint", int(pid), cursor, e,
+            )
+            continue
+        return {"cursor": cursor, "block": block, "payload": payload}
+    return None
 
 
 def try_claim(dead_pid: int, gen: int, name: str = "whatif") -> bool:
@@ -626,7 +866,14 @@ def try_claim(dead_pid: int, gen: int, name: str = "whatif") -> bool:
     gather: ``key_value_set`` without ``allow_overwrite`` fails when the
     key exists, so exactly one process wins generation ``gen``. Claim
     metadata (claimant pid, block owner, generation, wall time) is the
-    value, for attribution of a second failure during recovery."""
+    value, for attribution of a second failure during recovery.
+
+    Round 17: the CAS runs under :func:`kv_retry`, and a failure no
+    longer short-circuits to "lost" — a transient error is ambiguous
+    (the set may have landed before the error surfaced), so the claim
+    key is read back and the VALUE decides. Only a readable claim naming
+    another pid is a genuine loss; an unreadable key reads as lost too
+    (the poll loop re-enters the claim protocol and settles it)."""
     nproc, pid = process_info()
     meta = {
         "claimant": int(pid),
@@ -634,14 +881,20 @@ def try_claim(dead_pid: int, gen: int, name: str = "whatif") -> bool:
         "gen": int(gen),
         "t": time.time(),
     }
+    key = f"{CLAIM_PREFIX}/{_seq}/{name}/{int(dead_pid)}/{int(gen)}"
     try:
-        _client().key_value_set(
-            f"{CLAIM_PREFIX}/{_seq}/{name}/{int(dead_pid)}/{int(gen)}",
-            json.dumps(meta, sort_keys=True),
+        kv_retry(
+            lambda: _client().key_value_set(
+                key, json.dumps(meta, sort_keys=True)
+            ),
+            op="claim",
+            key=key,
         )
         return True
     except Exception:
-        return False
+        pass
+    claim = read_claim(dead_pid, gen, name=name)
+    return claim is not None and int(claim.get("claimant", -1)) == int(pid)
 
 
 def read_claim(dead_pid: int, gen: int, name: str = "whatif"):
@@ -695,11 +948,19 @@ def _publish_for(c, prefix: str, pid: int, payload) -> None:
     tolerant = recover_enabled()
     try:
         for j, ch in enumerate(chunks):
-            c.key_value_set(f"{prefix}/{pid}/{j}", ch)
-        c.key_value_set(f"{prefix}/{pid}/n", str(len(chunks)))
-    except Exception:
+            kv_retry(
+                lambda k=f"{prefix}/{pid}/{j}", v=ch: c.key_value_set(k, v),
+                op="gather_publish",
+                key=f"{prefix}/{pid}/{j}",
+            )
+        kv_retry(
+            lambda: c.key_value_set(f"{prefix}/{pid}/n", str(len(chunks))),
+            op="gather_publish",
+            key=f"{prefix}/{pid}/n",
+        )
+    except DcnRetryError:
         if not tolerant:
-            raise
+            raise  # attributed give-up — op/key/attempts in the message
         from ..utils.metrics import log
 
         log.warning(
@@ -713,7 +974,8 @@ def _publish_for(c, prefix: str, pid: int, payload) -> None:
 def _maybe_recover(c, prefix: str, p: int, name: str, recover) -> bool:
     """Survivor rebalance (round 15): ``p``'s beacon is stale and recovery
     is on. Claim generations 0..max_claims-1 of ``p``'s block; on a CAS
-    win, rebuild the block via ``recover(p)`` (checkpoint resume inside)
+    win, rebuild the block via ``recover(p, gen)`` (checkpoint resume
+    inside)
     and publish it under ``p``'s gather keys. On a CAS loss, defer to a
     LIVE claimant (keep polling for its publication); a claimant that is
     itself stale opens the next generation — the second-failure-during-
@@ -724,6 +986,28 @@ def _maybe_recover(c, prefix: str, p: int, name: str, recover) -> bool:
     _, me = process_info()
     stall = _stall_s()
     for gen in range(max_claims()):
+        # Coordinator claims LAST (round 17): process 0 hosts the
+        # jax.distributed coordination service — the one process whose
+        # death the fleet can never survive. Re-executing a dead block
+        # is exactly the work most likely to die again under fault
+        # pressure, so while any OTHER live worker could absorb it,
+        # give them one stall window to claim first. With no live
+        # sibling left (or the window expired unclaimed) process 0
+        # claims as before — liveness is unchanged.
+        if me == 0 and read_claim(p, gen, name=name) is None:
+            deadline = time.monotonic() + stall
+            while time.monotonic() < deadline:
+                now = time.time()
+                others = [
+                    q for q, b in read_heartbeats().items()
+                    if q not in (me, p) and q not in DEGRADED
+                    and now - float(b.get("t", 0.0)) <= stall
+                ]
+                if not others:
+                    break
+                time.sleep(_poll_s())
+                if read_claim(p, gen, name=name) is not None:
+                    break
         if try_claim(p, gen, name=name):
             log.warning(
                 "dcn: process %d claims dead process %d's block "
@@ -735,7 +1019,11 @@ def _maybe_recover(c, prefix: str, p: int, name: str, recover) -> bool:
                  "gen": int(gen)}
             )
             t0 = time.monotonic()
-            payload = recover(p)
+            # Claim-generation fencing (round 17): the generation rides
+            # into the recovery engine so telemetry can attribute which
+            # claim attempt produced the block — gen > 0 means an earlier
+            # claimant died mid-recovery and this is the hand-off.
+            payload = recover(p, gen)
             _publish_for(c, prefix, p, payload)
             log.warning(
                 "dcn: process %d resumed and republished process %d's "
@@ -837,8 +1125,9 @@ def gather(name: str, payload, recover=None) -> list:
     lifetime never collide — provided every process gathers in the same
     order (SPMD discipline, same as collectives).
 
-    ``recover`` (round 15): ``recover(dead_pid) -> payload`` rebuilds a
-    dead sibling's block deterministically. With KSIM_DCN_RECOVER on, a
+    ``recover`` (round 15): ``recover(dead_pid, gen) -> payload`` rebuilds
+    a dead sibling's block deterministically (``gen`` is the claim
+    generation, round 17). With KSIM_DCN_RECOVER on, a
     stale beacon routes through the claim protocol (:func:`_maybe_recover`)
     instead of raising, and the gather still completes in full."""
     global GATHER_COUNT, _seq

@@ -1,0 +1,356 @@
+"""Faultline (round 17): seeded, deterministic fault injection for the
+DCN fleet plane.
+
+The round-15 recovery machinery (parallel.dcn heartbeats, claims,
+checkpoints, survivor rebalance) had only ever been exercised by one
+clean SIGKILL.  Faultline wraps the jax.distributed KV client and the
+heartbeat file mirrors with a *seeded* injector so tests and the fuzz
+harness (scripts/faultline_fuzz.py) can drive adversarial schedules
+deterministically:
+
+- transient KV set/get errors (``FaultlineInjected``, raised *before*
+  the real call so the KV state stays well-defined),
+- added KV latency,
+- torn / truncated / corrupted checkpoint blob writes (only keys under
+  ``ksim/ckpt/`` — gather and coordination keys are never mangled),
+- stale reads (a get/dir-get occasionally returns the previous snapshot
+  observed for that key),
+- SIGKILL schedules keyed on the heartbeat cursor
+  (``KSIM_FAULTLINE_KILL="1@run:0,*@recover:-1"`` — ``*`` entries use a
+  KV CAS so exactly one process dies per entry, whichever heartbeats
+  first; process 0 hosts the jax.distributed coordination service and
+  its death can never be survived, so ``*`` only matches pids > 0 —
+  name ``0@...`` explicitly to drill the unsurvivable case).
+
+Everything is off by default and config-gated (``faultline:`` YAML via
+cli.py, or ``KSIM_FAULTLINE_*`` env directly).  The injector never
+touches the compiled chunk program — only the coordination plane — so a
+surviving fleet must still produce an end gather byte-identical to a
+no-failure run; that is the property the fuzzer pins.
+
+Determinism contract: each fault class draws from its own
+``random.Random`` stream derived from ``(seed, pid, class)``, so the
+k-th decision of a class is a pure function of the seed — same seed ⇒
+same schedule (pinned by tests/test_faultline.py).  The *interleaving*
+of classes across wall time may differ between runs (gather polling is
+timing-dependent); byte-parity of results is guaranteed by the retry /
+CRC / recovery semantics in parallel.dcn, not by identical interleaving.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import zlib
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# Keys whose *values* may be torn/corrupted on write.  Everything else
+# (heartbeats, claims, gather payloads, coordination keys) is left
+# intact — torn writes model a checkpoint publisher dying mid-blob.
+_TEAR_PREFIX = "ksim/ckpt/"
+
+# Coordination keys used by faultline itself (the ``*`` kill CAS); never
+# injected, always through the raw client.
+_SELF_PREFIX = "ksim/faultline/"
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+class FaultlineInjected(RuntimeError):
+    """A fault injected by faultline (not a real infrastructure error)."""
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in _TRUTHY
+
+
+def active() -> bool:
+    """Whether fault injection is enabled for this process."""
+    return _env_flag("KSIM_FAULTLINE")
+
+
+def parse_kill_schedule(spec: str) -> List[Tuple[str, str, int]]:
+    """Parse ``KSIM_FAULTLINE_KILL`` into ``(pid, state, chunk)`` entries.
+
+    Grammar: comma-separated ``<pid>@<state>:<chunk>`` tokens where
+    ``pid`` is a process index or ``*`` (any process — resolved to
+    exactly one via a KV CAS), ``state`` is a heartbeat state (``run``,
+    ``recover``, ``gather``; defaults to ``run`` when omitted), and
+    ``chunk`` is the heartbeat cursor at or after which the kill fires
+    (``-1`` fires on the first matching beat).  Raises ``ValueError``
+    on malformed tokens so validate_config can refuse bad schedules.
+    """
+    entries: List[Tuple[str, str, int]] = []
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        head, sep, chunk_s = tok.rpartition(":")
+        if not sep:
+            raise ValueError(f"faultline kill entry {tok!r} is missing ':<chunk>'")
+        if "@" in head:
+            pid_s, state = head.split("@", 1)
+        else:
+            pid_s, state = head, "run"
+        pid_s = pid_s.strip()
+        state = state.strip()
+        if pid_s != "*":
+            if not pid_s.lstrip("-").isdigit() or int(pid_s) < 0:
+                raise ValueError(
+                    f"faultline kill entry {tok!r}: pid must be a non-negative "
+                    f"process index or '*'"
+                )
+        if not state:
+            raise ValueError(f"faultline kill entry {tok!r}: empty state")
+        try:
+            chunk = int(chunk_s)
+        except ValueError:
+            raise ValueError(
+                f"faultline kill entry {tok!r}: chunk {chunk_s!r} is not an integer"
+            ) from None
+        entries.append((pid_s, state, chunk))
+    return entries
+
+
+class Injector:
+    """Seeded, per-process fault decider.
+
+    One ``random.Random`` stream per fault class, derived from
+    ``(seed, pid, class)`` — drawing from one class never shifts
+    another, and the k-th decision of a class depends only on the seed.
+    """
+
+    CLASSES = ("kv_error", "kv_delay", "torn", "stale", "file")
+
+    def __init__(
+        self,
+        seed: int = 0,
+        pid: int = 0,
+        kv_error_rate: float = 0.0,
+        kv_delay_rate: float = 0.0,
+        kv_delay_s: float = 0.02,
+        torn_write_rate: float = 0.0,
+        stale_read_rate: float = 0.0,
+        kill: str = "",
+    ):
+        self.seed = int(seed)
+        self.pid = int(pid)
+        self.kv_delay_s = max(float(kv_delay_s), 0.0)
+        self.rates = {
+            "kv_error": float(kv_error_rate),
+            "kv_delay": float(kv_delay_rate),
+            "torn": float(torn_write_rate),
+            "stale": float(stale_read_rate),
+            "file": float(torn_write_rate),
+        }
+        self.kill_entries = parse_kill_schedule(kill)
+        self.counts = {c: 0 for c in self.CLASSES}
+        self._rng: dict = {}
+
+    def _stream(self, name: str):
+        import random
+
+        r = self._rng.get(name)
+        if r is None:
+            # Distinct 64-bit-ish seeds per (seed, pid, class); crc32 of
+            # the class name keeps streams independent without hashing
+            # tuples (random.Random only seeds on int/str/bytes).
+            r = random.Random(
+                (self.seed * 1_000_003 + self.pid * 8191) ^ zlib.crc32(name.encode())
+            )
+            self._rng[name] = r
+        return r
+
+    def hit(self, cls: str) -> bool:
+        """Draw the next decision for ``cls``; True means inject."""
+        rate = self.rates.get(cls, 0.0)
+        if rate <= 0.0:
+            return False
+        if self._stream(cls).random() < rate:
+            self.counts[cls] += 1
+            return True
+        return False
+
+    def tear(self, value: str) -> str:
+        """Mangle a blob: truncate (torn write) or flip one character."""
+        if not value:
+            return value
+        r = self._stream("tear")
+        if r.random() < 0.5 and len(value) > 1:
+            return value[: 1 + int(r.random() * (len(value) - 1))]
+        i = int(r.random() * len(value))
+        return value[:i] + chr((ord(value[i]) ^ 0x1) & 0x7F) + value[i + 1 :]
+
+    def stats(self) -> dict:
+        return dict(self.counts)
+
+
+def from_env() -> Injector:
+    """Build an :class:`Injector` from ``KSIM_FAULTLINE_*``."""
+    pid = int(os.environ.get("KSIM_DCN_PID", "0") or 0)
+    return Injector(
+        seed=int(os.environ.get("KSIM_FAULTLINE_SEED", "0") or 0),
+        pid=pid,
+        kv_error_rate=float(os.environ.get("KSIM_FAULTLINE_KV_ERROR_RATE", "0") or 0),
+        kv_delay_rate=float(os.environ.get("KSIM_FAULTLINE_KV_DELAY_RATE", "0") or 0),
+        kv_delay_s=float(os.environ.get("KSIM_FAULTLINE_KV_DELAY_S", "0.02") or 0),
+        torn_write_rate=float(os.environ.get("KSIM_FAULTLINE_TORN_RATE", "0") or 0),
+        stale_read_rate=float(os.environ.get("KSIM_FAULTLINE_STALE_RATE", "0") or 0),
+        kill=os.environ.get("KSIM_FAULTLINE_KILL", ""),
+    )
+
+
+_INJECTOR: Optional[Injector] = None
+_PROXY = None
+_KILLED_CAS: set = set()
+
+
+def injector() -> Injector:
+    """The process-wide injector singleton (lazily built from env)."""
+    global _INJECTOR
+    if _INJECTOR is None:
+        _INJECTOR = from_env()
+    return _INJECTOR
+
+
+def reset() -> None:
+    """Drop the singleton + proxy (tests re-read env on next use)."""
+    global _INJECTOR, _PROXY
+    _INJECTOR = None
+    _PROXY = None
+    _KILLED_CAS.clear()
+
+
+class _KvProxy:
+    """KV-client wrapper injecting faults ahead of the real calls.
+
+    ``raw`` exposes the unwrapped client for coordination ops that must
+    not be injected (the ``*`` kill CAS).  Errors are raised *before*
+    the real call so the KV store never holds a half-applied op; torn
+    writes are the one deliberate exception — the mangled value IS
+    written, modelling a publisher dying mid-blob, and only ever for
+    checkpoint chunk keys.
+    """
+
+    def __init__(self, client, inj: Injector):
+        self.raw = client
+        self._inj = inj
+        # key -> previously observed value, for stale-read injection.
+        self._seen: dict = {}
+
+    def _delay(self):
+        if self._inj.hit("kv_delay"):
+            import time
+
+            time.sleep(self._inj.kv_delay_s)
+
+    def key_value_set(self, key, value, *args, **kwargs):
+        if key.startswith(_SELF_PREFIX):
+            return self.raw.key_value_set(key, value, *args, **kwargs)
+        self._delay()
+        if self._inj.hit("kv_error"):
+            raise FaultlineInjected(f"injected KV set error for {key!r}")
+        if key.startswith(_TEAR_PREFIX) and self._inj.hit("torn"):
+            log.debug("faultline: tearing write of %s", key)
+            value = self._inj.tear(value)
+        return self.raw.key_value_set(key, value, *args, **kwargs)
+
+    def blocking_key_value_get(self, key, *args, **kwargs):
+        self._delay()
+        if self._inj.hit("kv_error"):
+            raise FaultlineInjected(f"injected KV get error for {key!r}")
+        prev = self._seen.get(key)
+        val = self.raw.blocking_key_value_get(key, *args, **kwargs)
+        self._seen[key] = val
+        if prev is not None and self._inj.hit("stale"):
+            return prev
+        return val
+
+    def key_value_dir_get(self, prefix, *args, **kwargs):
+        self._delay()
+        if self._inj.hit("kv_error"):
+            raise FaultlineInjected(f"injected KV dir-get error for {prefix!r}")
+        skey = ("dir", prefix)
+        prev = self._seen.get(skey)
+        val = self.raw.key_value_dir_get(prefix, *args, **kwargs)
+        self._seen[skey] = val
+        if prev is not None and self._inj.hit("stale"):
+            return prev
+        return val
+
+    def __getattr__(self, name):
+        return getattr(self.raw, name)
+
+
+def wrap_kv(client):
+    """Wrap the jax.distributed KV client when faultline is active.
+
+    Identity when off — ``dcn._client()`` calls this on every KV touch,
+    and the off-by-default contract (bit-identical behaviour with
+    ``KSIM_FAULTLINE`` unset) is pinned by tests.
+    """
+    if client is None or not active():
+        return client
+    global _PROXY
+    if _PROXY is None or _PROXY.raw is not client:
+        _PROXY = _KvProxy(client, injector())
+    return _PROXY
+
+
+def file_blob(blob: str) -> str:
+    """Maybe-mangle a heartbeat file-mirror payload (torn mirror write)."""
+    if not active():
+        return blob
+    inj = injector()
+    if inj.hit("file"):
+        return inj.tear(blob)
+    return blob
+
+
+def maybe_kill(chunk: int, state: str) -> None:
+    """Fire any matching SIGKILL schedule entry for this heartbeat.
+
+    Called by ``dcn.heartbeat`` after the beacon publish.  Named-pid
+    entries fire unconditionally once ``chunk`` reaches the threshold in
+    the named state; ``*`` entries race a CAS on
+    ``ksim/faultline/kill/<idx>`` through the *raw* client so exactly
+    one process per entry dies, whichever heartbeats first — byte-parity
+    of the surviving fleet must hold regardless of which one.  ``*``
+    never matches process 0: it hosts the jax.distributed coordination
+    service, whose death aborts every healthy task (unsurvivable by
+    construction) — killing the coordinator must be asked for by name.
+    """
+    if not active():
+        return
+    inj = injector()
+    if not inj.kill_entries:
+        return
+    for idx, (pid_s, st, thr) in enumerate(inj.kill_entries):
+        if st != state or int(chunk) < thr:
+            continue
+        if pid_s == "*":
+            if inj.pid == 0 or idx in _KILLED_CAS:
+                continue
+            try:
+                from . import dcn
+
+                c = dcn._client()
+                raw = getattr(c, "raw", c)
+                # CAS: first writer wins the right to die.
+                raw.key_value_set(f"{_SELF_PREFIX}kill/{idx}", str(inj.pid))
+            except Exception:
+                _KILLED_CAS.add(idx)  # lost (or unreachable): never ours
+                continue
+        elif int(pid_s) != inj.pid:
+            continue
+        log.warning(
+            "faultline: killing process %d (schedule entry %r at state=%s chunk=%d)",
+            inj.pid,
+            f"{pid_s}@{st}:{thr}",
+            state,
+            int(chunk),
+        )
+        os.kill(os.getpid(), signal.SIGKILL)

@@ -155,6 +155,7 @@ class FlightRecorder:
         exchange_probe_s: Optional[float] = None,
         exchange_slots: Optional[int] = None,
         ckpt_publish: Optional[dict] = None,
+        kv_retry: Optional[dict] = None,
     ) -> None:
         """One chunk-boundary row. ``phase_acc`` is the CUMULATIVE phase
         accumulator (the collector's or this recorder's own) — the row
@@ -162,7 +163,10 @@ class FlightRecorder:
         ``_PodPager`` (or anything with stalls/stall_s/prefetches/depth).
         ``exchange_probe_s`` is one timed round of the selection-exchange
         probe; ``exchange_est_s`` scales it to the chunk's slot count
-        (the per-slot all_gather runs once per slot inside the scan)."""
+        (the per-slot all_gather runs once per slot inside the scan).
+        ``kv_retry`` (round 17) is the chunk's KV retry delta — retries
+        burned, give-ups, backoff wall — attributing coordination-plane
+        flakiness (real or faultline-injected) to the chunk it hit."""
         self._events += 1
         if self.cfg.every > 1 and (ci % self.cfg.every) != 0:
             return
@@ -216,6 +220,8 @@ class FlightRecorder:
                 )
         if ckpt_publish:
             row["dcn_publish"] = dict(ckpt_publish)
+        if kv_retry:
+            row["dcn_retry"] = dict(kv_retry)
         self._emit(row)
 
     def page(self, ci: int, stall_s: float, stalls: int) -> None:
@@ -291,11 +297,12 @@ class FlightRecorder:
                     row[k] = 0.0
             if isinstance(row.get("phases"), dict):
                 row["phases"] = {k: 0.0 for k in row["phases"]}
-            if isinstance(row.get("dcn_publish"), dict):
-                row["dcn_publish"] = {
-                    k: (0.0 if k.endswith("_s") else v)
-                    for k, v in row["dcn_publish"].items()
-                }
+            for blk in ("dcn_publish", "dcn_retry"):
+                if isinstance(row.get(blk), dict):
+                    row[blk] = {
+                        k: (0.0 if k.endswith("_s") else v)
+                        for k, v in row[blk].items()
+                    }
         try:
             self._writer.write(row)
         except OSError:

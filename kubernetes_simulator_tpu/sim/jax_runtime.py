@@ -1572,10 +1572,12 @@ class JaxReplayEngine:
             else None
         )
         rec_pub = None
+        rec_retry = None
         if rec is not None:
             from ..parallel import dcn as _dcn
 
             rec_pub = _dcn.publish_stats()
+            rec_retry = _dcn.retry_stats()
         t0 = time.perf_counter()
         try:
             for ci, c0 in enumerate(range(0, idx.shape[0], C)):
@@ -1758,6 +1760,20 @@ class JaxReplayEngine:
                             "bytes": pub_now["bytes"] - rec_pub["bytes"],
                         }
                         rec_pub = pub_now
+                    retry_now = _dcn.retry_stats()
+                    kv_retry = None
+                    if retry_now != rec_retry:
+                        kv_retry = {
+                            "retries": retry_now["retries"]
+                            - rec_retry["retries"],
+                            "giveups": retry_now["giveups"]
+                            - rec_retry["giveups"],
+                            "backoff_s": round(
+                                retry_now["backoff_s"]
+                                - rec_retry["backoff_s"], 6
+                            ),
+                        }
+                        rec_retry = retry_now
                     rec.chunk(
                         ci,
                         t_virtual=wave_times[c0],
@@ -1775,6 +1791,7 @@ class JaxReplayEngine:
                             C * idx.shape[1] if ex_s is not None else None
                         ),
                         ckpt_publish=ck_pub,
+                        kv_retry=kv_retry,
                     )
             _fold_pending()
             if self.kube:
@@ -2138,10 +2155,12 @@ class JaxReplayEngine:
         )
         rec_stalls_seen = 0
         rec_pub = None
+        rec_retry = None
         if rec is not None:
             from ..parallel import dcn as _dcn
 
             rec_pub = _dcn.publish_stats()
+            rec_retry = _dcn.retry_stats()
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_chunk:
@@ -2282,6 +2301,20 @@ class JaxReplayEngine:
                         "bytes": pub_now["bytes"] - rec_pub["bytes"],
                     }
                     rec_pub = pub_now
+                retry_now = _dcn.retry_stats()
+                kv_retry = None
+                if retry_now != rec_retry:
+                    kv_retry = {
+                        "retries": retry_now["retries"]
+                        - rec_retry["retries"],
+                        "giveups": retry_now["giveups"]
+                        - rec_retry["giveups"],
+                        "backoff_s": round(
+                            retry_now["backoff_s"]
+                            - rec_retry["backoff_s"], 6
+                        ),
+                    }
+                    rec_retry = retry_now
                 rec.chunk(
                     ci,
                     t_virtual=(
@@ -2302,6 +2335,7 @@ class JaxReplayEngine:
                         C * idx.shape[1] if ex_s is not None else None
                     ),
                     ckpt_publish=ck_pub,
+                    kv_retry=kv_retry,
                 )
         with _tick("device_wait"):
             jax.block_until_ready(all_choices[-1] if all_choices else state)

@@ -2199,13 +2199,16 @@ class WhatIfEngine:
             ]
         return stg
 
-    def _dcn_recover_block(self, dead_pid: int) -> dict:
+    def _dcn_recover_block(self, dead_pid: int, gen: int = 0) -> dict:
         """``recover`` callback for :func:`parallel.dcn.gather` (round
         15): rebuild ``dead_pid``'s contiguous scenario block through a
         fresh engine over THIS process's local mesh, resuming from the
         dead process's newest published checkpoint when one exists. The
         replay is deterministic, so the returned payload is byte-
-        identical to what ``dead_pid`` would have published itself."""
+        identical to what ``dead_pid`` would have published itself.
+        ``gen`` (round 17) is the claim generation — nonzero when an
+        earlier claimant died mid-recovery and this call is the fenced
+        hand-off; it rides into the recovery engine's fleet telemetry."""
         rb = self._dcn_rebuild
         if rb is None:
             raise RuntimeError(
@@ -2241,6 +2244,7 @@ class WhatIfEngine:
             _dcn_recovery=dict(
                 block=(lo, hi),
                 for_pid=int(dead_pid),
+                gen=int(gen),
                 epoch=dcn.gather_seq(),
                 prefer_taint=self._dcn_prefer_taint,
                 scales_pods=self._dcn_scales_pods,
@@ -2737,10 +2741,13 @@ class WhatIfEngine:
         from ..utils.profiling import profiling_active as _prof_on
 
         run_phases = PhaseTimers()
-        # PUBLISH_STATS is cumulative module state — snapshot it so the
-        # fleet phases below surface only THIS run's publications (a prior
+        # PUBLISH_STATS / RETRY_STATS / CRC_STATS are cumulative module
+        # state — snapshot them so the fleet phases below surface only
+        # THIS run's publications, KV retries and CRC fallbacks (a prior
         # run in the same process must not leak into the phase map).
         _ps_start = dcn.publish_stats()
+        _rs_start = dcn.retry_stats()
+        _cs_start = dcn.crc_stats()
         import contextlib as _ctxlib
 
         _null = _ctxlib.nullcontext()
@@ -2809,44 +2816,66 @@ class WhatIfEngine:
             from .jax_runtime import restore_carriers
 
             dead = int(self._dcn_recovery.get("for_pid", -1))
-            ckd = dcn.load_checkpoint(
-                dead, epoch=self._dcn_recovery.get("epoch")
-            )
-            pay = None if ckd is None else ckd["payload"]
-            if (
-                isinstance(pay, dict)
-                and tuple(ckd["block"])
-                == (int(hb_block[0]), int(hb_block[1]))
-                and pay.get("sig") == _ck_sig
-            ):
+            # Round 17: walk the dead process's checkpoints newest-first.
+            # dcn.load_checkpoint already skips CRC-invalid blobs; this
+            # loop additionally falls back past blobs that validate on
+            # the wire but turn out unusable here (signature or carrier-
+            # shape mismatch), via `before_cursor`, instead of giving up
+            # on the whole resume.
+            before = None
+            while True:
+                ckd = dcn.load_checkpoint(
+                    dead,
+                    epoch=self._dcn_recovery.get("epoch"),
+                    before_cursor=before,
+                )
+                if ckd is None:
+                    if before is not None:
+                        _log.warning(
+                            "dcn: no usable checkpoint left for process "
+                            "%d — re-executing its block from chunk 0",
+                            dead,
+                        )
+                    break
+                before = int(ckd["cursor"])
+                pay = ckd["payload"]
+                if not (
+                    isinstance(pay, dict)
+                    and tuple(ckd["block"])
+                    == (int(hb_block[0]), int(hb_block[1]))
+                    and pay.get("sig") == _ck_sig
+                ):
+                    _log.warning(
+                        "dcn: ignoring mismatched checkpoint (cursor %d) "
+                        "for process %d — trying an older one",
+                        before, dead,
+                    )
+                    continue
                 try:
                     carr = restore_carriers(_carriers(), pay["leaves"])
                 except ValueError as e:
                     _log.warning(
-                        "dcn: process %d's checkpoint is unusable (%s) — "
-                        "re-executing its block from chunk 0", dead, e,
+                        "dcn: process %d's checkpoint at cursor %d is "
+                        "unusable (%s) — trying an older one",
+                        dead, before, e,
                     )
-                else:
-                    states = carr["states"]
-                    if dev_rel:
-                        vassign_d = carr["vassign"]
-                        if self.retry_buffer:
-                            (
-                                rbuf_d, rcount_d, pend_id_d, pend_node_d,
-                                pend_relb_d, rdrop_d,
-                            ) = carr["retry"]
-                    outs = list(pay["outs"])
-                    start_ci = int(pay["cursor"])
-                    _log.warning(
-                        "dcn: resumed process %d's block [%d, %d) from "
-                        "its newest checkpoint at chunk %d/%d",
-                        dead, hb_block[0], hb_block[1], start_ci, n_chunks,
-                    )
-            elif ckd is not None:
+                    continue
+                states = carr["states"]
+                if dev_rel:
+                    vassign_d = carr["vassign"]
+                    if self.retry_buffer:
+                        (
+                            rbuf_d, rcount_d, pend_id_d, pend_node_d,
+                            pend_relb_d, rdrop_d,
+                        ) = carr["retry"]
+                outs = list(pay["outs"])
+                start_ci = int(pay["cursor"])
                 _log.warning(
-                    "dcn: ignoring mismatched checkpoint for process %d "
-                    "— re-executing its block from chunk 0", dead,
+                    "dcn: resumed process %d's block [%d, %d) from "
+                    "its newest checkpoint at chunk %d/%d",
+                    dead, hb_block[0], hb_block[1], start_ci, n_chunks,
                 )
+                break
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_ci:
@@ -3321,6 +3350,39 @@ class WhatIfEngine:
                 )
                 fleet_local.phases["ckpt_publish_mib"] = round(
                     (_ps["bytes"] - _ps_start["bytes"]) / 2**20, 3
+                )
+            # Faultline attribution (round 17): KV retries burned and CRC
+            # fallbacks taken during THIS run ride the same phase map,
+            # again only when nonzero — clean runs keep the pinned phase
+            # set byte-identical to pre-round-17.
+            _rs = dcn.retry_stats()
+            if (
+                _rs["retries"] > _rs_start["retries"]
+                or _rs["giveups"] > _rs_start["giveups"]
+            ):
+                fleet_local.phases["kv_retry"] = round(
+                    _rs["backoff_s"] - _rs_start["backoff_s"], 6
+                )
+                fleet_local.phases["kv_retry_count"] = float(
+                    _rs["retries"] - _rs_start["retries"]
+                )
+                fleet_local.phases["kv_retry_giveups"] = float(
+                    _rs["giveups"] - _rs_start["giveups"]
+                )
+            _cs = dcn.crc_stats()
+            if _cs["fallbacks"] > _cs_start["fallbacks"]:
+                fleet_local.phases["ckpt_crc_fallback_count"] = float(
+                    _cs["fallbacks"] - _cs_start["fallbacks"]
+                )
+            if self._dcn_recovery is not None:
+                # Claim-generation fencing (round 17): which claim
+                # attempt produced this block, and for whom. gen > 0
+                # marks a hand-off after a claimant death mid-recovery.
+                fleet_local.phases["recovery_gen"] = float(
+                    self._dcn_recovery.get("gen", 0)
+                )
+                fleet_local.phases["recovery_for"] = float(
+                    self._dcn_recovery.get("for_pid", -1)
                 )
         fleet_tel = None
         # ---- THE end-of-replay gather (round 11, parallel.dcn) ----

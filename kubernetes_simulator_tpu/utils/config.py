@@ -179,6 +179,29 @@ class FlightRecorderSpec:
 
 
 @dataclass
+class FaultlineSpec:
+    """Deterministic fleet fault injection (``faultline:`` YAML section,
+    round 17 — parallel.faultline). Config-level spelling of the
+    ``KSIM_FAULTLINE_*`` env knobs, exported by the CLI (setdefault)
+    before ``jax.distributed`` bring-up. Rates are per-operation
+    probabilities in [0, 1] drawn from seeded per-class streams; ``kill``
+    is a SIGKILL schedule (``"1@run:0,*@recover:-1"`` — see
+    ``faultline.parse_kill_schedule``). Off by default and only
+    meaningful in multi-process (DCN) runs; enabling injection with
+    ``dcn.recovery`` disabled is legal but warned — injected kills and
+    give-ups then fail the fleet attributed instead of recovering."""
+
+    enabled: bool = False
+    seed: int = 0
+    kv_error_rate: float = 0.0
+    kv_delay_rate: float = 0.0
+    kv_delay_s: float = 0.02
+    torn_write_rate: float = 0.0
+    stale_read_rate: float = 0.0
+    kill: Optional[str] = None
+
+
+@dataclass
 class TelemetrySpec:
     """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
 
@@ -216,6 +239,7 @@ class SimConfig:
     tune: Optional[TuneSpec] = None
     chaos: Optional[ChaosSpec] = None
     dcn_recovery: Optional[DcnRecoverySpec] = None
+    faultline: Optional[FaultlineSpec] = None
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     output: Optional[str] = None
     wave_width: int = 8
@@ -355,6 +379,18 @@ class SimConfig:
                 enable=bool(rec.get("enable", False)),
                 checkpoint_every=int(rec.get("checkpointEvery", 0)),
                 max_claims=int(rec.get("maxClaims", 2)),
+            )
+        fl = d.get("faultline")
+        if fl is not None:
+            cfg.faultline = FaultlineSpec(
+                enabled=bool(fl.get("enabled", True)),
+                seed=int(fl.get("seed", 0)),
+                kv_error_rate=float(fl.get("kvErrorRate", 0.0)),
+                kv_delay_rate=float(fl.get("kvDelayRate", 0.0)),
+                kv_delay_s=float(fl.get("kvDelayS", 0.02)),
+                torn_write_rate=float(fl.get("tornWriteRate", 0.0)),
+                stale_read_rate=float(fl.get("staleReadRate", 0.0)),
+                kill=fl.get("kill"),
             )
         tl = d.get("telemetry")
         if tl is not None:

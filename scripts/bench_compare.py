@@ -33,7 +33,11 @@ BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
   same composed shape (nodes/pods/node_shards/paged); first appearance
   or a reshape is informational, and the wall / pager-stall / memory-
   watermark lines (top-level ``rss_peak_mib`` /
-  ``replicated_resident_peak_mib``) never gate.
+  ``replicated_resident_peak_mib``) never gate;
+- faultline hardening costs (``detail.fault_injection``, round 17):
+  retry-helper wall, CRC framing overhead and the torn-blob fallback
+  recovery wall under a fixed injected schedule — printed
+  informationally and NEVER gate (injection is off in the headline).
 
 Accepts both the archived wrapper shape ``{"n", "cmd", "rc", "parsed"}``
 and a raw bench JSON line ``{"metric", "value", ...}``. Exits nonzero
@@ -285,6 +289,30 @@ def compare_pair(
             if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
                 notes.append(
                     f"dcn_recovery {key}: {ga} -> {gb} (informational)"
+                )
+
+    # Faultline hardening costs (round 17): NEVER a regression — the
+    # block prices the retry helper / CRC framing / fallback path under
+    # a fixed injected schedule; injection is off in the headline.
+    fa, fb = da.get("fault_injection"), db.get("fault_injection")
+    if isinstance(fb, dict) and not isinstance(fa, dict):
+        notes.append(
+            "fault_injection: first appearance "
+            f"(retries {fb.get('retry_count')}, "
+            f"torn detected {fb.get('torn_detected')}"
+            f"/{fb.get('torn_injected')}, "
+            f"fallback wall {fb.get('fallback_recovery_wall_s')}s)"
+        )
+    elif isinstance(fa, dict) and isinstance(fb, dict):
+        for key in (
+            "retry_wall_s",
+            "crc_frame_overhead_pct",
+            "fallback_recovery_wall_s",
+        ):
+            ga, gb = fa.get(key), fb.get(key)
+            if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
+                notes.append(
+                    f"fault_injection {key}: {ga} -> {gb} (informational)"
                 )
     return regressions, notes
 

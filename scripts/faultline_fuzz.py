@@ -1,0 +1,425 @@
+#!/usr/bin/env python
+"""Seeded crash-schedule fuzzer for the faultline plane (round 17).
+
+Samples adversarial fault schedules — SIGKILL schedules (always
+including the double-kill and the recovering-claimant-kill), transient
+KV errors, added latency, torn checkpoint writes, stale reads — runs
+each against a 3-worker DCN fleet with recovery enabled, and asserts
+the surviving workers' end gathers are BYTE-IDENTICAL to a no-failure
+single-process oracle.  The injector only ever touches the coordination
+plane, so any divergence is a real recovery-semantics bug, not noise.
+
+Usage (also importable — tests/test_faultline_fuzz.py drives the same
+functions from the pytest slow slice):
+
+    python scripts/faultline_fuzz.py --schedules 5 --seed 17
+    python scripts/faultline_fuzz.py --worker    # internal: fleet child
+    python scripts/faultline_fuzz.py --oracle    # internal: oracle child
+
+Both child modes print one ``FAULTLINE_RESULT <json>`` line; the worker
+joins the coordinator through the production ``dcn.maybe_init_from_env``
+path first.  Schedules are pure functions of ``--seed`` — a failure
+reproduces with the same seed and schedule index.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF = os.path.abspath(__file__)
+
+NPROC = 3
+SCENARIOS = 12  # divisible by NPROC and by 1 (the oracle)
+CHUNKS_PER_WORKER = SCENARIOS // NPROC  # wave_width=1, chunk_waves=1
+
+SKIP_MARKER = "Multiprocess computations aren't implemented"
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# -- the workload (identical on worker and oracle sides) ---------------------
+
+
+def build_payload() -> dict:
+    """Run the fuzz workload and reduce the result to exact values and
+    content hashes.  Kube boundary mode + series telemetry on the no-mesh
+    DCN path — the same recovery-capable leg tests/test_dcn_recovery.py
+    pins — sized so each of the 3 workers owns 4 single-scenario chunks
+    (kill thresholds 0..3 all exercise a mid-block death).  Only
+    virtual-time-derived fields ride the payload: phase timers are
+    wall-clock and recovery legitimately re-namespaces them under the
+    claimant's pid."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    nodes = [Node(f"n{i}", {"cpu": 4.0}) for i in range(4)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=20.0)
+        for i in range(24)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    scenarios = []
+    for s in range(SCENARIOS):
+        if s % 3 == 1:
+            scenarios.append(Scenario(events=[
+                NodeEvent(time=4.0 + s, kind="node_down", node=s % 4),
+                NodeEvent(time=12.0 + s, kind="node_up", node=s % 4),
+            ]))
+        elif s % 3 == 2:
+            scenarios.append(Scenario(events=[
+                NodeEvent(time=6.0 + s, kind="node_down", node=(s + 1) % 4),
+            ]))
+        else:
+            scenarios.append(Scenario())
+    eng = WhatIfEngine(
+        ec, ep, scenarios, cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=32, telemetry="series",
+    )
+    res = eng.run()
+    ft = res.fleet_telemetry
+    assert ft is not None, "fleet_telemetry missing from what-if result"
+    return {
+        "placed": res.placed.tolist(),
+        "evictions": res.evictions.tolist(),
+        "evict_rescheduled": res.evict_rescheduled.tolist(),
+        "total_placed": int(res.total_placed),
+        "granularity": ft.granularity,
+        "latency": ft.latency,
+        "reasons": ft.reasons,
+        "rejection_attempts": ft.rejection_attempts,
+        "zero_latency_binds": int(ft.zero_latency_binds),
+        "bind_values": [float(v) for v in ft.bind_latency.values()],
+        "series_sha": _sha(json.dumps(ft.series, sort_keys=True).encode()),
+        "events_len": len(ft.events),
+    }
+
+
+def _emit(payload: dict) -> None:
+    print("FAULTLINE_RESULT " + json.dumps(payload, sort_keys=True),
+          flush=True)
+
+
+def main_worker() -> int:
+    from kubernetes_simulator_tpu.parallel import dcn
+
+    assert dcn.maybe_init_from_env(), "KSIM_DCN_* env not set"
+    _emit(build_payload())
+    return 0
+
+
+def main_oracle() -> int:
+    _emit(build_payload())
+    return 0
+
+
+# -- schedule sampling -------------------------------------------------------
+
+# The two mandatory schedules of the acceptance bar: ≥2 concurrent worker
+# deaths, and a claimant killed at its first recovery beacon (the ``*``
+# CAS entry — whichever survivor claims first dies, the other hands off
+# via claim generation 1).
+MANDATORY = (
+    {"name": "double-kill", "kill": "1@run:0,2@run:0", "seed": 1701},
+    {"name": "claimant-kill", "kill": "2@run:0,*@recover:-1", "seed": 1702},
+)
+
+
+def sample_schedules(seed: int, n: int):
+    """``n`` fault schedules, a pure function of ``seed``.  The first two
+    are always the mandatory double-kill and claimant-kill; the rest mix
+    a random named kill (or none) with KV error/latency/torn/stale rates
+    low enough that the bounded retries absorb them."""
+    rng = random.Random(int(seed) * 9176 + 5)
+    out = [dict(s) for s in MANDATORY]
+    while len(out) < n:
+        sch = {"name": f"rand{len(out)}", "seed": rng.randrange(1, 10 ** 6)}
+        # Killable pids exclude 0: the coordinator hosts the
+        # jax.distributed coordination service, whose death is
+        # unsurvivable by construction (outside this fuzzer's bar).
+        roll = rng.random()
+        if roll < 0.45:
+            pid = rng.randrange(1, NPROC)
+            chunk = rng.randrange(CHUNKS_PER_WORKER - 1)
+            sch["kill"] = f"{pid}@run:{chunk}"
+        elif roll < 0.6:
+            a, b = rng.sample(range(1, NPROC), 2)
+            sch["kill"] = (
+                f"{a}@run:{rng.randrange(2)},{b}@run:{rng.randrange(2)}"
+            )
+        sch["kv_error_rate"] = rng.choice([0.0, 0.02, 0.05])
+        sch["kv_delay_rate"] = rng.choice([0.0, 0.05])
+        sch["torn_rate"] = rng.choice([0.0, 0.25, 0.5])
+        sch["stale_rate"] = rng.choice([0.0, 0.05])
+        out.append(sch)
+    return out
+
+
+def named_kill_pids(sched: dict):
+    """Pids a schedule kills unconditionally (named run-state entries
+    with a reachable chunk threshold), and the count of ``*`` entries
+    (each kills exactly one process, identity schedule-dependent)."""
+    from kubernetes_simulator_tpu.parallel import faultline
+
+    named, wildcard = set(), 0
+    for pid_s, state, chunk in faultline.parse_kill_schedule(
+        sched.get("kill", "")
+    ):
+        if pid_s == "*":
+            wildcard += 1
+        elif state == "run" and chunk < CHUNKS_PER_WORKER:
+            named.add(int(pid_s))
+    return named, wildcard
+
+
+# -- fleet orchestration -----------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(extra: dict) -> dict:
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": os.pathsep.join(
+            [_REPO]
+            + [
+                p
+                for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p
+            ]
+        ),
+    }
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def run_oracle(timeout_s: float = 600.0) -> dict:
+    """The no-failure reference payload, computed in a clean subprocess
+    (no DCN env, no faultline) through the same JSON round-trip the
+    worker results take."""
+    env = _child_env({})
+    for k in list(env):
+        if k.startswith("KSIM_DCN") or k.startswith("KSIM_FAULTLINE"):
+            del env[k]
+    p = subprocess.run(
+        [sys.executable, _SELF, "--oracle"],
+        env=env, capture_output=True, text=True, timeout=timeout_s,
+    )
+    assert p.returncode == 0, f"oracle failed:\n{p.stdout}\n{p.stderr}"
+    lines = [
+        l for l in p.stdout.splitlines()
+        if l.startswith("FAULTLINE_RESULT ")
+    ]
+    assert lines, f"oracle printed no result:\n{p.stdout}\n{p.stderr}"
+    return json.loads(lines[-1][len("FAULTLINE_RESULT "):])
+
+
+def run_schedule(sched: dict, hb_dir: str, timeout_s: float = 600.0) -> dict:
+    """Run one schedule against a fresh 3-worker fleet.  Returns
+    ``{"skip": bool, "rcs": {pid: rc}, "results": {pid: payload},
+    "blob": str}`` — ``results`` holds every surviving worker's gathered
+    payload."""
+    port = _free_port()
+    base = _child_env({
+        "KSIM_DCN_COORD": f"127.0.0.1:{port}",
+        "KSIM_DCN_NPROC": NPROC,
+        # Recovery knobs: checkpoint every chunk, claim fast, two
+        # generations so a killed claimant hands off exactly once.
+        "KSIM_DCN_RECOVER": "1",
+        "KSIM_DCN_CKPT_EVERY": "1",
+        "KSIM_DCN_TIMEOUT_S": "600",
+        "KSIM_DCN_STALL_S": "2",
+        "KSIM_DCN_POLL_S": "0.3",
+        "KSIM_DCN_HEARTBEAT_EVERY": "1",
+        "KSIM_DCN_MAX_CLAIMS": "2",
+        "KSIM_DCN_RETRY_BASE_S": "0.01",
+        "KSIM_DCN_HB_DIR": hb_dir,
+        # The schedule itself.
+        "KSIM_FAULTLINE": "1",
+        "KSIM_FAULTLINE_SEED": sched.get("seed", 0),
+        "KSIM_FAULTLINE_KV_ERROR_RATE": sched.get("kv_error_rate", 0.0),
+        "KSIM_FAULTLINE_KV_DELAY_RATE": sched.get("kv_delay_rate", 0.0),
+        "KSIM_FAULTLINE_KV_DELAY_S": "0.01",
+        "KSIM_FAULTLINE_TORN_RATE": sched.get("torn_rate", 0.0),
+        "KSIM_FAULTLINE_STALE_RATE": sched.get("stale_rate", 0.0),
+        "KSIM_FAULTLINE_KILL": sched.get("kill", ""),
+    })
+    procs = []
+    for pid in range(NPROC):
+        procs.append(subprocess.Popen(
+            [sys.executable, _SELF, "--worker"],
+            env=dict(base, KSIM_DCN_PID=str(pid)),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = {}
+    try:
+        for pid, p in enumerate(procs):
+            outs[pid] = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for pid, p in enumerate(procs):
+            outs.setdefault(pid, ("", "fleet timed out"))
+        return {
+            "skip": False,
+            "timeout": True,
+            "rcs": {pid: p.returncode for pid, p in enumerate(procs)},
+            "results": {},
+            "blob": "\n".join(o + e for o, e in outs.values()),
+        }
+    blob = "\n".join(o + e for o, e in outs.values())
+    results = {}
+    for pid, p in enumerate(procs):
+        if p.returncode == 0:
+            lines = [
+                l for l in outs[pid][0].splitlines()
+                if l.startswith("FAULTLINE_RESULT ")
+            ]
+            if lines:
+                results[pid] = json.loads(
+                    lines[-1][len("FAULTLINE_RESULT "):]
+                )
+    return {
+        "skip": SKIP_MARKER in blob,
+        "timeout": False,
+        "rcs": {pid: p.returncode for pid, p in enumerate(procs)},
+        "results": results,
+        "blob": blob,
+    }
+
+
+def check_schedule(sched: dict, out: dict, oracle: dict):
+    """Byte-parity + liveness assertions for one schedule run.  Returns
+    a list of failure strings (empty ⇒ the schedule passed)."""
+    fails = []
+    if out.get("timeout"):
+        return [f"{sched['name']}: fleet timed out"]
+    named, wildcard = named_kill_pids(sched)
+    rcs = out["rcs"]
+    for pid in named:
+        if rcs.get(pid) != -9:
+            fails.append(
+                f"{sched['name']}: pid {pid} should have been SIGKILLed "
+                f"(rc {rcs.get(pid)})"
+            )
+    killed = sum(1 for rc in rcs.values() if rc == -9)
+    if killed > len(named) + wildcard:
+        fails.append(
+            f"{sched['name']}: {killed} processes died, schedule allows "
+            f"at most {len(named) + wildcard}"
+        )
+    survivors = [pid for pid, rc in rcs.items() if rc == 0]
+    if not survivors:
+        fails.append(f"{sched['name']}: no surviving worker (rcs {rcs})")
+    if wildcard and killed > len(named):
+        # A ``*`` entry fired: a claimant died mid-recovery, so a
+        # survivor must have opened the next claim generation (the
+        # fenced hand-off) — not silently re-used the dead claim.
+        if "opening generation" not in out["blob"]:
+            fails.append(
+                f"{sched['name']}: wildcard kill fired but no claim "
+                "generation hand-off appeared in the logs"
+            )
+    for pid in survivors:
+        got = out["results"].get(pid)
+        if got is None:
+            fails.append(
+                f"{sched['name']}: survivor {pid} printed no result"
+            )
+        elif got != oracle:
+            diff = [k for k in oracle if got.get(k) != oracle[k]]
+            fails.append(
+                f"{sched['name']}: survivor {pid} diverged from the "
+                f"no-failure oracle in {diff}"
+            )
+    return fails
+
+
+def main_fuzz(seed: int, n: int, timeout_s: float) -> int:
+    import tempfile
+
+    print("faultline fuzz: oracle run (no failures) ...", flush=True)
+    oracle = run_oracle(timeout_s=timeout_s)
+    scheds = sample_schedules(seed, n)
+    failures = []
+    skipped = 0
+    for i, sched in enumerate(scheds):
+        desc = {k: v for k, v in sched.items() if k != "name"}
+        print(f"faultline fuzz: [{i + 1}/{n}] {sched['name']} {desc}",
+              flush=True)
+        with tempfile.TemporaryDirectory() as hb:
+            out = run_schedule(sched, hb, timeout_s=timeout_s)
+        if out["skip"]:
+            skipped += 1
+            print(
+                f"faultline fuzz: [{i + 1}/{n}] SKIP (no multiprocess "
+                "CPU backend)", flush=True,
+            )
+            continue
+        fails = check_schedule(sched, out, oracle)
+        if fails:
+            failures.extend(fails)
+            print(f"faultline fuzz: [{i + 1}/{n}] FAIL: {fails}",
+                  flush=True)
+            tail = "\n".join(out["blob"].splitlines()[-40:])
+            print(tail, flush=True)
+        else:
+            survivors = [p for p, rc in out["rcs"].items() if rc == 0]
+            print(
+                f"faultline fuzz: [{i + 1}/{n}] ok — rcs {out['rcs']}, "
+                f"{len(survivors)} survivor(s) byte-identical to oracle",
+                flush=True,
+            )
+    if failures:
+        print(f"faultline fuzz: {len(failures)} failure(s)", flush=True)
+        return 1
+    print(
+        f"faultline fuzz: all {n - skipped} schedule(s) byte-identical "
+        f"to the no-failure oracle ({skipped} skipped)", flush=True,
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run as one fleet worker")
+    ap.add_argument("--oracle", action="store_true",
+                    help="internal: run the no-failure oracle")
+    ap.add_argument("--schedules", type=int, default=5,
+                    help="number of fault schedules to sample (>= 5 "
+                         "includes the mandatory double-kill and "
+                         "claimant-kill)")
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-run timeout in seconds")
+    args = ap.parse_args()
+    if args.worker:
+        return main_worker()
+    if args.oracle:
+        return main_oracle()
+    return main_fuzz(args.seed, max(args.schedules, 2), args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -630,14 +630,14 @@ def test_gather_wait_recovers_stale_sibling(monkeypatch, tmp_path):
     )
     calls = []
 
-    def _recover(p):
-        calls.append(p)
+    def _recover(p, gen):
+        calls.append((p, gen))
         return {"placed": np.array([1, 2], np.int32)}
 
     got = dcn._get_attributed(
         kv, "ksim/gather/1/whatif/1/n", 1, "whatif", recover=_recover
     )
-    assert calls == [1]
+    assert calls == [(1, 0)]
     assert got == "1"  # the published manifest (one KV chunk)
     # Single-claimant key exists with our metadata.
     meta = dcn.read_claim(1, 0)
@@ -685,7 +685,7 @@ def test_gather_wait_defers_to_live_claimant(monkeypatch):
 
     kv.blocking_key_value_get = _late_get
 
-    def _never(p):  # pragma: no cover - must not fire
+    def _never(p, gen):  # pragma: no cover - must not fire
         raise AssertionError("CAS loser re-executed the block")
 
     got = dcn._get_attributed(
@@ -716,14 +716,14 @@ def test_gather_wait_opens_next_generation_on_stale_claimant(monkeypatch):
     )
     calls = []
 
-    def _recover(p):
-        calls.append(p)
+    def _recover(p, gen):
+        calls.append((p, gen))
         return {"placed": np.array([7], np.int32)}
 
     got = dcn._get_attributed(
         kv, "ksim/gather/1/whatif/1/n", 1, "whatif", recover=_recover
     )
-    assert got == "1" and calls == [1]
+    assert got == "1" and calls == [(1, 1)]
     assert dcn.read_claim(1, 1)["claimant"] == 0
 
 
@@ -753,7 +753,7 @@ def test_gather_wait_exhausted_claims_raise_attributed(monkeypatch):
     with pytest.raises(dcn.DcnGatherTimeout, match="looks DEAD"):
         dcn._get_attributed(
             kv, "ksim/gather/1/whatif/1/n", 1, "whatif",
-            recover=lambda p: {},
+            recover=lambda p, gen: {},
         )
 
 
@@ -775,7 +775,7 @@ def test_gather_wait_stale_beacon_still_fails_without_recover_knob(
     with pytest.raises(dcn.DcnGatherTimeout, match="looks DEAD"):
         dcn._get_attributed(
             kv, "ksim/gather/1/whatif/1/n", 1, "whatif",
-            recover=lambda p: {},
+            recover=lambda p, gen: {},
         )
 
 

@@ -1,0 +1,650 @@
+"""Single-process unit tests for the round-17 faultline plane: injector
+determinism (same seed ⇒ same schedule, per-class stream independence),
+kill-schedule parsing, CRC32+length checkpoint framing, the bounded
+kv_retry backoff envelope, the corrupt-blob fallback in load_checkpoint,
+the transient-vs-lost claim disambiguation, and the validate_config
+refusals for the ``faultline:`` YAML section.  The multi-process
+byte-parity property lives in tests/test_faultline_fuzz.py (slow)."""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.parallel import dcn, faultline
+
+# -- kill-schedule grammar ---------------------------------------------------
+
+
+def test_parse_kill_schedule_grammar():
+    assert faultline.parse_kill_schedule("") == []
+    assert faultline.parse_kill_schedule("1:0") == [("1", "run", 0)]
+    assert faultline.parse_kill_schedule("1@recover:-1") == [
+        ("1", "recover", -1)
+    ]
+    assert faultline.parse_kill_schedule("0@run:2, *@recover:-1") == [
+        ("0", "run", 2),
+        ("*", "recover", -1),
+    ]
+
+
+@pytest.mark.parametrize(
+    "spec", ["1", "1@run", "x@run:0", "-2@run:0", "1@:0", "1@run:x"]
+)
+def test_parse_kill_schedule_refuses_malformed(spec):
+    with pytest.raises(ValueError, match="faultline kill entry"):
+        faultline.parse_kill_schedule(spec)
+
+
+# -- injector determinism ----------------------------------------------------
+
+
+def test_injector_same_seed_same_schedule():
+    """The k-th decision of a class is a pure function of (seed, pid,
+    class) — the contract the fuzz harness leans on."""
+    a = faultline.Injector(seed=7, pid=1, kv_error_rate=0.3, torn_write_rate=0.5)
+    b = faultline.Injector(seed=7, pid=1, kv_error_rate=0.3, torn_write_rate=0.5)
+    seq_a = [a.hit("kv_error") for _ in range(64)]
+    seq_b = [b.hit("kv_error") for _ in range(64)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)  # rate actually bites, bounded
+    assert a.stats()["kv_error"] == sum(seq_a)
+
+
+def test_injector_streams_are_independent():
+    """Drawing from one class never shifts another: interleaving torn
+    draws between kv_error draws leaves the kv_error schedule intact."""
+    pure = faultline.Injector(seed=3, pid=0, kv_error_rate=0.4, torn_write_rate=0.4)
+    mixed = faultline.Injector(seed=3, pid=0, kv_error_rate=0.4, torn_write_rate=0.4)
+    want = [pure.hit("kv_error") for _ in range(32)]
+    got = []
+    for _ in range(32):
+        mixed.hit("torn")
+        got.append(mixed.hit("kv_error"))
+        mixed.hit("stale")
+    assert got == want
+
+
+def test_injector_seed_and_pid_change_schedule():
+    seqs = set()
+    for seed, pid in [(7, 0), (8, 0), (7, 1)]:
+        inj = faultline.Injector(seed=seed, pid=pid, kv_error_rate=0.5)
+        seqs.add(tuple(inj.hit("kv_error") for _ in range(32)))
+    assert len(seqs) == 3, "seed/pid must derive distinct streams"
+
+
+def test_injector_zero_rate_never_draws():
+    """rate <= 0 short-circuits without consuming the stream, so adding
+    a disabled class to a run never perturbs the enabled ones."""
+    inj = faultline.Injector(seed=5, pid=0, kv_error_rate=0.5)
+    ref = faultline.Injector(seed=5, pid=0, kv_error_rate=0.5)
+    out = []
+    for _ in range(16):
+        assert inj.hit("stale") is False  # rate 0.0
+        out.append(inj.hit("kv_error"))
+    assert out == [ref.hit("kv_error") for _ in range(16)]
+    assert "stale" not in inj._rng  # never even built the stream
+
+
+def test_injector_tear_mangles_deterministically():
+    a = faultline.Injector(seed=11, pid=2)
+    b = faultline.Injector(seed=11, pid=2)
+    blob = "x" * 64
+    torn_a = [a.tear(blob) for _ in range(8)]
+    torn_b = [b.tear(blob) for _ in range(8)]
+    assert torn_a == torn_b
+    assert all(t != blob for t in torn_a)
+    assert a.tear("") == ""
+
+
+def test_from_env_reads_knobs(monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE_SEED", "9")
+    monkeypatch.setenv("KSIM_DCN_PID", "2")
+    monkeypatch.setenv("KSIM_FAULTLINE_KV_ERROR_RATE", "0.25")
+    monkeypatch.setenv("KSIM_FAULTLINE_TORN_RATE", "0.5")
+    monkeypatch.setenv("KSIM_FAULTLINE_KILL", "1@run:0")
+    inj = faultline.from_env()
+    assert inj.seed == 9 and inj.pid == 2
+    assert inj.rates["kv_error"] == 0.25
+    assert inj.rates["torn"] == inj.rates["file"] == 0.5
+    assert inj.kill_entries == [("1", "run", 0)]
+
+
+# -- KV proxy ---------------------------------------------------------------
+
+
+class _FakeKV:
+    """In-memory stand-in for the jaxlib coordination-service KV client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.store:
+            raise RuntimeError(f"key exists: {key}")
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms=1000):
+        if key in self.store:
+            return self.store[key]
+        raise RuntimeError(f"Deadline Exceeded: {key}")
+
+    def key_value_dir_get(self, prefix):
+        return [
+            (k, v) for k, v in sorted(self.store.items())
+            if k.startswith(prefix)
+        ]
+
+
+@pytest.fixture
+def fl_off(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("KSIM_FAULTLINE"):
+            monkeypatch.delenv(k, raising=False)
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+def test_wrap_kv_identity_when_off(fl_off):
+    kv = _FakeKV()
+    assert faultline.active() is False
+    assert faultline.wrap_kv(kv) is kv
+    assert faultline.wrap_kv(None) is None
+    assert faultline.file_blob("beat") == "beat"
+
+
+def test_wrap_kv_injects_errors_and_tears_ckpt_only(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_SEED", "17")
+    monkeypatch.setenv("KSIM_FAULTLINE_KV_ERROR_RATE", "0.5")
+    monkeypatch.setenv("KSIM_FAULTLINE_TORN_RATE", "1.0")
+    kv = _FakeKV()
+    proxy = faultline.wrap_kv(kv)
+    assert proxy is not kv and proxy.raw is kv
+    assert faultline.wrap_kv(kv) is proxy  # cached
+
+    errors = 0
+    for i in range(32):
+        try:
+            proxy.key_value_set(f"ksim/hb/{i}", "beat", allow_overwrite=True)
+        except faultline.FaultlineInjected:
+            errors += 1
+    assert 0 < errors < 32
+    # Non-checkpoint values are NEVER torn, even at torn rate 1.0.
+    assert all(v == "beat" for k, v in kv.store.items())
+
+    # Checkpoint chunks ARE torn (keep trying past injected errors).
+    for i in range(8):
+        try:
+            proxy.key_value_set(f"ksim/ckpt/1/0/0-4/0/{i}", "A" * 32,
+                                allow_overwrite=True)
+        except faultline.FaultlineInjected:
+            pass
+    torn = [v for k, v in kv.store.items()
+            if k.startswith("ksim/ckpt/") and v != "A" * 32]
+    assert torn, "torn rate 1.0 must mangle checkpoint chunks"
+
+    # faultline's own coordination keys bypass injection entirely.
+    for _ in range(16):
+        proxy.key_value_set("ksim/faultline/kill/0", "1", allow_overwrite=True)
+    assert kv.store["ksim/faultline/kill/0"] == "1"
+
+
+def test_proxy_stale_reads_return_previous_snapshot(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_SEED", "3")
+    monkeypatch.setenv("KSIM_FAULTLINE_STALE_RATE", "1.0")
+    kv = _FakeKV()
+    proxy = faultline.wrap_kv(kv)
+    kv.store["k"] = "v1"
+    assert proxy.blocking_key_value_get("k") == "v1"  # no history yet
+    kv.store["k"] = "v2"
+    assert proxy.blocking_key_value_get("k") == "v1"  # stale snapshot
+    kv.store["hb/0"] = "a"
+    assert proxy.key_value_dir_get("hb") == [("hb/0", "a")]
+    kv.store["hb/1"] = "b"
+    assert proxy.key_value_dir_get("hb") == [("hb/0", "a")]  # stale dir
+
+
+def test_maybe_kill_named_entry_fires_in_state(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_DCN_PID", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_KILL", "1@run:2")
+    kills = []
+    monkeypatch.setattr(faultline.os, "kill", lambda pid, sig: kills.append(sig))
+    faultline.maybe_kill(0, "run")
+    faultline.maybe_kill(2, "gather")  # wrong state
+    assert kills == []
+    faultline.maybe_kill(2, "run")
+    assert kills == [faultline.signal.SIGKILL]
+
+
+def test_maybe_kill_wildcard_never_matches_coordinator(fl_off, monkeypatch):
+    """Process 0 hosts the jax.distributed coordination service — its
+    death aborts every healthy task, so ``*`` entries skip it without
+    even touching the kill CAS."""
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_DCN_PID", "0")
+    monkeypatch.setenv("KSIM_FAULTLINE_KILL", "*@recover:-1")
+    monkeypatch.setattr(
+        faultline.os, "kill",
+        lambda pid, sig: pytest.fail("'*' must never match the coordinator"),
+    )
+    faultline.maybe_kill(-1, "recover")
+    faultline.maybe_kill(3, "recover")
+
+
+def test_maybe_kill_other_pid_never_fires(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_DCN_PID", "0")
+    monkeypatch.setenv("KSIM_FAULTLINE_KILL", "1@run:0")
+    monkeypatch.setattr(
+        faultline.os, "kill",
+        lambda pid, sig: pytest.fail("kill fired for another pid"),
+    )
+    faultline.maybe_kill(5, "run")
+
+
+# -- CRC framing -------------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    for data in ["", "abc", "x" * 4096, json.dumps({"a": [1, 2]})]:
+        framed = dcn._frame_chunk(data)
+        assert framed.startswith("kf1:")
+        assert dcn._unframe_chunk(framed) == data
+
+
+def test_unframe_detects_torn_truncated_corrupt():
+    framed = dcn._frame_chunk("hello world")
+    with pytest.raises(ValueError, match="not framed"):
+        dcn._unframe_chunk("hello world")
+    with pytest.raises(ValueError, match="not framed|truncated"):
+        dcn._unframe_chunk(framed[:6])
+    with pytest.raises(ValueError, match="length mismatch"):
+        dcn._unframe_chunk(framed[:-3])
+    bad = framed[:-1] + chr(ord(framed[-1]) ^ 0x1)
+    with pytest.raises(ValueError, match="CRC32 mismatch"):
+        dcn._unframe_chunk(bad)
+
+
+def test_injected_tear_always_caught_by_frame():
+    """Every mangling the injector can produce (truncation or one-char
+    flip) fails frame validation — the property the whole fallback
+    chain rests on."""
+    inj = faultline.Injector(seed=17, pid=0)
+    framed = dcn._frame_chunk("payload-" * 16)
+    for _ in range(64):
+        torn = inj.tear(framed)
+        assert torn != framed
+        with pytest.raises(ValueError):
+            dcn._unframe_chunk(torn)
+
+
+# -- kv_retry backoff envelope ----------------------------------------------
+
+
+def test_kv_retry_success_first_attempt_no_sleep():
+    s0 = dcn.retry_stats()
+    sleeps = []
+    assert (
+        dcn.kv_retry(lambda: 42, op="t", sleep=sleeps.append) == 42
+    )
+    assert sleeps == []
+    s1 = dcn.retry_stats()
+    assert s1["attempts"] == s0["attempts"] + 1
+    assert s1["retries"] == s0["retries"]
+    assert s1["giveups"] == s0["giveups"]
+
+
+def test_kv_retry_backoff_bounds_and_giveup():
+    """Delay before retry k is min(cap, base*2^k) * u with u in
+    [0.5, 1.0] — bounded both sides, attempts exhausted ⇒ attributed
+    DcnRetryError carrying op/key/attempts/last."""
+    s0 = dcn.retry_stats()
+    sleeps = []
+    boom = RuntimeError("flaky")
+
+    def _fail():
+        raise boom
+
+    with pytest.raises(dcn.DcnRetryError) as ei:
+        dcn.kv_retry(
+            _fail, op="heartbeat", key="ksim/hb/0",
+            attempts=4, base_s=0.1, cap_s=0.25, sleep=sleeps.append,
+        )
+    assert len(sleeps) == 3  # n-1 backoffs for n attempts
+    for k, d in enumerate(sleeps):
+        env = min(0.25, 0.1 * 2.0 ** k)
+        assert 0.5 * env <= d <= env, (k, d, env)
+    assert sleeps[2] <= 0.25  # cap bites at k=2 (0.4 uncapped)
+    e = ei.value
+    assert e.op == "heartbeat" and e.key == "ksim/hb/0"
+    assert e.attempts == 4 and e.last is boom
+    assert "gave up after 4 attempts" in str(e)
+    s1 = dcn.retry_stats()
+    assert s1["attempts"] == s0["attempts"] + 4
+    assert s1["retries"] == s0["retries"] + 3
+    assert s1["giveups"] == s0["giveups"] + 1
+    assert s1["backoff_s"] > s0["backoff_s"]
+
+
+def test_kv_retry_recovers_after_transient():
+    calls = {"n": 0}
+
+    def _flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    sleeps = []
+    assert dcn.kv_retry(_flaky, op="t", attempts=4, base_s=0.01,
+                        sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+
+
+def test_kv_retry_jitter_injectable():
+    sleeps = []
+
+    def _fail():
+        raise RuntimeError("x")
+
+    with pytest.raises(dcn.DcnRetryError):
+        dcn.kv_retry(_fail, op="t", attempts=3, base_s=0.2, cap_s=10.0,
+                     sleep=sleeps.append, jitter=lambda: 1.0)
+    assert sleeps == [0.2, 0.4]  # u=1.0 pins the upper envelope exactly
+
+
+# -- checkpoint CRC fallback -------------------------------------------------
+
+
+def _fleet(monkeypatch, nproc=2, pid=1):
+    kv = _FakeKV()
+    monkeypatch.setattr(dcn, "process_info", lambda: (nproc, pid))
+    monkeypatch.setattr(dcn, "_client", lambda: kv)
+    monkeypatch.setattr(dcn, "_degraded_exit_armed", [True])
+    monkeypatch.setattr(dcn, "DEGRADED", set())
+    return kv
+
+
+def test_corrupt_newest_blob_falls_back_to_prior_epoch(monkeypatch):
+    """The headline acceptance drill: deliberately corrupt the newest
+    checkpoint blob — load_checkpoint detects it via the CRC frame and
+    falls back to the newest PRIOR complete cursor."""
+    kv = _fleet(monkeypatch, nproc=2, pid=1)
+    pay0 = {"cursor": 1, "leaves": [np.arange(512, dtype=np.int32)]}
+    pay1 = {"cursor": 3, "leaves": [np.arange(512, dtype=np.int32) * 3]}
+    assert dcn.publish_checkpoint(1, pay0, (4, 8), epoch=7)
+    assert dcn.publish_checkpoint(3, pay1, (4, 8), epoch=7)
+    # Corrupt one chunk of the newest blob (flip a payload char).
+    key = f"{dcn.CKPT_PREFIX}/7/1/4-8/3/0"
+    v = kv.store[key]
+    kv.store[key] = v[:-1] + chr(ord(v[-1]) ^ 0x1)
+    c0 = dcn.crc_stats()
+    got = dcn.load_checkpoint(1, epoch=7)
+    assert got is not None and got["cursor"] == 1
+    np.testing.assert_array_equal(
+        got["payload"]["leaves"][0], pay0["leaves"][0]
+    )
+    c1 = dcn.crc_stats()
+    assert c1["fallbacks"] == c0["fallbacks"] + 1
+    assert c1["frames_bad"] == c0["frames_bad"] + 1
+    # Corrupt the older blob too: nothing usable remains.
+    key0 = f"{dcn.CKPT_PREFIX}/7/1/4-8/1/0"
+    kv.store[key0] = kv.store[key0][:40]
+    assert dcn.load_checkpoint(1, epoch=7) is None
+
+
+def test_manifest_crc_guards_whole_blob(monkeypatch):
+    """Per-chunk frames can all pass while a chunk is MISSING content
+    relative to the manifest — the whole-blob crc/len in the JSON
+    manifest catches chunk-level swaps."""
+    kv = _fleet(monkeypatch, nproc=2, pid=0)
+    pay = {"cursor": 2, "leaves": [np.ones(2048, np.int32)]}
+    assert dcn.publish_checkpoint(2, pay, (0, 4), epoch=5)
+    # Replace chunk 0 with a validly-framed but WRONG chunk.
+    key = f"{dcn.CKPT_PREFIX}/5/0/0-4/2/0"
+    kv.store[key] = dcn._frame_chunk("not-the-real-chunk")
+    assert dcn.load_checkpoint(0, epoch=5) is None
+
+
+def test_legacy_bare_int_manifest_still_loads(monkeypatch):
+    """Pre-round-17 blobs (bare-int manifest, unframed chunks) load
+    unvalidated — mixed-version tolerance."""
+    kv = _fleet(monkeypatch, nproc=2, pid=1)
+    chunks = dcn._encode_payload({"cursor": 0, "leaves": []})
+    prefix = f"{dcn.CKPT_PREFIX}/3/1/4-8/0"
+    for j, ch in enumerate(chunks):
+        kv.store[f"{prefix}/{j}"] = ch
+    kv.store[f"{prefix}/n"] = str(len(chunks))
+    got = dcn.load_checkpoint(1, epoch=3)
+    assert got is not None and got["cursor"] == 0
+    assert got["payload"]["cursor"] == 0
+
+
+def test_load_checkpoint_before_cursor_walks_older(monkeypatch):
+    _fleet(monkeypatch, nproc=2, pid=1)
+    for cur in (1, 3, 5):
+        assert dcn.publish_checkpoint(
+            cur, {"cursor": cur, "leaves": []}, (4, 8), epoch=2
+        )
+    assert dcn.load_checkpoint(1, epoch=2)["cursor"] == 5
+    assert dcn.load_checkpoint(1, epoch=2, before_cursor=5)["cursor"] == 3
+    assert dcn.load_checkpoint(1, epoch=2, before_cursor=3)["cursor"] == 1
+    assert dcn.load_checkpoint(1, epoch=2, before_cursor=1) is None
+
+
+def test_publish_checkpoint_retries_through_transient_faults(
+    fl_off, monkeypatch
+):
+    """With faultline injecting KV set errors at a moderate rate, the
+    bounded retries inside publish_checkpoint absorb them and the blob
+    round-trips clean."""
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_SEED", "17")
+    monkeypatch.setenv("KSIM_FAULTLINE_KV_ERROR_RATE", "0.2")
+    monkeypatch.setenv("KSIM_DCN_RETRY_BASE_S", "0.001")
+    raw = _FakeKV()
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 1))
+    monkeypatch.setattr(dcn, "_client", lambda: faultline.wrap_kv(raw))
+    monkeypatch.setattr(dcn, "_degraded_exit_armed", [True])
+    pay = {"cursor": 1, "leaves": [np.arange(256, dtype=np.int32)]}
+    s0 = dcn.retry_stats()
+    assert dcn.publish_checkpoint(1, pay, (4, 8), epoch=1)
+    got = dcn.load_checkpoint(1, epoch=1)
+    assert got is not None and got["cursor"] == 1
+    np.testing.assert_array_equal(got["payload"]["leaves"][0],
+                                  pay["leaves"][0])
+    assert dcn.retry_stats()["retries"] > s0["retries"]
+
+
+# -- claim disambiguation ----------------------------------------------------
+
+
+def test_try_claim_transient_error_that_landed_counts_as_won(monkeypatch):
+    """A transient set error is ambiguous — the CAS may have landed
+    before the error surfaced. try_claim reads the key back and the
+    VALUE decides."""
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    monkeypatch.setenv("KSIM_DCN_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("KSIM_DCN_RETRIES", "2")
+    real_set = kv.key_value_set
+
+    def _landed_then_error(key, value, allow_overwrite=False):
+        real_set(key, value, allow_overwrite=allow_overwrite)
+        raise RuntimeError("connection reset (but the set landed)")
+
+    kv.key_value_set = _landed_then_error
+    assert dcn.try_claim(2, 0) is True
+    assert dcn.read_claim(2, 0)["claimant"] == 0
+
+
+def test_try_claim_genuine_cas_loss_still_lost(monkeypatch):
+    kv = _fleet(monkeypatch, nproc=3, pid=1)
+    monkeypatch.setenv("KSIM_DCN_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("KSIM_DCN_RETRIES", "2")
+    kv.store[f"{dcn.CLAIM_PREFIX}/{dcn._seq}/whatif/2/0"] = json.dumps(
+        {"claimant": 0, "for": 2, "gen": 0, "t": 1.0}
+    )
+    assert dcn.try_claim(2, 0) is False
+
+
+# -- coordinator claims last -------------------------------------------------
+
+
+def test_coordinator_defers_claim_to_live_sibling(monkeypatch):
+    """Round 17: process 0 (the coordination-service host — the one
+    process whose death is unsurvivable) gives a live sibling one stall
+    window to claim a dead block before claiming itself. Here pid 2
+    claims during the grace window, so pid 0 defers (returns True to
+    keep polling) and never re-executes the block."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "1")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.5")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    now = time.time()
+    kv.store[f"{dcn.HB_PREFIX}/1"] = json.dumps(
+        {"pid": 1, "chunk": 0, "t": now - 10.0}
+    )
+    kv.store[f"{dcn.HB_PREFIX}/2"] = json.dumps(
+        {"pid": 2, "chunk": 3, "t": now}
+    )
+    claim_key = f"{dcn.CLAIM_PREFIX}/{dcn._seq}/whatif/1/0"
+    real_sleep = time.sleep
+
+    def _sibling_claims(d):
+        kv.store.setdefault(claim_key, json.dumps(
+            {"claimant": 2, "for": 1, "gen": 0, "t": time.time()}
+        ))
+        real_sleep(0)
+
+    monkeypatch.setattr(dcn.time, "sleep", _sibling_claims)
+    ok = dcn._maybe_recover(
+        kv, "ksim/gather/1", 1, "whatif",
+        recover=lambda p, gen=0: pytest.fail(
+            "coordinator re-executed a block a live sibling claimed"
+        ),
+    )
+    assert ok is True
+    assert json.loads(kv.store[claim_key])["claimant"] == 2
+
+
+def test_coordinator_claims_when_no_live_sibling(monkeypatch):
+    """Liveness: with every other process stale, the coordinator's
+    grace window collapses immediately and it claims generation 0."""
+    import time
+
+    kv = _fleet(monkeypatch, nproc=3, pid=0)
+    monkeypatch.setenv("KSIM_DCN_RECOVER", "1")
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "0.5")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.01")
+    now = time.time()
+    for q in (1, 2):
+        kv.store[f"{dcn.HB_PREFIX}/{q}"] = json.dumps(
+            {"pid": q, "chunk": 0, "t": now - 10.0}
+        )
+    calls = []
+    t0 = time.monotonic()
+    ok = dcn._maybe_recover(
+        kv, "ksim/gather/1", 1, "whatif",
+        recover=lambda p, gen=0: (calls.append((p, gen)), {"x": 1})[1],
+    )
+    assert ok is True and calls == [(1, 0)]
+    assert time.monotonic() - t0 < 0.4, "grace window should collapse"
+    assert dcn.read_claim(1, 0)["claimant"] == 0
+
+
+# -- heartbeat through injected faults --------------------------------------
+
+
+def test_heartbeat_survives_transient_kv_errors(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_SEED", "2")
+    monkeypatch.setenv("KSIM_FAULTLINE_KV_ERROR_RATE", "0.3")
+    monkeypatch.setenv("KSIM_DCN_RETRY_BASE_S", "0.001")
+    raw = _FakeKV()
+    monkeypatch.setattr(dcn, "process_info", lambda: (2, 1))
+    monkeypatch.setattr(dcn, "_client", lambda: faultline.wrap_kv(raw))
+    monkeypatch.setattr(dcn, "_degraded_exit_armed", [True])
+    oks = [dcn.heartbeat(i, total=64, state="run") for i in range(64)]
+    # With 2 bounded attempts at 30% error rate most beats land; a beat
+    # that exhausts its budget returns False instead of raising.
+    assert sum(oks) > 32
+    assert f"{dcn.HB_PREFIX}/1" in raw.store
+
+
+# -- config validation -------------------------------------------------------
+
+
+def _cfg(yaml_text, tmp_path):
+    from kubernetes_simulator_tpu.utils.config import SimConfig
+
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml_text)
+    return SimConfig.load(str(p))
+
+
+_BASE = """
+strategy: jax
+cluster: {synthetic: {nodes: 4, seed: 1}}
+workload: {synthetic: {pods: 8, seed: 1}}
+whatIf: {scenarios: 2, seed: 1}
+"""
+
+
+def test_validate_refuses_bad_faultline(tmp_path):
+    from kubernetes_simulator_tpu.cli import validate_config
+
+    cfg = _cfg(
+        _BASE
+        + "faultline: {enabled: true, seed: -1, kvErrorRate: 1.5,\n"
+        + "  kvDelayS: -0.5, kill: 'zz@run'}\n",
+        tmp_path,
+    )
+    errors = "\n".join(validate_config(cfg))
+    assert "faultline.seed" in errors
+    assert "faultline.kvErrorRate" in errors
+    assert "faultline.kvDelayS" in errors
+    assert "faultline.kill" in errors
+
+
+def test_validate_warns_injection_without_recovery(tmp_path, caplog):
+    from kubernetes_simulator_tpu.cli import validate_config
+
+    cfg = _cfg(
+        _BASE + "faultline: {enabled: true, seed: 1, kvErrorRate: 0.1}\n",
+        tmp_path,
+    )
+    with caplog.at_level(logging.WARNING):
+        errors = validate_config(cfg)
+    assert not [e for e in errors if "faultline" in e]
+    assert any("dcn.recovery disabled" in r.message for r in caplog.records)
+
+
+def test_validate_accepts_example_config16():
+    from kubernetes_simulator_tpu.cli import validate_config
+    from kubernetes_simulator_tpu.utils.config import SimConfig
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "config16_faultline.yaml"
+    )
+    cfg = SimConfig.load(path)
+    assert cfg.faultline is not None and cfg.faultline.enabled
+    assert cfg.faultline.seed == 17
+    assert cfg.faultline.kill == "1@run:0"
+    errors = [e for e in validate_config(cfg) if "faultline" in e]
+    assert errors == []
+
+
+def test_faultline_section_absent_is_silent(tmp_path):
+    from kubernetes_simulator_tpu.cli import _faultline_errors
+
+    cfg = _cfg(_BASE, tmp_path)
+    assert cfg.faultline is None
+    assert _faultline_errors(cfg) == []

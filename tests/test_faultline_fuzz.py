@@ -1,0 +1,76 @@
+"""Round-17 faultline fuzz slice (slow): drive scripts/faultline_fuzz.py's
+seeded crash schedules — always including the double-kill and the
+recovering-claimant-kill — against live 3-worker fleets and pin the
+acceptance bar: every surviving worker's end gather is BYTE-IDENTICAL to
+the no-failure single-process oracle, named kills die with SIGKILL, at
+least one worker survives every schedule, and a fired wildcard kill
+leaves the claim-generation hand-off in the logs.
+
+The schedules are a pure function of the seed, so a red run here
+reproduces exactly with ``python scripts/faultline_fuzz.py --seed 17``.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "scripts")
+    ),
+)
+
+import faultline_fuzz as F  # noqa: E402
+
+SEED = 17
+N_SCHEDULES = 5
+
+
+def test_mandatory_schedules_always_sampled():
+    """Fast sanity (no fleet): the sampler always leads with the
+    double-kill and claimant-kill drills, schedules are deterministic in
+    the seed, and sampled kills never name the coordinator."""
+    scheds = F.sample_schedules(SEED, N_SCHEDULES)
+    assert len(scheds) == N_SCHEDULES
+    assert scheds[0]["name"] == "double-kill"
+    assert scheds[0]["kill"] == "1@run:0,2@run:0"
+    assert scheds[1]["name"] == "claimant-kill"
+    assert "*@recover" in scheds[1]["kill"]
+    assert scheds == F.sample_schedules(SEED, N_SCHEDULES)
+    assert scheds != F.sample_schedules(SEED + 1, N_SCHEDULES)
+    for sch in scheds:
+        named, _ = F.named_kill_pids(sch)
+        assert 0 not in named, (
+            "the fuzzer must not kill the coordination-service host"
+        )
+
+
+@pytest.mark.slow
+def test_fuzz_schedules_byte_identical_to_oracle(tmp_path):
+    oracle = F.run_oracle()
+    scheds = F.sample_schedules(SEED, N_SCHEDULES)
+    failures = []
+    for i, sched in enumerate(scheds):
+        hb = tmp_path / f"hb{i}"
+        hb.mkdir()
+        out = F.run_schedule(sched, str(hb), timeout_s=600.0)
+        if out["skip"]:
+            pytest.skip("jaxlib CPU backend lacks multiprocess execution")
+        failures.extend(F.check_schedule(sched, out, oracle))
+        if sched["name"] == "double-kill":
+            # Both named victims actually died concurrently and the
+            # coordinator absorbed BOTH blocks.
+            assert out["rcs"][1] == -9 and out["rcs"][2] == -9, out["rcs"]
+            assert "claims dead process 1" in out["blob"]
+            assert "claims dead process 2" in out["blob"]
+        if sched["name"] == "claimant-kill":
+            # The wildcard entry fired on the gen-0 claimant (worker 1 —
+            # the coordinator defers claims while a live sibling can
+            # absorb the block) and the survivor opened generation 1.
+            killed = sorted(p for p, rc in out["rcs"].items() if rc == -9)
+            assert killed == [1, 2], out["rcs"]
+            assert "opening generation 1" in out["blob"], out["blob"][-2000:]
+            assert "(gen 1)" in out["blob"], out["blob"][-2000:]
+    assert not failures, "\n".join(failures)
