@@ -358,6 +358,39 @@ def main():
             }
         }
 
+    # Work-queue accounting (round 18) — informational detail only
+    # (bench_compare.py never gates on it). Populated when the timed
+    # runs above actually drained the work-stealing queue (bench under
+    # dcn_launch.py with KSIM_DCN_WORKQUEUE=1): this process's lease/
+    # steal/speculation counters, the lease-renewal overhead as a share
+    # of the headline median wall, and the lower-bound straggler wall
+    # saved by speculative wins.
+    wq_block = {}
+    if dcn.wq_enabled():
+        ws = dcn.wq_stats()
+        renew_pct = None
+        if nproc > 1 and med_wall > 0:
+            renew_pct = round(100.0 * ws["renew_wall_s"] / med_wall, 2)
+        wq_block = {
+            "work_queue": {
+                "block_size": dcn.wq_block_size() or None,
+                "speculate": dcn.speculate_enabled(),
+                "leases": ws["leases"],
+                "steals": ws["steals"],
+                "blocks_executed": ws["blocks_executed"],
+                "spec_attempts": ws["spec_attempts"],
+                "spec_wins": ws["spec_wins"],
+                "spec_losses": ws["spec_losses"],
+                "spec_wasted_chunks": ws["spec_wasted_chunks"],
+                "dup_discards": ws["dup_discards"],
+                "lease_renewals": ws["renewals"],
+                "lease_renew_overhead_pct": renew_pct,
+                "straggler_wall_saved_s": round(
+                    ws["straggler_wall_saved_s"], 3
+                ),
+            }
+        }
+
     scaling = {}
     if mesh is not None and nproc == 1:
         runs_ref = max(1, int(os.environ.get("BENCH_REF_RUNS", 2)))
@@ -689,6 +722,7 @@ def main():
                     **dcn_block,
                     **rec_block,
                     **fault_block,
+                    **wq_block,
                     **scaling,
                     **cont,
                     **tune_sweep,

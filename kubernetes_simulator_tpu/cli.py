@@ -302,6 +302,60 @@ def _recovery_errors(cfg) -> list:
     return errors
 
 
+def _workqueue_errors(cfg) -> list:
+    """Actionable refusals for the ``dcn.workQueue`` section (round 18).
+    Shared by validate_config and the pre-dispatch env export in main():
+    the queue outside a DCN fleet, speculation without the checkpoints
+    it resumes from, or a nonsensical block size must fail with a
+    message naming the fix — not silently no-op."""
+    wq = getattr(cfg, "dcn_workqueue", None)
+    if wq is None:
+        return []
+    errors = []
+    if wq.block_size < 0:
+        errors.append(
+            "dcn.workQueue.blockSize: must be >= 0 scenarios per block "
+            "(0 = auto: one block per worker, reproducing the static "
+            "partition when nobody steals)"
+        )
+    if wq.straggler_s < 0:
+        errors.append(
+            "dcn.workQueue.stragglerS: must be >= 0 seconds (0 = auto: "
+            "half the KSIM_DCN_STALL_S lease-expiry window)"
+        )
+    if not wq.enable:
+        if wq.speculate or wq.block_size or wq.straggler_s:
+            log.warning(
+                "dcn.workQueue: speculate/blockSize/stragglerS set but "
+                "enable is false — the work queue stays off"
+            )
+        return errors
+    if int(os.environ.get("KSIM_DCN_NPROC", "1") or 1) <= 1:
+        errors.append(
+            "dcn.workQueue.enable: the work-stealing queue needs a "
+            "multi-process DCN fleet — launch through "
+            "scripts/dcn_launch.py; KSIM_DCN_NPROC is unset/1, so there "
+            "is nobody to lease blocks from the queue"
+        )
+    if dcn.heartbeat_every() == 0:
+        errors.append(
+            "dcn.workQueue.enable: the queue needs liveness heartbeats — "
+            "remove KSIM_DCN_HEARTBEAT_EVERY=0 (lease renewals ride the "
+            "heartbeat cadence; without them every lease looks expired)"
+        )
+    if wq.speculate:
+        rec = getattr(cfg, "dcn_recovery", None)
+        if rec is None or rec.checkpoint_every < 1:
+            errors.append(
+                "dcn.workQueue.speculate: speculative re-execution "
+                "resumes from the straggler's newest published "
+                "checkpoint — set dcn.recovery.checkpointEvery >= 1 "
+                "(without checkpoints a backup re-executes the whole "
+                "block and rarely beats the straggler)"
+            )
+    return errors
+
+
 def _faultline_errors(cfg) -> list:
     """Actionable refusals for the ``faultline:`` section (round 17).
     Shared by validate_config and the pre-dispatch env export in main().
@@ -339,6 +393,13 @@ def _faultline_errors(cfg) -> list:
             _faultline.parse_kill_schedule(str(fl.kill))
         except ValueError as e:
             errors.append(f"faultline.kill: {e}")
+    if getattr(fl, "slow", None):
+        from .parallel import faultline as _faultline
+
+        try:
+            _faultline.parse_slow_schedule(str(fl.slow))
+        except ValueError as e:
+            errors.append(f"faultline.slow: {e}")
     if not fl.enabled:
         return errors
     rec = getattr(cfg, "dcn_recovery", None)
@@ -605,6 +666,7 @@ def validate_config(cfg) -> list:
                 "pagedWaves: true)"
             )
     errors.extend(_recovery_errors(cfg))
+    errors.extend(_workqueue_errors(cfg))
     errors.extend(_faultline_errors(cfg))
     return errors
 
@@ -673,6 +735,32 @@ def main(argv=None) -> int:
             os.environ.setdefault(
                 "KSIM_DCN_MAX_CLAIMS", str(rec.max_claims)
             )
+        # Work-queue knobs (round 18, dcn.workQueue:) must also land
+        # before bring-up — mesh.init_distributed widens the runtime
+        # failure detector when the queue is on (a straggler must not be
+        # declared dead while a backup races it).
+        wq = (
+            getattr(cfg_pre, "dcn_workqueue", None)
+            if cfg_pre is not None
+            else None
+        )
+        if wq is not None and wq.enable:
+            errors = _workqueue_errors(cfg_pre)
+            if errors:
+                for e in errors:
+                    log.error("config: %s", e)
+                return 2
+            os.environ.setdefault("KSIM_DCN_WORKQUEUE", "1")
+            if wq.block_size:
+                os.environ.setdefault(
+                    "KSIM_DCN_WQ_BLOCK", str(wq.block_size)
+                )
+            if wq.speculate:
+                os.environ.setdefault("KSIM_DCN_SPECULATE", "1")
+            if wq.straggler_s:
+                os.environ.setdefault(
+                    "KSIM_DCN_STRAGGLER_S", str(wq.straggler_s)
+                )
         # Faultline injection knobs (round 17, faultline:) ride the same
         # pre-dispatch export — the KV-client wrapper reads KSIM_FAULTLINE_*
         # lazily, but a consistent fleet wants them pinned before any
@@ -697,6 +785,8 @@ def main(argv=None) -> int:
                     os.environ.setdefault(env, str(val))
             if fl.kill:
                 os.environ.setdefault("KSIM_FAULTLINE_KILL", str(fl.kill))
+            if getattr(fl, "slow", None):
+                os.environ.setdefault("KSIM_FAULTLINE_SLOW", str(fl.slow))
     # Multi-host DCN bring-up (round 11): a no-op without the
     # KSIM_DCN_* env set by scripts/dcn_launch.py. Enables the compile
     # cache BEFORE jax.distributed.initialize (documented ordering).

@@ -408,6 +408,14 @@ def heartbeat(
         return False
     if nproc <= 1:
         return False
+    from . import faultline
+
+    # Deterministic straggler injection (round 18): a KSIM_FAULTLINE_SLOW
+    # entry for this pid sleeps BEFORE the beacon/renewal publish, so the
+    # previous beat (and the work-queue lease renewal) ages on the wire
+    # exactly as a genuinely slow chunk would make it — straggler tests
+    # need no wall-clock races.
+    faultline.maybe_slow(int(chunk), str(state))
     beat: dict = {
         "pid": int(pid),
         "chunk": int(chunk),
@@ -430,9 +438,10 @@ def heartbeat(
         pass
     if extra:
         beat.update(extra)
+    if _ACTIVE_LEASE[0] is not None:
+        beat.setdefault("leased_blocks", 1)
+        beat.setdefault("wq_block", int(_ACTIVE_LEASE[0].get("bid", -1)))
     blob = json.dumps(beat, sort_keys=True)
-    from . import faultline
-
     hb_dir = os.environ.get("KSIM_DCN_HB_DIR")
     if hb_dir:
         # File mirror for monitors OUTSIDE the fleet (dcn_launch --watch):
@@ -461,6 +470,37 @@ def heartbeat(
         )
     except Exception:
         ok = False
+    # Work-queue lease renewal (round 18): while this process executes a
+    # leased scenario block, every beat also overwrites the block's renew
+    # key — generation-stamped, so the queue driver measures the LEASE's
+    # freshness (distinct from the beacon: an idle process beats without
+    # holding anything). Best-effort like the beacon itself.
+    if _ACTIVE_LEASE[0] is not None:
+        lease = _ACTIVE_LEASE[0]
+        t0 = time.perf_counter()
+        renew = json.dumps(
+            {
+                "pid": int(pid),
+                "gen": int(lease.get("gen", 0)),
+                "block": int(lease.get("bid", -1)),
+                "chunk": int(chunk),
+                "t": time.time(),
+            },
+            sort_keys=True,
+        )
+        try:
+            kv_retry(
+                lambda: _client().key_value_set(
+                    lease["key"], renew, allow_overwrite=True
+                ),
+                op="wq_renew",
+                key=lease["key"],
+                attempts=2,
+            )
+            WQ_STATS["renewals"] += 1
+        except Exception:
+            pass
+        WQ_STATS["renew_wall_s"] += time.perf_counter() - t0
     # Kill schedules fire on the heartbeat cursor whether or not the
     # publish landed — a deterministic schedule must not drift because a
     # transient KV error ate one beat.
@@ -614,11 +654,29 @@ def _unframe_chunk(framed: str) -> str:
     return data
 
 
+# In-process subscribers to fleet events (round 18): the flight recorder
+# registers a callback here so lease/steal/speculation/claim events land
+# in its JSONL stream alongside the chunk rows. Callbacks receive the
+# event dict WITHOUT the wall-clock stamp (the recorder scrubs time
+# itself); a raising sink is dropped — events must never kill a replay.
+EVENT_SINKS: list = []
+
+
 def _mirror_event(event: dict) -> None:
-    """Append one claim/recovery event line to ``$KSIM_DCN_HB_DIR/
-    events.jsonl`` so out-of-fleet monitors (dcn_launch --watch) can
-    surface a rebalance live. Best-effort; single ``write`` of one line
-    keeps concurrent appenders from tearing each other."""
+    """Append one claim/recovery/work-queue event line to
+    ``$KSIM_DCN_HB_DIR/events.jsonl`` so out-of-fleet monitors
+    (dcn_launch --watch) can surface a rebalance live, and forward it to
+    the in-process :data:`EVENT_SINKS` (flight recorder). Best-effort;
+    single ``write`` of one line keeps concurrent appenders from tearing
+    each other."""
+    for sink in list(EVENT_SINKS):
+        try:
+            sink(dict(event))
+        except Exception:
+            try:
+                EVENT_SINKS.remove(sink)
+            except ValueError:
+                pass
     hb_dir = os.environ.get("KSIM_DCN_HB_DIR")
     if not hb_dir:
         return
@@ -938,14 +996,18 @@ def _describe_process(p: int, hb: Dict[int, dict], now: float) -> str:
     return ", ".join(parts)
 
 
-def _publish_for(c, prefix: str, pid: int, payload) -> None:
+def _publish_for(c, prefix: str, pid: int, payload, tolerant=None) -> None:
     """Publish a gather payload under ``pid``'s keys (used by a claimant
     standing in for a dead sibling, and by :func:`gather` itself). When
     recovery is enabled an already-existing key is tolerated: a presumed-
     dead straggler that publishes after its block was absorbed collides
-    with the claimant's byte-identical publication — first writer wins."""
+    with the claimant's byte-identical publication — first writer wins.
+    The work-queue result publication (round 18) forces ``tolerant=True``:
+    a transient error on a write-once key is ambiguous (the set may have
+    landed), and duplicate block payloads are byte-identical anyway."""
     chunks = _encode_payload(payload)
-    tolerant = recover_enabled()
+    if tolerant is None:
+        tolerant = recover_enabled()
     try:
         for j, ch in enumerate(chunks):
             kv_retry(
@@ -1170,6 +1232,558 @@ def gather(name: str, payload, recover=None) -> list:
             )
         )
     return out
+
+
+# -- work-stealing scenario-block queue (round 18) ---------------------------
+#
+# The static "process p owns block p forever" slicing becomes a KV-backed
+# WORK QUEUE over contiguous scenario blocks: processes lease blocks via
+# the claim-CAS idiom (generation-stamped, renewed on the heartbeat
+# cadence), publish per-block results keyed by BLOCK id instead of pid,
+# and every process assembles the end result from whichever process
+# completed each block — byte-identical to the static-slicing oracle for
+# any interleaving, because block execution is deterministic given the
+# block bounds and the full-list engine gates.
+#
+# On top of the queue:
+#   * straggler mitigation — when a lease's renewal goes stale past
+#     KSIM_DCN_STRAGGLER_S (or the holder falls under the fleet's
+#     progress-rate watermark), an idle process wins a one-shot
+#     speculator election and re-executes the block from the holder's
+#     newest published checkpoint; first-complete-wins via CAS on the
+#     block's done key, duplicates discarded deterministically.
+#   * lease expiry — past KSIM_DCN_STALL_S the holder is presumed dead
+#     and the lease is STOLEN (next generation), same stall window as
+#     the round-15 claim protocol. Lease expiry implies a process may
+#     never reach the collective shutdown barrier, so any steal or
+#     speculative win arms the degraded exit fleet-wide.
+#   * true elastic join — a process whose contribution starts mid-replay
+#     (KSIM_DCN_JOIN_DELAY_S, set by scripts/dcn_launch.py --join) leases
+#     whatever blocks are still pending instead of being restricted to
+#     claiming dead siblings' work. (The jax.distributed runtime barriers
+#     until every process CONNECTS, so joiners connect at launch and
+#     defer their contribution — see scripts/dcn_launch.py.)
+#
+# Everything is off by default (KSIM_DCN_WORKQUEUE / dcn.workQueue YAML);
+# wq_run bumps the gather sequence exactly once per replay, so the
+# "one gather per replay" GATHER_COUNT contract is unchanged.
+
+WQ_PREFIX = "ksim/wq"
+
+# Cumulative work-queue accounting for THIS process. leases/steals/
+# spec_* count protocol outcomes; dup_discards are executions that lost
+# the done-CAS (byte-identical duplicates, dropped); renew_wall_s is the
+# lease-renewal overhead riding the heartbeat cadence;
+# straggler_wall_saved_s is a lower-bound estimate per speculative win
+# (the residual wait before lease expiry would even have fired).
+WQ_STATS = {
+    "leases": 0,
+    "steals": 0,
+    "spec_attempts": 0,
+    "spec_wins": 0,
+    "spec_losses": 0,
+    "blocks_executed": 0,
+    "dup_discards": 0,
+    "renewals": 0,
+    "renew_wall_s": 0.0,
+    "spec_wasted_chunks": 0,
+    "straggler_wall_saved_s": 0.0,
+}
+
+# The lease this process is currently executing (set by wq_run around the
+# execute callback): {"key": renew key, "bid", "gen"}. heartbeat() renews
+# it on every beat and stamps the beacon with leased_blocks/wq_block.
+_ACTIVE_LEASE: list = [None]
+
+# Chunks executed by the most recent block engine (set via
+# note_block_chunks by sim.whatif) — the driver charges them to
+# spec_wasted_chunks when a speculative execution loses the done-CAS.
+_LAST_EXEC_CHUNKS = [0]
+
+
+def wq_stats() -> dict:
+    """Snapshot of :data:`WQ_STATS` (copy — callers diff it)."""
+    return dict(WQ_STATS)
+
+
+def note_block_chunks(n: int) -> None:
+    """Record how many chunks the last block execution actually ran
+    (resumed executions count only the chunks after the checkpoint)."""
+    _LAST_EXEC_CHUNKS[0] = max(int(n), 0)
+
+
+def wq_enabled() -> bool:
+    """Work-stealing scenario-block queue (``KSIM_DCN_WORKQUEUE``;
+    default off — static per-process slicing stays the default)."""
+    return str(
+        os.environ.get("KSIM_DCN_WORKQUEUE", "0")
+    ).strip().lower() in ("1", "true", "yes", "on")
+
+
+def wq_block_size() -> int:
+    """Scenarios per queue block (``KSIM_DCN_WQ_BLOCK``; 0 = auto:
+    ``n_global // worker_count()`` — one block per worker, reproducing
+    the static partition exactly when nobody steals)."""
+    try:
+        return max(int(os.environ.get("KSIM_DCN_WQ_BLOCK", "0")), 0)
+    except ValueError:
+        return 0
+
+
+def speculate_enabled() -> bool:
+    """Speculative re-execution of straggling blocks
+    (``KSIM_DCN_SPECULATE``; default off). Requires checkpoint
+    publication (``KSIM_DCN_CKPT_EVERY``) to be useful — the speculator
+    resumes from the holder's newest published checkpoint."""
+    return str(
+        os.environ.get("KSIM_DCN_SPECULATE", "0")
+    ).strip().lower() in ("1", "true", "yes", "on")
+
+
+def straggler_s() -> float:
+    """Lease-renewal age past which a LIVE holder counts as a straggler
+    and becomes speculation-eligible (``KSIM_DCN_STRAGGLER_S``; default
+    half the stall window). Distinct from lease EXPIRY at
+    ``KSIM_DCN_STALL_S`` — expiry presumes death and steals the lease;
+    straggling only races a backup execution against the holder."""
+    try:
+        v = float(os.environ.get("KSIM_DCN_STRAGGLER_S", "0") or 0.0)
+    except ValueError:
+        v = 0.0
+    return v if v > 0 else _stall_s() / 2.0
+
+
+def join_delay_s() -> float:
+    """Seconds this process defers its work-queue contribution
+    (``KSIM_DCN_JOIN_DELAY_S``, set per-joiner by scripts/dcn_launch.py
+    --join). The coordination CONNECT happened at launch (the runtime
+    barriers on it); the queue entry is what joins mid-replay."""
+    try:
+        return max(float(os.environ.get("KSIM_DCN_JOIN_DELAY_S", "0") or 0), 0.0)
+    except ValueError:
+        return 0.0
+
+
+def wq_blocks(n_global: int) -> list:
+    """Partition a length-``n_global`` scenario axis into contiguous
+    ``(lo, hi)`` queue blocks of :func:`wq_block_size` scenarios (the
+    last block may be smaller — uneven sizes are legal; concatenating
+    block results in block order always reproduces global order)."""
+    n = int(n_global)
+    per = wq_block_size() or max(n // worker_count(), 1)
+    return [(lo, min(lo + per, n)) for lo in range(0, n, per)]
+
+
+def wq_ckpt_epoch(seq: int, bid: int) -> int:
+    """Checkpoint namespace for work-queue block ``bid`` of gather
+    ``seq``: always negative, so block checkpoints never collide with the
+    static path's positive epochs — and distinct per block, so a
+    speculator resuming block b never picks up the holder's checkpoint
+    for a DIFFERENT block at a higher cursor."""
+    return -(int(seq) * 100_000 + int(bid) + 1)
+
+
+def _wq_read_json(c, key: str, timeout_ms: int = 2000):
+    """Non-fatal JSON read of one queue key (None when absent/bad)."""
+    try:
+        return json.loads(c.blocking_key_value_get(key, int(timeout_ms)))
+    except Exception:
+        return None
+
+
+def _wq_cas(c, key: str, meta: dict):
+    """Write-once CAS with transient-ambiguity read-back (the try_claim
+    pattern): returns the WINNING value — ``meta`` itself when our set
+    landed, the existing value on a loss, None when the key is
+    unreadable (callers treat that as a loss and re-poll)."""
+    blob = json.dumps(meta, sort_keys=True)
+    try:
+        kv_retry(lambda: c.key_value_set(key, blob), op="wq_cas", key=key)
+        return meta
+    except Exception:
+        pass
+    return _wq_read_json(c, key)
+
+
+def wq_run(name: str, blocks: list, execute) -> list:
+    """THE work-queue driver: lease, execute and publish scenario blocks
+    until every block has a winner, then assemble the per-block payloads
+    in block order. Every process runs this (workers, spares, joiners)
+    and every process returns the same list. Counts as this replay's ONE
+    gather (bumps the sequence and GATHER_COUNT exactly once).
+
+    ``execute(bid, lo, hi, resume_pid, gen, speculative, queue_depth)``
+    runs block ``bid`` deterministically and returns its payload;
+    ``resume_pid >= 0`` asks it to resume from that pid's newest
+    published checkpoint for this block's epoch (steals and speculative
+    re-executions), ``-1`` executes from chunk 0."""
+    global GATHER_COUNT, _seq
+    nproc, pid = process_info()
+    _seq += 1
+    GATHER_COUNT += 1
+    c = _client()
+    nb = len(blocks)
+    prefix = f"{WQ_PREFIX}/{_seq}/{name}"
+    hb_on = heartbeat_every() > 0
+    spec_on = speculate_enabled()
+    stall = _stall_s()
+    strag = straggler_s()
+    poll = _poll_s()
+    gen_cap = max_claims()
+    deadline = time.monotonic() + _timeout_ms() / 1000.0
+    local: Dict[int, object] = {}  # bid -> payload computed HERE
+    done: Dict[int, dict] = {}  # bid -> winning done meta
+    spec_tried: set = set()  # (bid, gen) speculator elections entered
+    spec_deferred: set = set()  # leader's one-sweep election deferrals
+
+    def _lease_key(bid: int, gen: int) -> str:
+        return f"{prefix}/lease/{int(bid)}/{int(gen)}"
+
+    def _renew_key(bid: int) -> str:
+        return f"{prefix}/renew/{int(bid)}"
+
+    def _done_key(bid: int) -> str:
+        return f"{prefix}/done/{int(bid)}"
+
+    def _read_dir(sub: str) -> dict:
+        """All keys under ``<prefix>/<sub>`` as {tail-path: parsed JSON}.
+        One non-blocking dir RPC — a blocking get on an ABSENT key would
+        wait out its whole timeout, which the poll sweeps can't afford."""
+        try:
+            entries = kv_retry(
+                lambda: c.key_value_dir_get(f"{prefix}/{sub}"),
+                op="wq_dir",
+                key=f"{prefix}/{sub}",
+                attempts=2,
+            )
+        except Exception:
+            return {}
+        out = {}
+        for key, val in entries:
+            tail = str(key).split(f"/{sub}/", 1)[-1]
+            try:
+                out[tail] = json.loads(val)
+            except (ValueError, TypeError):
+                continue
+        return out
+
+    def _note_done(bid: int, meta: dict) -> None:
+        done[bid] = meta
+        # A stolen or speculated block means some process may never reach
+        # the collective shutdown barrier (a dead holder can't; a live
+        # straggler may be unboundedly late) — EVERY process that learns
+        # of it skips the barrier at exit, so nobody hangs on it.
+        if meta.get("spec") or int(meta.get("gen", 0) or 0) > 0:
+            _arm_degraded_exit()
+
+    def _run_block(bid, gen, resume_pid, speculative, renew_age=0.0):
+        from ..utils.metrics import log
+
+        lo, hi = blocks[bid]
+        qd = nb - len(done)
+        kind = (
+            "speculate" if speculative else ("steal" if gen else "lease")
+        )
+        verb = {
+            "lease": "leases", "steal": "steals", "speculate": "speculates",
+        }[kind]
+        log.info(
+            "dcn wq: process %d %s block %d [%d, %d) gen %d%s",
+            pid, verb, bid, lo, hi, gen,
+            f" (resuming from pid {resume_pid})" if resume_pid >= 0 else "",
+        )
+        _mirror_event(
+            {"event": kind, "pid": int(pid), "block": int(bid),
+             "gen": int(gen), "from": int(resume_pid)}
+        )
+        _ACTIVE_LEASE[0] = {
+            "key": _renew_key(bid), "bid": int(bid), "gen": int(gen),
+        }
+        t0 = time.monotonic()
+        try:
+            payload = execute(bid, lo, hi, resume_pid, gen, speculative, qd)
+        finally:
+            _ACTIVE_LEASE[0] = None
+        local[bid] = payload
+        _publish_for(
+            c, f"{prefix}/result/{bid}", pid, payload, tolerant=True
+        )
+        win = _wq_cas(
+            c, _done_key(bid),
+            {"pid": int(pid), "gen": int(gen), "spec": bool(speculative),
+             "t": time.time()},
+        )
+        won = win is not None and int(win.get("pid", -1)) == int(pid)
+        if won:
+            WQ_STATS["blocks_executed"] += 1
+            if speculative:
+                WQ_STATS["spec_wins"] += 1
+                # Lower-bound wall saved: the residual wait before lease
+                # EXPIRY would even have let anyone steal the block.
+                WQ_STATS["straggler_wall_saved_s"] += max(
+                    stall - float(renew_age), 0.0
+                )
+            _mirror_event(
+                {"event": "block_done", "pid": int(pid), "block": int(bid),
+                 "gen": int(gen), "spec": bool(speculative),
+                 "wall_s": round(time.monotonic() - t0, 3)}
+            )
+        else:
+            WQ_STATS["dup_discards"] += 1
+            if speculative:
+                WQ_STATS["spec_losses"] += 1
+                WQ_STATS["spec_wasted_chunks"] += _LAST_EXEC_CHUNKS[0]
+            log.info(
+                "dcn wq: process %d's %s of block %d lost the "
+                "first-complete-wins CAS to process %s — duplicate "
+                "discarded (byte-identical by construction)",
+                pid, kind, bid, None if win is None else win.get("pid"),
+            )
+            _mirror_event(
+                {"event": "spec_lost" if speculative else "dup_discard",
+                 "pid": int(pid), "block": int(bid), "gen": int(gen)}
+            )
+        if win is not None:
+            _note_done(bid, win)
+
+    def _try_lease(bid: int, gen: int) -> bool:
+        win = _wq_cas(
+            c, _lease_key(bid, gen),
+            {"pid": int(pid), "gen": int(gen), "t": time.time()},
+        )
+        return win is not None and int(win.get("pid", -1)) == int(pid)
+
+    # Mid-replay joiner (dcn_launch --join): the coordination connect
+    # happened at process start; the CONTRIBUTION is deferred here. While
+    # asleep the fleet sees a live "join" beacon, never a stale one.
+    delay = join_delay_s()
+    if delay > 0:
+        if hb_on:
+            heartbeat(
+                -1, state="join",
+                extra={"leased_blocks": 0, "queue_depth": nb,
+                       "join_delay_s": delay},
+            )
+        time.sleep(delay)
+        _mirror_event({"event": "join", "pid": int(pid)})
+
+    # Phase A — primary drain: generation-0 leases, iteration order
+    # rotated so process p starts at block p (mod nb). With the auto
+    # block size (one block per worker) and no contention this
+    # reproduces the static partition exactly.
+    for k in range(nb):
+        bid = (pid + k) % nb
+        if bid in done or time.monotonic() > deadline:
+            continue
+        dones = _read_dir("done")
+        if str(bid) in dones:
+            _note_done(bid, dones[str(bid)])
+            continue
+        if _try_lease(bid, 0):
+            WQ_STATS["leases"] += 1
+            _run_block(bid, 0, -1, False)
+
+    # Phase B — wait for the remaining blocks; steal expired leases, lease
+    # late-appearing pending blocks, and speculate on stragglers.
+    while len(done) < nb:
+        if time.monotonic() > deadline:
+            hb = read_heartbeats()
+            missing = sorted(b for b in range(nb) if b not in done)
+            raise DcnGatherTimeout(
+                f"wq_run({name!r}): timed out after "
+                f"KSIM_DCN_TIMEOUT_S={_timeout_ms() / 1000:g}s with blocks "
+                f"{missing} still unfinished. "
+                + "; ".join(
+                    _describe_process(q, hb, time.time())
+                    for q in sorted(hb)
+                ),
+                missing=missing,
+                heartbeats=hb,
+            )
+        progressed = False
+        beats = read_heartbeats()
+        now = time.time()
+        # Fleet progress-rate watermark (the round-8 live-buffer gauge's
+        # companion): the fastest chunk rate any lease-holder reports.
+        rates = [
+            float(b.get("wq_rate", 0.0))
+            for b in beats.values()
+            if b.get("wq_rate") and now - float(b.get("t", 0.0)) <= stall
+        ]
+        watermark = max(rates) if rates else 0.0
+        dones = _read_dir("done")
+        for bid, meta in (
+            (int(k), v) for k, v in dones.items() if k.isdigit()
+        ):
+            if bid not in done:
+                _note_done(bid, meta)
+                progressed = True
+        lease_dir = _read_dir("lease")  # "<bid>/<gen>" -> meta
+        renews = _read_dir("renew")  # "<bid>" -> meta
+        newest: Dict[int, tuple] = {}
+        for tail, meta in lease_dir.items():
+            parts_k = tail.split("/")
+            if len(parts_k) != 2:
+                continue
+            try:
+                b, g = int(parts_k[0]), int(parts_k[1])
+            except ValueError:
+                continue
+            if b not in newest or g > newest[b][0]:
+                newest[b] = (g, meta)
+        for bid in range(nb):
+            if bid in done:
+                continue
+            gen, lease = newest.get(bid, (-1, None))
+            if lease is None:
+                # Never leased — pending work (the elastic-join case, or
+                # a fleet with more blocks than processes racing here).
+                if _try_lease(bid, 0):
+                    WQ_STATS["leases"] += 1
+                    _run_block(bid, 0, -1, False)
+                    progressed = True
+                continue
+            holder = int(lease.get("pid", -1))
+            if holder == pid:
+                continue  # ambiguity artifact: our own lease, re-poll
+            renew = renews.get(str(bid))
+            if renew is not None and int(renew.get("gen", -1)) == gen:
+                age = now - float(renew.get("t", now))
+                holder_chunk = int(renew.get("chunk", -1))
+            else:
+                age = now - float(lease.get("t", now))
+                holder_chunk = -1
+            hb_holder = beats.get(holder)
+            holder_rate = (
+                float(hb_holder.get("wq_rate", 0.0)) if hb_holder else 0.0
+            )
+            lagging = (
+                watermark > 0.0
+                and holder_rate > 0.0
+                and holder_rate < 0.25 * watermark
+            )
+            if (
+                spec_on
+                and (bid, gen) not in spec_tried
+                and holder_chunk >= 0  # first-chunk compile is exempt
+                and (age > strag or lagging)
+            ):
+                if pid == 0 and (bid, gen) not in spec_deferred:
+                    # The leader hosts the coordination service — its
+                    # death is unsurvivable by construction, so give
+                    # sibling idle processes one poll's head start at
+                    # the election and take the risky role only when
+                    # nobody else picked it up.
+                    spec_deferred.add((bid, gen))
+                    continue
+                # Straggler: one-shot speculator election per (block,
+                # generation) — exactly one idle process re-executes.
+                # Checked BEFORE lease expiry: a speculative win
+                # completes the block without burning one of the
+                # gen_cap-bounded lease generations, so an untried
+                # election always gets the first shot — steal is the
+                # fallback once it is spent (or the holder never
+                # renewed at this generation).
+                spec_tried.add((bid, gen))
+                win = _wq_cas(
+                    c, f"{prefix}/spec/{bid}/{gen}",
+                    {"pid": int(pid), "t": now},
+                )
+                if win is not None and int(win.get("pid", -1)) == pid:
+                    WQ_STATS["spec_attempts"] += 1
+                    _run_block(bid, gen, holder, True, renew_age=age)
+                    progressed = True
+                continue
+            if age > stall and gen < gen_cap:
+                # Lease EXPIRED — the holder is presumed dead (same stall
+                # window as the round-15 claim protocol). Steal it: open
+                # the next generation and resume from the holder's newest
+                # published checkpoint for this block.
+                if _try_lease(bid, gen + 1):
+                    WQ_STATS["steals"] += 1
+                    DEGRADED.add(holder)
+                    _arm_degraded_exit()
+                    _run_block(bid, gen + 1, holder, False)
+                    progressed = True
+                continue
+        if not progressed:
+            if hb_on:
+                # Idle, queue not empty: the beacon says so explicitly —
+                # "waiting with zero leases" is not "stalled holding one".
+                heartbeat(
+                    -1, state="wq_wait",
+                    extra={
+                        "leased_blocks": 0,
+                        "queue_depth": int(nb - len(done)),
+                    },
+                )
+            time.sleep(poll)
+
+    # Phase C — assembly: fetch each block from its WINNER (local reuse
+    # when we won it), in block order. Results were published BEFORE the
+    # done-CAS, so the keys exist by construction.
+    parts = []
+    for bid in range(nb):
+        win = done[bid]
+        wpid = int(win.get("pid", -1))
+        if wpid == pid and bid in local:
+            parts.append(local[bid])
+            continue
+        rp = f"{prefix}/result/{bid}/{wpid}"
+        n = int(_get_attributed(c, f"{rp}/n", wpid, name, recover=None))
+        parts.append(
+            _decode_payload(
+                _get_attributed(c, f"{rp}/{j}", wpid, name, recover=None)
+                for j in range(n)
+            )
+        )
+
+    # Phase D — exit rendezvous. A degraded exit skips the collective
+    # shutdown barrier, but process 0 hosts the coordination service:
+    # its teardown ABORTS every process still touching the KV — and a
+    # live straggler may be mid-execution (it will lose the done-CAS,
+    # then fetch the winners for ITS assembly) arbitrarily long after
+    # the fleet finished. Each process marks its assembly complete; the
+    # leader lingers until every peer has either marked done or stopped
+    # advancing its beacon for a grace window (it is dead — waiting
+    # longer helps nobody).
+    try:
+        kv_retry(
+            lambda: c.key_value_set(
+                f"{prefix}/exit/{pid}", json.dumps({"t": time.time()})
+            ),
+            op="wq_exit",
+            key=f"{prefix}/exit/{pid}",
+            attempts=2,
+        )
+    except Exception:
+        pass
+    if pid == 0 and _degraded_exit_armed[0]:
+        grace = max(stall, 10.0)
+        last_t: Dict[int, float] = {}
+        last_adv: Dict[int, float] = {}
+        while time.monotonic() < deadline:
+            exited = _read_dir("exit")
+            waiting = [
+                q for q in range(nproc)
+                if q != pid and str(q) not in exited
+            ]
+            if not waiting:
+                break
+            beats = read_heartbeats()
+            mono = time.monotonic()
+            any_alive = False
+            for q in waiting:
+                t_q = float(beats.get(q, {}).get("t", 0.0))
+                if q not in last_adv or t_q > last_t.get(q, 0.0):
+                    last_t[q] = t_q
+                    last_adv[q] = mono
+                if mono - last_adv[q] <= grace:
+                    any_alive = True
+            if not any_alive:
+                break
+            time.sleep(poll)
+    return parts
 
 
 def output_path_for_process(path: Optional[str]) -> Optional[str]:

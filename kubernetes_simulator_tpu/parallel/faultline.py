@@ -115,6 +115,53 @@ def parse_kill_schedule(spec: str) -> List[Tuple[str, str, int]]:
     return entries
 
 
+def parse_slow_schedule(spec: str) -> List[Tuple[int, int, float]]:
+    """Parse ``KSIM_FAULTLINE_SLOW`` into ``(pid, chunk, factor)`` entries.
+
+    Grammar: comma-separated ``<pid>@<chunk>:<factor>`` tokens — from
+    heartbeat cursor ``chunk`` onward, process ``pid`` sleeps ``factor``
+    seconds per heartbeat while in the ``run`` state.  Unlike the kill
+    grammar there is no ``*``: a straggler must be named so the slow
+    schedule is a pure function of the config (no CAS race deciding who
+    straggles).  Raises ``ValueError`` on malformed tokens.
+    """
+    entries: List[Tuple[int, int, float]] = []
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        head, sep, factor_s = tok.rpartition(":")
+        if not sep or "@" not in head:
+            raise ValueError(
+                f"faultline slow entry {tok!r} must be '<pid>@<chunk>:<factor>'"
+            )
+        pid_s, chunk_s = head.split("@", 1)
+        if not pid_s.strip().isdigit():
+            raise ValueError(
+                f"faultline slow entry {tok!r}: pid must be a named "
+                f"non-negative process index ('*' is not allowed — "
+                f"stragglers are deterministic by construction)"
+            )
+        try:
+            chunk = int(chunk_s)
+        except ValueError:
+            raise ValueError(
+                f"faultline slow entry {tok!r}: chunk {chunk_s!r} is not an integer"
+            ) from None
+        try:
+            factor = float(factor_s)
+        except ValueError:
+            raise ValueError(
+                f"faultline slow entry {tok!r}: factor {factor_s!r} is not a number"
+            ) from None
+        if factor < 0:
+            raise ValueError(
+                f"faultline slow entry {tok!r}: factor must be >= 0 seconds"
+            )
+        entries.append((int(pid_s), chunk, factor))
+    return entries
+
+
 class Injector:
     """Seeded, per-process fault decider.
 
@@ -135,6 +182,7 @@ class Injector:
         torn_write_rate: float = 0.0,
         stale_read_rate: float = 0.0,
         kill: str = "",
+        slow: str = "",
     ):
         self.seed = int(seed)
         self.pid = int(pid)
@@ -147,6 +195,10 @@ class Injector:
             "file": float(torn_write_rate),
         }
         self.kill_entries = parse_kill_schedule(kill)
+        # Slow (straggler) schedule — kept out of CLASSES/counts: it is
+        # not a rate-driven class and pinned stats stay five-keyed.
+        self.slow_entries = parse_slow_schedule(slow)
+        self.slow_count = 0
         self.counts = {c: 0 for c in self.CLASSES}
         self._rng: dict = {}
 
@@ -200,6 +252,7 @@ def from_env() -> Injector:
         torn_write_rate=float(os.environ.get("KSIM_FAULTLINE_TORN_RATE", "0") or 0),
         stale_read_rate=float(os.environ.get("KSIM_FAULTLINE_STALE_RATE", "0") or 0),
         kill=os.environ.get("KSIM_FAULTLINE_KILL", ""),
+        slow=os.environ.get("KSIM_FAULTLINE_SLOW", ""),
     )
 
 
@@ -308,6 +361,37 @@ def file_blob(blob: str) -> str:
     if inj.hit("file"):
         return inj.tear(blob)
     return blob
+
+
+def maybe_slow(chunk: int, state: str) -> float:
+    """Sleep per the straggler schedule; returns seconds slept.
+
+    Called by ``dcn.heartbeat`` at the TOP of the beat — *before* the
+    beacon/lease-renewal publish — so the sleep ages the PREVIOUS beacon
+    and renewal on the wire (the signal straggler detection reads) while
+    the beat published after waking carries a fresh timestamp.  Only the
+    ``run`` state is slowed: slowing ``gather``/``recover`` would stall
+    coordination itself rather than manufacture a compute straggler.
+    """
+    if not active() or state != "run":
+        return 0.0
+    inj = injector()
+    slept = 0.0
+    for pid_s, thr, factor in inj.slow_entries:
+        if pid_s != inj.pid or int(chunk) < thr or factor <= 0:
+            continue
+        if slept == 0.0:
+            log.warning(
+                "faultline: slowing process %d by %.3gs (schedule entry "
+                "%r at chunk=%d)",
+                inj.pid, factor, f"{pid_s}@{thr}:{factor:g}", int(chunk),
+            )
+        import time
+
+        time.sleep(factor)
+        inj.slow_count += 1
+        slept += factor
+    return slept
 
 
 def maybe_kill(chunk: int, state: str) -> None:

@@ -51,12 +51,15 @@ def init_distributed(
     gather deadline: its default ~100s tolerance would propagate a fatal
     error that aborts every healthy task while a survivor is still
     rebalancing the dead process's block. parallel.dcn's liveness
-    beacons (KSIM_DCN_STALL_S) stay the fast detector."""
+    beacons (KSIM_DCN_STALL_S) stay the fast detector. The round-18
+    work-stealing queue widens it for the same reason: a straggling or
+    deferred-join process must not be declared dead by the runtime while
+    the queue is still racing a speculative re-execution against it."""
     if not (num_processes and num_processes > 1):
         return
     from . import dcn
 
-    if not dcn.recover_enabled():
+    if not (dcn.recover_enabled() or dcn.wq_enabled()):
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -96,8 +99,12 @@ def make_node_mesh(num_shards: int) -> Mesh:
     """1-D device mesh over the NODE axis — ``num_shards`` devices each
     carrying 1/num_shards of a single scenario's node planes. Raises when
     the host does not expose that many devices (node sharding never spans
-    processes; compose with parallel.dcn for that)."""
-    devs = jax.devices()
+    processes; compose with parallel.dcn for that). LOCAL devices only:
+    inside a DCN fleet ``jax.devices()`` leads with process 0's devices,
+    which are unaddressable from every other process — a node-sharded
+    source replay feeding a fleet (the round-18 work-queue fork leg)
+    must shard over the devices this process owns."""
+    devs = jax.local_devices()
     if num_shards > len(devs):
         raise ValueError(
             f"node_shards={num_shards} exceeds the {len(devs)} visible "

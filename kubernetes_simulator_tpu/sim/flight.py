@@ -128,6 +128,14 @@ class FlightRecorder:
                 **self._meta,
             }
         )
+        # Fleet-event subscription (round 18): lease/steal/speculation/
+        # claim events from parallel.dcn land in this stream as "fleet"
+        # rows, interleaved with the chunk rows — the straggler tests pin
+        # the trail here. Unregistered on close; a raising sink is
+        # dropped by dcn itself.
+        self._fleet_sink = self.fleet_event
+        dcn.EVENT_SINKS.append(self._fleet_sink)
+        self._dcn_mod = dcn
 
     @classmethod
     def open(cls, spec, meta: Optional[dict] = None) -> Optional["FlightRecorder"]:
@@ -267,7 +275,28 @@ class FlightRecorder:
             }
         )
 
+    def fleet_event(self, event: dict) -> None:
+        """One fleet coordination event (parallel.dcn._mirror_event):
+        lease / steal / speculate / block_done / spec_lost / join /
+        claim / recovered. Flattened into the row — every field but the
+        wall clocks is deterministic for a fixed schedule."""
+        ev = dict(event)
+        kind = ev.pop("event", "?")
+        self._emit(
+            {
+                "event": "fleet",
+                "fleet_event": str(kind),
+                "chunk": -1,
+                "wall_s": round(time.perf_counter() - self._t0, 6),
+                **ev,
+            }
+        )
+
     def close(self, summary: Optional[dict] = None) -> None:
+        try:
+            self._dcn_mod.EVENT_SINKS.remove(self._fleet_sink)
+        except (AttributeError, ValueError):
+            pass
         if self._writer is None:
             return
         row = {

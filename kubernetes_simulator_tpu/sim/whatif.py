@@ -634,7 +634,17 @@ class WhatIfEngine:
         self.S_global = len(scenarios)
         self._dcn_sliced = False
         self._dcn_spare = False
+        # Round 18 work-stealing queue: run() routes through _run_workqueue
+        # instead of the static chunk loop; _dcn_wq_info marks a BLOCK
+        # engine built by _wq_exec_block (rides in via _dcn_recovery).
+        self._dcn_wq = False
+        self._wq_exec_chunks = 0
         self._dcn_recovery = dict(_dcn_recovery) if _dcn_recovery else None
+        self._dcn_wq_info = (
+            dict(self._dcn_recovery.get("wq") or {})
+            if self._dcn_recovery is not None and self._dcn_recovery.get("wq")
+            else None
+        )
         # Everything a survivor needs to rebuild a DEAD sibling's engine
         # bit-identically (round 15): the FULL scenario list plus the raw
         # ctor knobs. Captured only on the sliced path — recovery re-runs
@@ -723,6 +733,12 @@ class WhatIfEngine:
                 # slice for shapes only; run() skips the chunk loop and
                 # sits in the gather as claim-eligible elastic capacity.
                 self._dcn_spare = dcn.is_spare()
+                # Work-stealing queue (round 18): the slice above is kept
+                # only for shapes/compile warm-up parity — run() leases
+                # scenario BLOCKS from the KV queue instead of executing
+                # the static slice, and every process (workers, spares,
+                # joiners) drains the same queue.
+                self._dcn_wq = dcn.wq_enabled()
                 if policies is not None:
                     pol_g = np.asarray(policies)
                     if pol_g.ndim == 2 and pol_g.shape[0] == self.S_global:
@@ -2359,10 +2375,185 @@ class WhatIfEngine:
             process_count=jax.process_count(),
         )
 
+    def _wq_exec_block(
+        self, bid, lo, hi, resume_pid, gen, speculative, queue_depth
+    ) -> dict:
+        """``execute`` callback for :func:`parallel.dcn.wq_run`: run
+        scenario block ``[lo, hi)`` through a fresh engine on THIS
+        process's local mesh and return the 17-key gather payload. The
+        chunk program is a pure function of the block contents and the
+        full-list engine gates (dictated below, never re-derived), so any
+        process executing the block — holder, speculator, or thief —
+        produces byte-identical results. ``resume_pid >= 0`` resumes from
+        that pid's newest published checkpoint for this block's own
+        (negative) epoch; speculative/steal provenance rides into the
+        block engine's fleet telemetry via the ``wq`` info dict."""
+        rb = self._dcn_rebuild
+        if rb is None:
+            raise RuntimeError(
+                "work-queue execute callback invoked on an engine that "
+                "was never scenario-sliced"
+            )
+        if dcn.heartbeat_every() > 0:
+            dcn.heartbeat(
+                -1, block=(int(lo), int(hi)),
+                state="spec" if speculative else "run",
+                extra={
+                    "wq_block": int(bid),
+                    "leased_blocks": 1,
+                    "queue_depth": int(queue_depth),
+                },
+            )
+        eng = WhatIfEngine(
+            self.ec, self.pods, rb["scenarios"],
+            config=rb["config"],
+            wave_width=rb["wave_width"],
+            chunk_waves=rb["chunk_waves"],
+            mesh=self.mesh,
+            collect_assignments=rb["collect_assignments"],
+            fork_checkpoint=rb["fork_checkpoint"],
+            preemption=rb["preemption"],
+            completions=rb["completions"],
+            retry_buffer=rb["retry_buffer"],
+            granularity_guard=rb["granularity_guard"],
+            telemetry=rb["telemetry"],
+            policies=rb["policies"],
+            _dcn_recovery=dict(
+                block=(int(lo), int(hi)),
+                for_pid=int(resume_pid),
+                gen=int(gen),
+                epoch=dcn.wq_ckpt_epoch(dcn.gather_seq(), int(bid)),
+                prefer_taint=self._dcn_prefer_taint,
+                scales_pods=self._dcn_scales_pods,
+                wq=dict(
+                    block=int(bid),
+                    speculative=bool(speculative),
+                    queue_depth=int(queue_depth),
+                ),
+            ),
+        )
+        res = eng.run()
+        dcn.note_block_chunks(eng._wq_exec_chunks)
+        return dict(
+            placed=res.placed,
+            assignments=res.assignments,
+            util=res.utilization_cpu,
+            preemptions=res.preemptions,
+            dropped=res.retry_dropped,
+            evictions=res.evictions,
+            resched=res.evict_rescheduled,
+            stranded=res.evict_stranded,
+            evict_lat=res.evict_latency_mean,
+            lat50=res.latency_p50,
+            lat90=res.latency_p90,
+            lat99=res.latency_p99,
+            frag_stranded=res.stranded_cpu,
+            frag_index=res.frag_index_cpu,
+            frag_pack=res.packing_efficiency,
+            telemetry=res.scenario_telemetry,
+            fleet=res.fleet_telemetry,
+        )
+
+    def _run_workqueue(self) -> WhatIfResult:
+        """Round 18 work-stealing scenario-block queue: every process
+        (worker, spare, mid-replay joiner) drains
+        :func:`parallel.dcn.wq_run` and assembles the per-block payloads
+        in block order — structurally the :meth:`_run_spare` assembly,
+        keyed by block id instead of pid, so the result is byte-identical
+        to the static-slicing oracle for ANY lease interleaving."""
+        from .telemetry import ReplayTelemetry
+
+        t0 = time.perf_counter()
+        if dcn.heartbeat_every() > 0:
+            dcn.heartbeat(
+                -1, state="run", wall_s=0.0,
+                extra={"leased_blocks": 0},
+            )
+        blocks = dcn.wq_blocks(self.S_global)
+        parts = dcn.wq_run("whatif", blocks, self._wq_exec_block)
+
+        def _cat(k):
+            if parts[0][k] is None:
+                return None
+            return np.concatenate([p[k] for p in parts], axis=0)
+
+        placed = _cat("placed")
+        fleet_tel = None
+        if parts[0].get("fleet") is not None:
+            fleet_tel = ReplayTelemetry.merge(
+                [p["fleet"] for p in parts],
+                process_ids=list(range(len(parts))),
+            )
+        wall = time.perf_counter() - t0
+        # Mirror the single-process path's to_schedule: waves already
+        # covered by a fork checkpoint are not demand, so they must not
+        # count against placed when deriving unschedulable. The outer
+        # wq engine never runs _init_states (only block engines do), so
+        # load the fork bookkeeping here.
+        self._fork_waves_done = 0
+        if self.fork_checkpoint:
+            self._load_fork_or_init()
+        idx = self.waves.idx
+        if self._fork_waves_done:
+            idx = idx[self._fork_waves_done:]
+        to_schedule = int((idx >= 0).sum())
+        total = int(placed.sum())
+        ndev_local = (
+            int(self.mesh.devices.size) if self.mesh is not None else 1
+        )
+        dev_scale = dcn.worker_count()
+        return WhatIfResult(
+            placed=placed,
+            unschedulable=(to_schedule - placed).astype(np.int32),
+            total_placed=total,
+            wall_clock_s=wall,
+            placements_per_sec=total / wall if wall > 0 else 0.0,
+            assignments=_cat("assignments"),
+            utilization_cpu=_cat("util"),
+            completions_on=self.completions_on,
+            engine=self.engine,
+            preemptions=_cat("preemptions"),
+            retry_dropped=_cat("dropped"),
+            evictions=_cat("evictions"),
+            evict_rescheduled=_cat("resched"),
+            evict_stranded=_cat("stranded"),
+            evict_latency_mean=_cat("evict_lat"),
+            latency_p50=_cat("lat50"),
+            latency_p90=_cat("lat90"),
+            latency_p99=_cat("lat99"),
+            stranded_cpu=_cat("frag_stranded"),
+            frag_index_cpu=_cat("frag_index"),
+            packing_efficiency=_cat("frag_pack"),
+            scenario_telemetry=(
+                None
+                if parts[0]["telemetry"] is None
+                else [t for p in parts for t in p["telemetry"]]
+            ),
+            fleet_telemetry=fleet_tel,
+            n_devices=ndev_local * dev_scale,
+            mesh_shape=(
+                dict(zip(
+                    self.mesh.axis_names,
+                    (
+                        int(d) * dev_scale
+                        for d in self.mesh.devices.shape
+                    ),
+                ))
+                if self.mesh is not None
+                else None
+            ),
+            process_count=jax.process_count(),
+        )
+
     def run(self) -> WhatIfResult:
         # Per-run counter for the round-11 contract test: full-tensor
         # cross-process replication in _fetch must be 0 for this replay.
         self._replicate_count = 0
+        if self._dcn_wq:
+            # Work-queue mode subsumes the spare path: a spare is just a
+            # process that loses every generation-0 lease race and waits
+            # for stealable/speculation-eligible work.
+            return self._run_workqueue()
         if self._dcn_spare:
             return self._run_spare()
         states = self._init_states()  # sets fork bookkeeping first
@@ -2763,12 +2954,27 @@ class WhatIfEngine:
         # pid with state="recover" and the claimed block named, so a
         # SECOND failure during recovery is attributed to the claimant.
         recovering = self._dcn_recovery is not None
+        wq_info = self._dcn_wq_info  # block engine under the round-18 queue
         hb_on = (
             self._dcn_sliced or recovering
         ) and dcn.heartbeat_every() > 0
         hb_block = (self._proc_lo, self._proc_lo + self.S)
-        hb_kw = (
-            dict(
+        if wq_info is not None:
+            # Work-queue block engine: beats under our OWN pid with the
+            # lease named (dcn.heartbeat also renews the lease on every
+            # beat). wq_rate — chunks per wall second, the straggler
+            # watermark's input — is refreshed per beat in the loop.
+            hb_kw = dict(
+                state="spec" if wq_info.get("speculative") else "run",
+                extra={
+                    "wq_block": int(wq_info.get("block", -1)),
+                    "leased_blocks": 1,
+                    "queue_depth": int(wq_info.get("queue_depth", 0)),
+                    "wq_rate": 0.0,
+                },
+            )
+        elif recovering:
+            hb_kw = dict(
                 state="recover",
                 extra={
                     "recovering_for": int(
@@ -2776,9 +2982,8 @@ class WhatIfEngine:
                     )
                 },
             )
-            if recovering
-            else {}
-        )
+        else:
+            hb_kw = {}
         # Recoverable work-queue (round 15, parallel.dcn): on a chunk
         # cadence, publish a compressed host snapshot of the loop
         # carriers so a survivor can resume THIS block mid-replay after
@@ -2789,9 +2994,15 @@ class WhatIfEngine:
         # state in per-scenario host structures instead — a claimed
         # block there re-executes from chunk 0, still byte-identical.
         ck_ok = kbops is None and not comp_on
+        # Queue block engines checkpoint too (under the block's own
+        # negative epoch) — that is what a speculator or thief resumes.
         ck_every = (
             dcn.ckpt_every()
-            if (self._dcn_sliced and not self._dcn_spare and ck_ok)
+            if ck_ok
+            and (
+                (self._dcn_sliced and not self._dcn_spare)
+                or wq_info is not None
+            )
             else 0
         )
 
@@ -2811,7 +3022,14 @@ class WhatIfEngine:
             int(self.S), int(C), int(n_chunks),
         ]
         start_ci = 0
-        if recovering and ck_ok:
+        # for_pid < 0 is a generation-0 queue lease: nobody ran this block
+        # before us, so there is no checkpoint to resume — execute from
+        # chunk 0 (steals/speculation name the holder via for_pid >= 0).
+        if (
+            recovering
+            and ck_ok
+            and int(self._dcn_recovery.get("for_pid", -1)) >= 0
+        ):
             from ..utils.metrics import log as _log
             from .jax_runtime import restore_carriers
 
@@ -2876,6 +3094,10 @@ class WhatIfEngine:
                     dead, hb_block[0], hb_block[1], start_ci, n_chunks,
                 )
                 break
+        # Chunks this engine will actually execute (resumes skip the
+        # carried prefix) — the queue driver charges these to
+        # spec_wasted_chunks when a speculative duplicate is discarded.
+        self._wq_exec_chunks = max(n_chunks - start_ci, 0)
         t0 = time.perf_counter()
         for ci, c0 in enumerate(range(0, idx.shape[0], C)):
             if ci < start_ci:
@@ -2893,8 +3115,15 @@ class WhatIfEngine:
                             "outs": jax.device_get(outs),
                         },
                         hb_block,
+                        epoch=(self._dcn_recovery or {}).get("epoch"),
                     )
             if hb_on:
+                if wq_info is not None and ci > start_ci:
+                    wall_now = time.perf_counter() - t0
+                    if wall_now > 0:
+                        hb_kw["extra"]["wq_rate"] = round(
+                            (ci - start_ci) / wall_now, 4
+                        )
                 dcn.maybe_heartbeat(
                     ci - 1,
                     total=n_chunks,
@@ -3374,7 +3603,20 @@ class WhatIfEngine:
                 fleet_local.phases["ckpt_crc_fallback_count"] = float(
                     _cs["fallbacks"] - _cs_start["fallbacks"]
                 )
-            if self._dcn_recovery is not None:
+            if self._dcn_wq_info is not None:
+                # Work-queue provenance (round 18): which block this
+                # engine executed, at which lease generation, and whether
+                # it was a speculative re-execution — the telemetry trail
+                # the straggler tests pin.
+                fleet_local.phases["wq_block"] = float(
+                    self._dcn_wq_info.get("block", -1)
+                )
+                fleet_local.phases["wq_gen"] = float(
+                    self._dcn_recovery.get("gen", 0)
+                )
+                if self._dcn_wq_info.get("speculative"):
+                    fleet_local.phases["wq_spec"] = 1.0
+            elif self._dcn_recovery is not None:
                 # Claim-generation fencing (round 17): which claim
                 # attempt produced this block, and for whom. gen > 0
                 # marks a hand-off after a claimant death mid-recovery.

@@ -167,6 +167,27 @@ class DcnRecoverySpec:
 
 
 @dataclass
+class DcnWorkQueueSpec:
+    """Work-stealing scenario-block queue (``dcn.workQueue:`` YAML
+    section, round 18 — parallel.dcn). Config-level spelling of the
+    ``KSIM_DCN_WORKQUEUE`` / ``KSIM_DCN_WQ_BLOCK`` /
+    ``KSIM_DCN_SPECULATE`` / ``KSIM_DCN_STRAGGLER_S`` env knobs, exported
+    by the CLI (setdefault) before ``jax.distributed`` bring-up.
+    ``block_size`` is scenarios per lease (0 = auto: one block per worker
+    — the static partition when nobody steals); ``speculate`` enables
+    backup re-execution of straggling blocks (requires checkpoint
+    publication via ``dcn.recovery.checkpointEvery`` to resume mid-block;
+    validate_config refuses it without); ``straggler_s`` is the
+    lease-renewal age past which a LIVE holder becomes
+    speculation-eligible (0 = half the stall window)."""
+
+    enable: bool = False
+    block_size: int = 0
+    speculate: bool = False
+    straggler_s: float = 0.0
+
+
+@dataclass
 class FlightRecorderSpec:
     """Flight recorder (``flightRecorder:`` YAML section, round 16 —
     sim.flight). ``path`` is the JSONL stream sink (suffixed per process
@@ -199,6 +220,10 @@ class FaultlineSpec:
     torn_write_rate: float = 0.0
     stale_read_rate: float = 0.0
     kill: Optional[str] = None
+    # Straggler schedule (round 18): "<pid>@<chunk>:<factor>" entries —
+    # see faultline.parse_slow_schedule. Distinct from kill: the process
+    # stays alive, each heartbeat just sleeps `factor` seconds.
+    slow: Optional[str] = None
 
 
 @dataclass
@@ -239,6 +264,7 @@ class SimConfig:
     tune: Optional[TuneSpec] = None
     chaos: Optional[ChaosSpec] = None
     dcn_recovery: Optional[DcnRecoverySpec] = None
+    dcn_workqueue: Optional[DcnWorkQueueSpec] = None
     faultline: Optional[FaultlineSpec] = None
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     output: Optional[str] = None
@@ -380,6 +406,14 @@ class SimConfig:
                 checkpoint_every=int(rec.get("checkpointEvery", 0)),
                 max_claims=int(rec.get("maxClaims", 2)),
             )
+            wq = dc.get("workQueue")
+            if wq is not None:
+                cfg.dcn_workqueue = DcnWorkQueueSpec(
+                    enable=bool(wq.get("enable", False)),
+                    block_size=int(wq.get("blockSize", 0)),
+                    speculate=bool(wq.get("speculate", False)),
+                    straggler_s=float(wq.get("stragglerS", 0.0)),
+                )
         fl = d.get("faultline")
         if fl is not None:
             cfg.faultline = FaultlineSpec(
@@ -391,6 +425,7 @@ class SimConfig:
                 torn_write_rate=float(fl.get("tornWriteRate", 0.0)),
                 stale_read_rate=float(fl.get("staleReadRate", 0.0)),
                 kill=fl.get("kill"),
+                slow=fl.get("slow"),
             )
         tl = d.get("telemetry")
         if tl is not None:

@@ -314,6 +314,34 @@ def compare_pair(
                 notes.append(
                     f"fault_injection {key}: {ga} -> {gb} (informational)"
                 )
+
+    # Work-queue accounting (round 18): NEVER a regression — the block
+    # only exists when the bench ran under the opt-in work-stealing
+    # queue, and steal/speculation counts are schedule-dependent, not
+    # performance signals.
+    wa, wb = da.get("work_queue"), db.get("work_queue")
+    if isinstance(wb, dict) and not isinstance(wa, dict):
+        notes.append(
+            "work_queue: first appearance "
+            f"(steals {wb.get('steals')}, "
+            f"spec wins {wb.get('spec_wins')}, "
+            f"wasted chunks {wb.get('spec_wasted_chunks')}, "
+            f"renew overhead {wb.get('lease_renew_overhead_pct')}%, "
+            f"straggler wall saved {wb.get('straggler_wall_saved_s')}s)"
+        )
+    elif isinstance(wa, dict) and isinstance(wb, dict):
+        for key in (
+            "steals",
+            "spec_wins",
+            "spec_wasted_chunks",
+            "lease_renew_overhead_pct",
+            "straggler_wall_saved_s",
+        ):
+            ga, gb = wa.get(key), wb.get(key)
+            if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
+                notes.append(
+                    f"work_queue {key}: {ga} -> {gb} (informational)"
+                )
     return regressions, notes
 
 

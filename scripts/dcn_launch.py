@@ -33,6 +33,19 @@ checkpoint (``KSIM_DCN_CKPT_EVERY``), and the launcher succeeds as long
 as ANY process completes the gathered replay. ``--watch`` surfaces the
 rebalance live: claim/recovered events from the KV mirror's
 ``events.jsonl`` plus ``recovering-p<dead>`` beacon states.
+
+``--join N`` (round 18) launches N JOINER processes at the tail of the
+pid range and turns the work-stealing scenario-block queue on
+(``KSIM_DCN_WORKQUEUE=1`` unless already set). The jax.distributed
+runtime barriers until every process CONNECTS, so a joiner connects at
+launch like everyone else — what joins mid-replay is its CONTRIBUTION:
+each joiner sleeps ``--join-delay`` seconds (staggered per joiner)
+inside the queue driver, publishing a live ``join``-state beacon, then
+leases whatever blocks are still pending. Unlike round-15 spares,
+joiners (and every worker) can relieve a LIVE straggler, not just a
+dead process. ``--watch`` renders the queue live: per-block lease
+owners from the beacons, plus lease / steal / speculate / block-done /
+join events.
 """
 
 from __future__ import annotations
@@ -61,6 +74,7 @@ def child_env(
     port: int,
     devices_per_proc: int,
     hb_dir: str = "",
+    join_delay: float = 0.0,
 ) -> dict:
     env = dict(os.environ)
     env["KSIM_DCN_COORD"] = f"127.0.0.1:{port}"
@@ -68,6 +82,11 @@ def child_env(
     env["KSIM_DCN_PID"] = str(pid)
     if hb_dir:
         env["KSIM_DCN_HB_DIR"] = hb_dir
+    if join_delay > 0:
+        # Round 18 joiner: defer this process's work-queue contribution
+        # (the coordination connect still happens at launch — the
+        # runtime barriers on it; parallel.dcn.wq_run sleeps instead).
+        env["KSIM_DCN_JOIN_DELAY_S"] = str(join_delay)
     env.setdefault("JAX_PLATFORMS", "cpu")
     flags = [
         f for f in env.get("XLA_FLAGS", "").split()
@@ -181,6 +200,8 @@ class FleetWatch:
         kind = e.get("event", "?")
         who = f"p{e.get('claimant', '?')}"
         dead = f"p{e.get('for', '?')}"
+        wp = f"p{e.get('pid', '?')}"
+        blk = f"block {e.get('block', '?')}"
         if kind == "claim":
             msg = (
                 f"{who} CLAIMS dead {dead}'s block "
@@ -191,6 +212,32 @@ class FleetWatch:
                 f"{who} RECOVERED {dead}'s block "
                 f"in {float(e.get('wall_s', 0.0)):.1f}s"
             )
+        # Round 18 work-queue trail (parallel.dcn.wq_run):
+        elif kind == "lease":
+            msg = f"{wp} leases {blk}"
+        elif kind == "steal":
+            msg = (
+                f"{wp} STEALS {blk} from expired p{e.get('from', '?')} "
+                f"(gen {e.get('gen', '?')})"
+            )
+        elif kind == "speculate":
+            msg = (
+                f"{wp} SPECULATES on straggler p{e.get('from', '?')}'s "
+                f"{blk}"
+            )
+        elif kind == "block_done":
+            msg = (
+                f"{wp} completed {blk} in "
+                f"{float(e.get('wall_s', 0.0)):.1f}s"
+                + (" (speculative win)" if e.get("spec") else "")
+            )
+        elif kind in ("spec_lost", "dup_discard"):
+            msg = (
+                f"{wp}'s duplicate of {blk} discarded "
+                f"(lost first-complete-wins)"
+            )
+        elif kind == "join":
+            msg = f"{wp} JOINS the fleet mid-replay"
         else:
             msg = json.dumps(e, sort_keys=True)
         return f"dcn_launch[watch]: {msg}"
@@ -234,12 +281,21 @@ class FleetWatch:
                 # Round 15: a claimant re-executing a dead sibling's
                 # block beats under its OWN pid with the dead pid named.
                 state = f"recovering-p{b['recovering_for']}"
+            if "wq_block" in b and int(b.get("leased_blocks", 0)):
+                # Round 18: the lease this process is executing ("spec"
+                # state = speculative re-execution of a straggler's
+                # block).
+                state = f"{state}@b{b['wq_block']}"
             seg = (
                 f"p{pid} {state} "
                 f"chunk {chunk}"
                 + (f"/{total}" if total is not None else "")
                 + rate
             )
+            if "queue_depth" in b and not int(b.get("leased_blocks", 0)):
+                # Idle-but-queue-pending vs stalled-holding-a-lease: the
+                # round-18 beacon extras make the distinction explicit.
+                seg += f" qd={b['queue_depth']}"
             if "live_buffers" in b:
                 seg += f" live={b['live_buffers']}"
             if "util_cpu" in b:
@@ -250,6 +306,28 @@ class FleetWatch:
                 seg += " [STRAGGLER]"
             segs.append(seg)
         return "dcn_launch[watch]: " + " | ".join(segs)
+
+    def wq_line(self, beats: dict) -> str:
+        """Round 18: one line of per-block lease owners, derived from the
+        ``wq_block``/``leased_blocks`` beacon extras ('' when no process
+        holds a queue lease — e.g. a static-slicing fleet)."""
+        owners = {}
+        for pid, b in beats.items():
+            if int(b.get("leased_blocks", 0)) and "wq_block" in b:
+                suffix = "*" if b.get("state") == "spec" else ""
+                owners.setdefault(int(b["wq_block"]), []).append(
+                    f"p{pid}{suffix}"
+                )
+        if not owners:
+            return ""
+        segs = [
+            f"b{bid}→{'+'.join(sorted(pids))}"
+            for bid, pids in sorted(owners.items())
+        ]
+        return (
+            "dcn_launch[watch]: wq leases " + " ".join(segs)
+            + " (* = speculative)"
+        )
 
 
 def main(argv=None) -> int:
@@ -282,6 +360,20 @@ def main(argv=None) -> int:
              "(KSIM_DCN_SPARES / KSIM_DCN_RECOVER)",
     )
     ap.add_argument(
+        "--join", type=int, default=0, metavar="JOINERS",
+        help="round 18: launch JOINERS extra processes at the tail of "
+             "the pid range and enable the work-stealing block queue "
+             "(KSIM_DCN_WORKQUEUE=1 unless set): each joiner defers its "
+             "queue contribution by --join-delay seconds (staggered), "
+             "then leases pending blocks — true elastic capacity, not "
+             "just dead-block claims",
+    )
+    ap.add_argument(
+        "--join-delay", type=float, default=5.0, metavar="SECONDS",
+        help="base contribution delay for --join processes (joiner k "
+             "waits k×delay seconds; KSIM_DCN_JOIN_DELAY_S)",
+    )
+    ap.add_argument(
         "--watch-interval", type=float, default=2.0,
         help="seconds between --watch progress lines",
     )
@@ -307,13 +399,29 @@ def main(argv=None) -> int:
         ap.error("--nproc must be >= 1")
     if args.elastic < 0:
         ap.error("--elastic must be >= 0")
-    nproc = args.nproc + args.elastic
+    if args.join < 0:
+        ap.error("--join must be >= 0")
+    if args.join and args.elastic:
+        ap.error(
+            "--join and --elastic are mutually exclusive: joiners ride "
+            "the work queue (any process leases any pending block), "
+            "which subsumes spare capacity"
+        )
+    if args.join_delay < 0:
+        ap.error("--join-delay must be >= 0")
+    nproc = args.nproc + args.elastic + args.join
     elastic = args.elastic > 0
     if elastic:
         # Spares own no scenario block (parallel.dcn.spare_count); the
         # recovery knob defaults on so survivors/spare claim dead blocks.
         os.environ["KSIM_DCN_SPARES"] = str(args.elastic)
         os.environ.setdefault("KSIM_DCN_RECOVER", "1")
+    if args.join:
+        # Round 18 joiners are spare-pid processes under the work queue:
+        # they own no static block, connect at launch (the runtime
+        # barriers on connects) and defer their queue contribution.
+        os.environ["KSIM_DCN_SPARES"] = str(args.join)
+        os.environ.setdefault("KSIM_DCN_WORKQUEUE", "1")
     tolerant = elastic or str(
         os.environ.get("KSIM_DCN_RECOVER", "0")
     ).strip().lower() in ("1", "true", "yes", "on")
@@ -330,8 +438,14 @@ def main(argv=None) -> int:
     port = free_port()
     procs, tails = [], []
     for pid in range(nproc):
+        join_delay = 0.0
+        if args.join and pid >= args.nproc:
+            # Joiner k defers its contribution k×delay seconds so a
+            # multi-joiner launch trickles capacity in, not all at once.
+            join_delay = args.join_delay * (pid - args.nproc + 1)
         env = child_env(
-            pid, nproc, port, args.devices_per_proc, hb_dir
+            pid, nproc, port, args.devices_per_proc, hb_dir,
+            join_delay=join_delay,
         )
         if pid == 0:
             p = subprocess.Popen(cmd, env=env)
@@ -366,6 +480,9 @@ def main(argv=None) -> int:
                 beats = watch.read()
                 if beats:
                     print(watch.line(beats), file=sys.stderr)
+                    wql = watch.wq_line(beats)
+                    if wql:
+                        print(wql, file=sys.stderr)
                 for fl in watch.flight_lines():
                     print(fl, file=sys.stderr)
             if time.monotonic() > deadline:

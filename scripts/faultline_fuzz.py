@@ -1,13 +1,17 @@
 #!/usr/bin/env python
-"""Seeded crash-schedule fuzzer for the faultline plane (round 17).
+"""Seeded crash-schedule fuzzer for the faultline plane (rounds 17-18).
 
 Samples adversarial fault schedules — SIGKILL schedules (always
 including the double-kill and the recovering-claimant-kill), transient
-KV errors, added latency, torn checkpoint writes, stale reads — runs
-each against a 3-worker DCN fleet with recovery enabled, and asserts
-the surviving workers' end gathers are BYTE-IDENTICAL to a no-failure
-single-process oracle.  The injector only ever touches the coordination
-plane, so any divergence is a real recovery-semantics bug, not noise.
+KV errors, added latency, torn checkpoint writes, stale reads, and the
+round-18 work-queue drills (a deterministic straggler resolved by
+speculative re-execution, and a speculator killed mid-speculation with
+the block completing via the lease-expiry steal) — runs each against a
+3-worker DCN fleet with recovery enabled, and asserts the surviving
+workers' end gathers are BYTE-IDENTICAL to a no-failure single-process
+oracle.  The injector only ever touches the coordination plane or the
+holder's wall-clock, so any divergence is a real semantics bug, not
+noise.
 
 Usage (also importable — tests/test_faultline_fuzz.py drives the same
 functions from the pytest slow slice):
@@ -127,21 +131,30 @@ def main_oracle() -> int:
 
 # -- schedule sampling -------------------------------------------------------
 
-# The two mandatory schedules of the acceptance bar: ≥2 concurrent worker
-# deaths, and a claimant killed at its first recovery beacon (the ``*``
+# The mandatory schedules of the acceptance bar: ≥2 concurrent worker
+# deaths; a claimant killed at its first recovery beacon (the ``*``
 # CAS entry — whichever survivor claims first dies, the other hands off
-# via claim generation 1).
+# via claim generation 1); and two round-18 work-queue drills — a
+# deterministic straggler resolved purely by speculative re-execution
+# (lease expiry pushed out of reach), and a speculator SIGKILLed at its
+# first ``spec`` beacon, after which the straggler's block still
+# completes via the lease-expiry steal at generation 1.
 MANDATORY = (
     {"name": "double-kill", "kill": "1@run:0,2@run:0", "seed": 1701},
     {"name": "claimant-kill", "kill": "2@run:0,*@recover:-1", "seed": 1702},
+    {"name": "wq-straggler", "wq": 1, "slow": "1@1:4",
+     "stall_s": 600, "straggler_s": 1.0, "seed": 1801},
+    {"name": "wq-spec-kill", "wq": 1, "slow": "1@1:4",
+     "kill": "*@spec:-1", "stall_s": 2, "straggler_s": 1.0, "seed": 1802},
 )
 
 
 def sample_schedules(seed: int, n: int):
-    """``n`` fault schedules, a pure function of ``seed``.  The first two
-    are always the mandatory double-kill and claimant-kill; the rest mix
-    a random named kill (or none) with KV error/latency/torn/stale rates
-    low enough that the bounded retries absorb them."""
+    """``n`` fault schedules, a pure function of ``seed``.  The first
+    four are always the mandatory double-kill, claimant-kill,
+    wq-straggler and wq-spec-kill drills; the rest mix a random named
+    kill (or none) with KV error/latency/torn/stale rates low enough
+    that the bounded retries absorb them."""
     rng = random.Random(int(seed) * 9176 + 5)
     out = [dict(s) for s in MANDATORY]
     while len(out) < n:
@@ -246,7 +259,7 @@ def run_schedule(sched: dict, hb_dir: str, timeout_s: float = 600.0) -> dict:
         "KSIM_DCN_RECOVER": "1",
         "KSIM_DCN_CKPT_EVERY": "1",
         "KSIM_DCN_TIMEOUT_S": "600",
-        "KSIM_DCN_STALL_S": "2",
+        "KSIM_DCN_STALL_S": sched.get("stall_s", 2),
         "KSIM_DCN_POLL_S": "0.3",
         "KSIM_DCN_HEARTBEAT_EVERY": "1",
         "KSIM_DCN_MAX_CLAIMS": "2",
@@ -261,7 +274,17 @@ def run_schedule(sched: dict, hb_dir: str, timeout_s: float = 600.0) -> dict:
         "KSIM_FAULTLINE_TORN_RATE": sched.get("torn_rate", 0.0),
         "KSIM_FAULTLINE_STALE_RATE": sched.get("stale_rate", 0.0),
         "KSIM_FAULTLINE_KILL": sched.get("kill", ""),
+        "KSIM_FAULTLINE_SLOW": sched.get("slow", ""),
     })
+    if sched.get("wq"):
+        # Round-18 work-queue drills: leases + speculation ride the same
+        # fleet; straggler_s far below the (possibly unreachable) lease
+        # stall so speculation — not expiry — is what gets exercised.
+        base.update({
+            "KSIM_DCN_WORKQUEUE": "1",
+            "KSIM_DCN_SPECULATE": "1",
+            "KSIM_DCN_STRAGGLER_S": str(sched.get("straggler_s", 1.0)),
+        })
     procs = []
     for pid in range(NPROC):
         procs.append(subprocess.Popen(
@@ -332,13 +355,25 @@ def check_schedule(sched: dict, out: dict, oracle: dict):
     if not survivors:
         fails.append(f"{sched['name']}: no surviving worker (rcs {rcs})")
     if wildcard and killed > len(named):
-        # A ``*`` entry fired: a claimant died mid-recovery, so a
-        # survivor must have opened the next claim generation (the
-        # fenced hand-off) — not silently re-used the dead claim.
-        if "opening generation" not in out["blob"]:
+        # A ``*`` entry fired. Static slicing: a claimant died
+        # mid-recovery, so a survivor must have opened the next claim
+        # generation (the fenced hand-off). Work queue: the speculator
+        # died, so the straggler's block must have completed via the
+        # lease-expiry STEAL at the next lease generation.
+        marker = "steals block" if sched.get("wq") else "opening generation"
+        if marker not in out["blob"]:
             fails.append(
-                f"{sched['name']}: wildcard kill fired but no claim "
-                "generation hand-off appeared in the logs"
+                f"{sched['name']}: wildcard kill fired but no "
+                f"{'lease steal' if sched.get('wq') else 'claim generation'}"
+                " hand-off appeared in the logs"
+            )
+    if sched.get("wq") and sched.get("slow") and not sched.get("kill"):
+        # Pure-straggler drill: with lease expiry out of reach, only a
+        # speculative re-execution can have resolved the slowed holder.
+        if "speculates block" not in out["blob"]:
+            fails.append(
+                f"{sched['name']}: straggler injected but no speculative "
+                "re-execution appeared in the logs"
             )
     for pid in survivors:
         got = out["results"].get(pid)
@@ -406,10 +441,10 @@ def main() -> int:
                     help="internal: run as one fleet worker")
     ap.add_argument("--oracle", action="store_true",
                     help="internal: run the no-failure oracle")
-    ap.add_argument("--schedules", type=int, default=5,
-                    help="number of fault schedules to sample (>= 5 "
-                         "includes the mandatory double-kill and "
-                         "claimant-kill)")
+    ap.add_argument("--schedules", type=int, default=6,
+                    help="number of fault schedules to sample (>= 4 "
+                         "includes the mandatory double-kill, "
+                         "claimant-kill, wq-straggler and wq-spec-kill)")
     ap.add_argument("--seed", type=int, default=17)
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run timeout in seconds")
@@ -418,7 +453,8 @@ def main() -> int:
         return main_worker()
     if args.oracle:
         return main_oracle()
-    return main_fuzz(args.seed, max(args.schedules, 2), args.timeout)
+    return main_fuzz(args.seed, max(args.schedules, len(MANDATORY)),
+                     args.timeout)
 
 
 if __name__ == "__main__":

@@ -411,6 +411,128 @@ def case_fleetmerge():
     }
 
 
+def case_wqmerge():
+    """Round-18 work-queue merge case: kube+series what-if on the no-mesh
+    DCN path with S=6 — divisible by 1-, 2- and 3-worker fleets and by
+    the uneven block sizes the parity suite sweeps. Under the work queue
+    the merged fleet telemetry keeps the EXECUTING processes' ``p<pid>/``
+    phase namespaces (whoever won each block) with ``wq_block`` markers;
+    statically it is exactly one namespace per process. Either way every
+    virtual-time-derived payload field must bit-match the
+    single-process oracle."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.core import Cluster, Node, Pod
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.parallel import dcn
+    from kubernetes_simulator_tpu.sim.runtime import NodeEvent
+    from kubernetes_simulator_tpu.sim.whatif import Scenario, WhatIfEngine
+
+    nodes = [Node(f"n{i}", {"cpu": 4.0}) for i in range(4)]
+    pods = [
+        Pod(f"p{i}", requests={"cpu": 1.0}, arrival_time=float(i),
+            duration=20.0)
+        for i in range(24)
+    ]
+    ec, ep = encode(Cluster(nodes=nodes), pods)
+    cfg = FrameworkConfig(plugins=[{"name": "NodeResourcesFit"}])
+    scenarios = []
+    for s in range(6):
+        if s % 3 == 1:
+            scenarios.append(Scenario(events=[
+                NodeEvent(time=4.0 + s, kind="node_down", node=s % 4),
+                NodeEvent(time=12.0 + s, kind="node_up", node=s % 4),
+            ]))
+        elif s % 3 == 2:
+            scenarios.append(Scenario(events=[
+                NodeEvent(time=6.0 + s, kind="node_down", node=(s + 1) % 4),
+            ]))
+        else:
+            scenarios.append(Scenario())
+    eng = WhatIfEngine(
+        ec, ep, scenarios, cfg, wave_width=1, chunk_waves=1,
+        preemption="kube", retry_buffer=32, telemetry="series",
+    )
+    res = eng.run()
+    ft = res.fleet_telemetry
+    assert ft is not None, "fleet_telemetry missing from what-if result"
+    nproc, _ = dcn.process_info()
+    prefixes = {k.split("/", 1)[0] for k in ft.phases}
+    if nproc > 1 and dcn.wq_enabled():
+        # Phase timers keep the EXECUTING process's namespace (whoever
+        # won each block) — a subset of the fleet when one process
+        # drains several blocks — and the block executors stamp
+        # wq_block markers.
+        assert any(k.endswith("/wq_block") for k in ft.phases), (
+            "work-queue run lost its wq_block phase attribution"
+        )
+        assert prefixes and prefixes <= {
+            f"p{i}" for i in range(nproc)
+        }, prefixes
+    else:
+        assert prefixes == {f"p{i}" for i in range(max(nproc, 1))}, prefixes
+    return eng, {
+        "granularity": ft.granularity,
+        "latency": ft.latency,
+        "reasons": ft.reasons,
+        "rejection_attempts": ft.rejection_attempts,
+        "zero_latency_binds": int(ft.zero_latency_binds),
+        "bind_values": [float(v) for v in ft.bind_latency.values()],
+        "series_sha": _sha(
+            json.dumps(ft.series, sort_keys=True).encode()
+        ),
+        "events_len": len(ft.events),
+    }
+
+
+def case_wqfork():
+    """Round-18 work-queue over the node-sharded (round-14) leg: every
+    scenario forks from a checkpoint written by a
+    ``JaxReplayEngine(node_shards=2)`` replay, then the S=6 what-if batch
+    runs (under the queue when enabled) — placements and the collected
+    assignment matrix must bit-match the single-process oracle."""
+    from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
+    from kubernetes_simulator_tpu.models.encode import encode
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+    from kubernetes_simulator_tpu.sim.synthetic import (
+        make_cluster,
+        make_workload,
+    )
+    from kubernetes_simulator_tpu.sim.whatif import (
+        Scenario,
+        WhatIfEngine,
+        uniform_scenarios,
+    )
+
+    cluster = make_cluster(10, seed=18)
+    pods, _ = make_workload(80, seed=18, with_affinity=True, with_spread=True)
+    ec, ep = encode(cluster, pods)
+    cfg = FrameworkConfig()
+    fd, ck = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    os.unlink(ck)
+    try:
+        JaxReplayEngine(
+            ec, ep, cfg, chunk_waves=5, node_shards=2,
+        ).replay(checkpoint_path=ck, checkpoint_every=2)
+        scenarios = [Scenario()] + list(
+            uniform_scenarios(ec, 5, seed=18, p_capacity=0.5, p_taint=0.2)
+        )
+        eng = WhatIfEngine(
+            ec, ep, scenarios, cfg, chunk_waves=5,
+            collect_assignments=True, fork_checkpoint=ck,
+        )
+        res = eng.run()
+    finally:
+        if os.path.exists(ck):
+            os.unlink(ck)
+    return eng, {
+        "placed": res.placed.tolist(),
+        "unschedulable": res.unschedulable.tolist(),
+        "total_placed": int(res.total_placed),
+        "assignments_sha": _arr_sha(res.assignments),
+    }
+
+
 CASES = {
     "plain": case_plain,
     "chaos": case_chaos,
@@ -418,6 +540,8 @@ CASES = {
     "ckpt": case_ckpt,
     "odd": case_odd,
     "fleetmerge": case_fleetmerge,
+    "wqmerge": case_wqmerge,
+    "wqfork": case_wqfork,
 }
 
 

@@ -38,6 +38,63 @@ def test_parse_kill_schedule_refuses_malformed(spec):
         faultline.parse_kill_schedule(spec)
 
 
+def test_parse_slow_schedule_grammar():
+    """Round-18 straggler grammar: ``<pid>@<chunk>:<factor>`` — process
+    ``pid`` sleeps ``factor`` seconds per run-state heartbeat from chunk
+    ``chunk`` onward."""
+    assert faultline.parse_slow_schedule("") == []
+    assert faultline.parse_slow_schedule("1@2:0.5") == [(1, 2, 0.5)]
+    assert faultline.parse_slow_schedule("0@0:4, 2@1:0.25") == [
+        (0, 0, 4.0),
+        (2, 1, 0.25),
+    ]
+
+
+@pytest.mark.parametrize(
+    "spec", ["1", "1@1", "x@1:2", "-1@1:2", "1@x:2", "1@1:x", "1@1:-2"]
+)
+def test_parse_slow_schedule_refuses_malformed(spec):
+    with pytest.raises(ValueError, match="faultline slow entry"):
+        faultline.parse_slow_schedule(spec)
+
+
+def test_parse_slow_schedule_refuses_wildcard():
+    """No ``*`` in the slow grammar: a straggler is named so the
+    schedule is a pure function of the config, not a CAS race."""
+    with pytest.raises(ValueError, match="not allowed"):
+        faultline.parse_slow_schedule("*@1:2")
+
+
+def test_maybe_slow_fires_for_named_pid_in_run_state(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_DCN_PID", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_SLOW", "1@2:0.5")
+    import time as _time
+
+    naps = []
+    monkeypatch.setattr(_time, "sleep", lambda s: naps.append(s))
+    assert faultline.maybe_slow(0, "run") == 0.0  # below chunk threshold
+    assert faultline.maybe_slow(2, "gather") == 0.0  # wrong state
+    assert faultline.maybe_slow(2, "spec") == 0.0  # speculators never slowed
+    assert faultline.maybe_slow(2, "run") == 0.5
+    assert faultline.maybe_slow(3, "run") == 0.5  # every beat from thr on
+    assert naps == [0.5, 0.5]
+    assert faultline.injector().slow_count == 2
+
+
+def test_maybe_slow_other_pid_never_fires(fl_off, monkeypatch):
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_DCN_PID", "0")
+    monkeypatch.setenv("KSIM_FAULTLINE_SLOW", "1@0:5")
+    import time as _time
+
+    monkeypatch.setattr(
+        _time, "sleep",
+        lambda s: pytest.fail("slow schedule fired for another pid"),
+    )
+    assert faultline.maybe_slow(3, "run") == 0.0
+
+
 # -- injector determinism ----------------------------------------------------
 
 

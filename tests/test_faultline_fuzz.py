@@ -25,19 +25,25 @@ sys.path.insert(
 import faultline_fuzz as F  # noqa: E402
 
 SEED = 17
-N_SCHEDULES = 5
+N_SCHEDULES = 6
 
 
 def test_mandatory_schedules_always_sampled():
     """Fast sanity (no fleet): the sampler always leads with the
-    double-kill and claimant-kill drills, schedules are deterministic in
-    the seed, and sampled kills never name the coordinator."""
+    double-kill, claimant-kill, wq-straggler and wq-spec-kill drills,
+    schedules are deterministic in the seed, and sampled kills never
+    name the coordinator."""
     scheds = F.sample_schedules(SEED, N_SCHEDULES)
     assert len(scheds) == N_SCHEDULES
     assert scheds[0]["name"] == "double-kill"
     assert scheds[0]["kill"] == "1@run:0,2@run:0"
     assert scheds[1]["name"] == "claimant-kill"
     assert "*@recover" in scheds[1]["kill"]
+    assert scheds[2]["name"] == "wq-straggler"
+    assert scheds[2]["wq"] and scheds[2]["slow"] == "1@1:4"
+    assert "kill" not in scheds[2]
+    assert scheds[3]["name"] == "wq-spec-kill"
+    assert scheds[3]["wq"] and scheds[3]["kill"] == "*@spec:-1"
     assert scheds == F.sample_schedules(SEED, N_SCHEDULES)
     assert scheds != F.sample_schedules(SEED + 1, N_SCHEDULES)
     for sch in scheds:
@@ -73,4 +79,17 @@ def test_fuzz_schedules_byte_identical_to_oracle(tmp_path):
             assert killed == [1, 2], out["rcs"]
             assert "opening generation 1" in out["blob"], out["blob"][-2000:]
             assert "(gen 1)" in out["blob"], out["blob"][-2000:]
+        if sched["name"] == "wq-straggler":
+            # Nobody dies: the slowed holder is outrun by an idle
+            # process's speculative re-execution, everyone exits clean.
+            assert all(rc == 0 for rc in out["rcs"].values()), out["rcs"]
+            assert "speculates block" in out["blob"], out["blob"][-2000:]
+        if sched["name"] == "wq-spec-kill":
+            # Exactly the speculator dies (the only process that ever
+            # beacons state "spec"); the straggler's block still
+            # completes via the gen-1 lease steal.
+            killed = [p for p, rc in out["rcs"].items() if rc == -9]
+            assert len(killed) == 1, out["rcs"]
+            assert "speculates block" in out["blob"], out["blob"][-2000:]
+            assert "steals block" in out["blob"], out["blob"][-2000:]
     assert not failures, "\n".join(failures)
