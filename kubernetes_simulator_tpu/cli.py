@@ -413,6 +413,38 @@ def _faultline_errors(cfg) -> list:
     return errors
 
 
+def _overlap_errors(cfg) -> list:
+    """Actionable refusals for the ``overlap:`` section (round 19).
+    Shared by validate_config and the pre-dispatch env export in main().
+    A gate explicitly enabled on a config that lacks the machinery it
+    overlaps is refused — silently accepting it would report perfect
+    hidden wall for work that never existed."""
+    ov = getattr(cfg, "overlap", None)
+    if ov is None:
+        return []
+    errors = []
+    if ov.pager_thread and not getattr(cfg, "paged_waves", False):
+        errors.append(
+            "overlap.pagerThread: true requires pagedWaves: true — "
+            "without paged pod waves there is no pager (and no page "
+            "fetch) to move off the chunk-loop thread"
+        )
+    if ov.background_publisher:
+        rec = getattr(cfg, "dcn_recovery", None)
+        wq = getattr(cfg, "dcn_workqueue", None)
+        has_ckpt = (
+            rec is not None and rec.enable and rec.checkpoint_every >= 1
+        ) or (wq is not None and wq.enable)
+        if not has_ckpt:
+            errors.append(
+                "overlap.backgroundPublisher: true requires a checkpoint "
+                "cadence — enable dcn.recovery with checkpointEvery >= 1 "
+                "(or dcn.workQueue) so there are publications to move "
+                "off the loop thread"
+            )
+    return errors
+
+
 def validate_config(cfg) -> list:
     """Structural checks → list of actionable error strings (empty = ok)."""
     from .framework.registry import available_strategies
@@ -668,6 +700,7 @@ def validate_config(cfg) -> list:
     errors.extend(_recovery_errors(cfg))
     errors.extend(_workqueue_errors(cfg))
     errors.extend(_faultline_errors(cfg))
+    errors.extend(_overlap_errors(cfg))
     return errors
 
 
@@ -787,6 +820,24 @@ def main(argv=None) -> int:
                 os.environ.setdefault("KSIM_FAULTLINE_KILL", str(fl.kill))
             if getattr(fl, "slow", None):
                 os.environ.setdefault("KSIM_FAULTLINE_SLOW", str(fl.slow))
+        # Overlap gates (round 19, overlap:) ride the same pre-dispatch
+        # export. Engines default every gate ON, so only explicit values
+        # are exported — a None field stays the engine default, and an
+        # operator's explicit env still wins (setdefault).
+        ov = getattr(cfg_pre, "overlap", None) if cfg_pre is not None else None
+        if ov is not None:
+            errors = _overlap_errors(cfg_pre)
+            if errors:
+                for e in errors:
+                    log.error("config: %s", e)
+                return 2
+            for val, env in (
+                (ov.pager_thread, "KSIM_PAGER_THREAD"),
+                (ov.background_publisher, "KSIM_DCN_CKPT_ASYNC"),
+                (ov.two_phase_exchange, "KSIM_TWO_PHASE_EXCHANGE"),
+            ):
+                if val is not None:
+                    os.environ.setdefault(env, "1" if val else "0")
     # Multi-host DCN bring-up (round 11): a no-op without the
     # KSIM_DCN_* env set by scripts/dcn_launch.py. Enables the compile
     # cache BEFORE jax.distributed.initialize (documented ordering).

@@ -26,6 +26,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -1283,16 +1284,62 @@ def apply_unbind_wave(
 # ---------------------------------------------------------------------------
 
 
+def two_phase_exchange() -> bool:
+    """Round-19 A/B gate for the slim two-phase selection exchange.
+    Read at TRACE time (engine build), not import time, so tests and the
+    ``overlap:`` config section can flip it per engine: set
+    ``KSIM_TWO_PHASE_EXCHANGE=0`` before building an engine to compile
+    the legacy single-gather program."""
+    return os.environ.get("KSIM_TWO_PHASE_EXCHANGE", "1") not in ("", "0")
+
+
+def exchange_payload_bytes(nshards: int, groups: int, two_phase: bool) -> int:
+    """Bytes RECEIVED per shard per selection slot by the exchange —
+    the latency-proportional payload scaling_probe/bench pin.
+
+    Legacy single-phase: one all_gather of a ``[2 + 2G]`` f32 row from
+    every shard. Two-phase: an all_gather of the ``[2]`` f32
+    (score, gid) pair plus an all-reduce of the owner-masked ``[2G]``
+    f32 domain row, charged at the standard ring all-reduce cost of
+    2·(n−1)/n of the row per shard — so the two-phase payload equals
+    legacy at n = 2 (the reduce degenerates to a peer swap) and is
+    strictly smaller at every n ≥ 3."""
+    g2 = 2 * int(groups)
+    n = max(int(nshards), 1)
+    if n <= 1:
+        return 0  # no collective compiles on a single shard
+    if not two_phase:
+        return 4 * (n - 1) * (2 + g2)
+    return 4 * ((n - 1) * 2 + (2 * (n - 1) * g2) // n)
+
+
 def select_node_sharded(
     scores: jax.Array, feasible: jax.Array, gdom_f: jax.Array, ctx: ShardCtx
 ):
     """Two-stage select over node shards → (choice GLOBAL i32, placed,
-    gdom_at [G] f32, has_dom [G] f32). The all_gather row is
-    [2 + 2G] f32 per shard — the only cross-device exchange a
-    normalization-free (fit-only) trace compiles in the whole chunk
-    loop. Bit-identical to :func:`select_node` on the unsharded planes:
-    global node ids < 2²⁴ are exact in f32 and the (max score, min id)
-    fold reproduces numpy's first-occurrence argmax."""
+    gdom_at [G] f32, has_dom [G] f32). Bit-identical to
+    :func:`select_node` on the unsharded planes: global node ids < 2²⁴
+    are exact in f32 and the (max score, min id) fold reproduces numpy's
+    first-occurrence argmax.
+
+    Two exchange programs compile behind :func:`two_phase_exchange`:
+
+    * legacy (round 14): ONE all_gather of a ``[2 + 2G]`` f32 row
+      (score, gid, domain row) per shard, folded statically.
+    * two-phase (round 19): phase 1 all_gathers only the ``[2]`` f32
+      (score, gid) pair and folds the winner — replicated on every
+      shard; phase 2 moves the winner's ``[2G]`` domain row with a
+      single owner-selected exchange, a psum of the row masked to the
+      owner shard (``winner_gid // n_local`` — shards are contiguous
+      blocks, and the owner's LOCAL argmax IS the global winner, so its
+      candidate row is exactly the winner's row). The mask makes every
+      non-owner contribution ±0.0, so the f32 sum returns the owner's
+      row exactly; when nothing is feasible anywhere the psum of
+      all-masked rows is the same zero row the legacy fold returns, and
+      downstream ``has_dom > 0.5`` gates keep it inert. Payload per
+      shard drops from ``nshards·(2+2G)`` to ``nshards·2 + ~2·2G`` f32
+      per slot — the latency term the ROADMAP flags at 40+ shards.
+    """
     masked = jnp.where(feasible, scores, NEG_INF)
     iota = jax.lax.broadcasted_iota(jnp.int32, masked.shape, masked.ndim - 1)
 
@@ -1319,18 +1366,41 @@ def select_node_sharded(
     hasdom_cand = jnp.einsum(
         "gn,n->g", (gdom_f >= 0).astype(jnp.float32), oh, precision=_HI
     )
-    row = jnp.concatenate([mx[None], gid_f[None], gdom_cand, hasdom_cand])
-    allrows = jax.lax.all_gather(row, ctx.axis)  # [nshards, 2 + 2G]
-    best = allrows[0]
-    for k in range(1, ctx.nshards):
-        cand = allrows[k]
-        better = (cand[0] > best[0]) | ((cand[0] == best[0]) & (cand[1] < best[1]))
-        best = jnp.where(better, cand, best)
     G = gdom_f.shape[0]
+
+    def fold(rows):
+        best = rows[0]
+        for k in range(1, ctx.nshards):
+            cand = rows[k]
+            better = (cand[0] > best[0]) | (
+                (cand[0] == best[0]) & (cand[1] < best[1])
+            )
+            best = jnp.where(better, cand, best)
+        return best
+
+    if not two_phase_exchange():
+        row = jnp.concatenate([mx[None], gid_f[None], gdom_cand, hasdom_cand])
+        best = fold(jax.lax.all_gather(row, ctx.axis))  # [nshards, 2 + 2G]
+        placed = best[0] > NEG_INF
+        choice = jnp.where(placed, best[1], 0.0).astype(jnp.int32)
+        choice = jnp.where(placed, choice, PAD)
+        return choice, placed, best[2 : 2 + G], best[2 + G : 2 + 2 * G]
+
+    # Phase 1: winner election on the [2] f32 (score, gid) pair only.
+    best = fold(jax.lax.all_gather(jnp.stack([mx, gid_f]), ctx.axis))
     placed = best[0] > NEG_INF
     choice = jnp.where(placed, best[1], 0.0).astype(jnp.int32)
+    # Phase 2: owner-selected domain-row exchange. The owner's local
+    # candidate row is the winner's row; everyone else contributes ±0.0.
+    owner = choice // np.int32(ctx.n_local)
+    mine = (
+        (jax.lax.axis_index(ctx.axis).astype(jnp.int32) == owner) & placed
+    ).astype(jnp.float32)
+    dom = jax.lax.psum(
+        jnp.concatenate([gdom_cand, hasdom_cand]) * mine, ctx.axis
+    )
     choice = jnp.where(placed, choice, PAD)
-    return choice, placed, best[2 : 2 + G], best[2 + G : 2 + 2 * G]
+    return choice, placed, dom[:G], dom[G:]
 
 
 def apply_binding_sharded(

@@ -829,6 +829,149 @@ def publish_checkpoint(
         return False
 
 
+# -- background checkpoint publication (round 19) ---------------------------
+#
+# ``publish_checkpoint`` serializes encode (pack→pickle→zlib→base64),
+# CRC framing and the retried KV sets on the caller's thread — on the
+# chunk loop that is exposed wall at every checkpoint cadence. The
+# publisher below moves everything AFTER the device→host snapshot onto
+# one daemon thread with single-flight, newest-wins coalescing: at most
+# one publication runs at a time, at most one waits, and a newer
+# snapshot submitted while one is waiting replaces it (the KV plane
+# only ever needs the newest durable cursor; recovery from an older
+# cursor is always correct, just re-executes more chunks). Boundaries
+# that need a DURABLE cursor — replay end before the final gather, a
+# work-queue block completion — call :func:`drain_publisher`.
+#
+# Failure semantics match the synchronous path exactly: the worker runs
+# the same defensive :func:`publish_checkpoint` (KV give-ups are
+# swallowed, faultline's transient-KV drills keep passing), while a
+# genuinely unexpected error is stored and re-raised attributed at the
+# next loop touch (submit or drain). A SIGKILL mid-publication leaves
+# the prior cursor loadable because the manifest key is written LAST —
+# the same torn-blob story the CRC stack already covers.
+
+BG_PUBLISH_STATS = {
+    "submitted": 0,
+    "coalesced": 0,
+    "drains": 0,
+    "drain_wait_s": 0.0,
+}
+
+
+def bg_publish_stats() -> dict:
+    """Snapshot of :data:`BG_PUBLISH_STATS` (copy — callers diff it)."""
+    return dict(BG_PUBLISH_STATS)
+
+
+def ckpt_async_enabled() -> bool:
+    """Round-19 A/B gate for the background publisher (default ON).
+    ``KSIM_DCN_CKPT_ASYNC=0`` keeps every publication synchronous on
+    the loop thread, exactly as rounds 17–18 ran it."""
+    return os.environ.get("KSIM_DCN_CKPT_ASYNC", "1") not in ("", "0")
+
+
+class _CheckpointPublisher:
+    """Single-flight newest-wins publisher thread. Lazy: the daemon
+    thread starts at the first submit, so single-process and overlap-off
+    runs never spawn it."""
+
+    def __init__(self):
+        import threading
+
+        self._cv = threading.Condition()
+        self._pending = None  # (cursor, payload, block, epoch)
+        self._busy = False
+        self._error = None  # (cursor, exception) — re-raised on touch
+        self._thread = None
+        self._threading = threading
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = self._threading.Thread(
+                target=self._run, name="ksim-ckpt-publisher", daemon=True
+            )
+            self._thread.start()
+
+    def _raise_stored(self) -> None:
+        err = self._error
+        if err is not None:
+            self._error = None
+            cursor, exc = err
+            raise RuntimeError(
+                f"dcn: background checkpoint publication failed at "
+                f"cursor {cursor}"
+            ) from exc
+
+    def submit(self, cursor, payload, block, epoch) -> None:
+        self._raise_stored()
+        with self._cv:
+            if self._pending is not None:
+                BG_PUBLISH_STATS["coalesced"] += 1
+            self._pending = (cursor, payload, block, epoch)
+            BG_PUBLISH_STATS["submitted"] += 1
+            self._ensure_thread()
+            self._cv.notify_all()
+
+    def drain(self) -> None:
+        """Block until nothing is pending or in flight — the durable-
+        cursor boundary. Re-raises a stored worker error."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while self._busy or self._pending is not None:
+                self._cv.wait(timeout=0.5)
+        BG_PUBLISH_STATS["drains"] += 1
+        BG_PUBLISH_STATS["drain_wait_s"] += time.perf_counter() - t0
+        self._raise_stored()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None:
+                    self._cv.wait()
+                job, self._pending = self._pending, None
+                self._busy = True
+            try:
+                publish_checkpoint(job[0], job[1], job[2], epoch=job[3])
+            except BaseException as e:  # publish_checkpoint is defensive
+                self._error = (job[0], e)  # pragma: no cover
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+
+_PUBLISHER = _CheckpointPublisher()
+
+
+def publish_checkpoint_async(
+    cursor: int, payload, block: tuple, epoch: Optional[int] = None
+) -> bool:
+    """Round-19 entry point for chunk-cadence publications: hand the
+    (already host-resident) payload to the single-flight publisher
+    thread and return immediately. Falls back to the synchronous
+    :func:`publish_checkpoint` when the gate is off; no-ops outside DCN
+    like every coordination call. Returns True when the publication was
+    queued or synchronously pushed."""
+    nproc, _pid = process_info()
+    if nproc <= 1:
+        return False
+    if not ckpt_async_enabled():
+        return publish_checkpoint(cursor, payload, block, epoch=epoch)
+    _PUBLISHER.submit(cursor, payload, block, epoch)
+    return True
+
+
+def drain_publisher() -> None:
+    """Wait for every queued background publication to finish (or be
+    coalesced away) — call wherever a durable cursor is required:
+    replay end before the final heartbeat/gather, work-queue block
+    completion. Cheap when nothing is queued; re-raises an unexpected
+    publisher error attributed to this loop touch."""
+    if ckpt_async_enabled():
+        _PUBLISHER.drain()
+
+
 def load_checkpoint(
     pid: int, epoch: Optional[int] = None, before_cursor: Optional[int] = None
 ):

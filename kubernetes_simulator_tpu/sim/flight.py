@@ -39,14 +39,20 @@ from .telemetry import PhaseTimers
 # (kept PRESENT as numbers so schema-v5 validation still sees them).
 # Values inside the "phases" delta dict are zeroed too — phase timers
 # are perf_counter deltas. Everything else in a flight row (chunk
-# cursor, virtual time, dispatch/placement counts, pager stall COUNTS,
-# prefetch depth, checkpoint blob bytes, residency estimate) is
-# deterministic for a fixed seed and stays.
+# cursor, virtual time, dispatch/placement counts, pager stall/
+# invalidation COUNTS, prefetch depth, checkpoint blob bytes, residency
+# estimate) is deterministic for a fixed seed and stays.
+# ``pager_waits`` is a COUNT but rides this list anyway: whether a
+# threaded prefetch finished before ``get`` asked is a race outcome
+# (round 19), unlike miss/invalidation counts which are structural.
 FLIGHT_WALL_FIELDS = (
     "wall_s",
     "rolling_pps",
     "stall_s",
     "pager_stall_s",
+    "pager_prefetch_s",
+    "pager_wait_s",
+    "pager_waits",
     "exchange_probe_s",
     "exchange_est_s",
     "ckpt_wall_s",
@@ -219,6 +225,22 @@ class FlightRecorder:
             row["pager_stall_s"] = round(
                 float(getattr(pager, "stall_s", 0.0)), 6
             )
+            # Round-19 overlap ledger: the prefetch fetches' own wall
+            # (hidden when the pager thread is on, loop-exposed when
+            # off), blocking waits on in-flight prefetches, and staged
+            # pages invalidated by resume jumps. Always present so the
+            # stream is byte-identical threaded on vs off under the
+            # deterministic scrub.
+            row["pager_prefetch_s"] = round(
+                float(getattr(pager, "prefetch_wall_s", 0.0)), 6
+            )
+            row["pager_waits"] = int(getattr(pager, "waits", 0))
+            row["pager_wait_s"] = round(
+                float(getattr(pager, "wait_s", 0.0)), 6
+            )
+            row["pager_invalidations"] = int(
+                getattr(pager, "invalidations", 0)
+            )
         if exchange_probe_s is not None:
             row["exchange_probe_s"] = round(float(exchange_probe_s), 6)
             if exchange_slots:
@@ -232,19 +254,26 @@ class FlightRecorder:
             row["dcn_retry"] = dict(kv_retry)
         self._emit(row)
 
-    def page(self, ci: int, stall_s: float, stalls: int) -> None:
+    def page(
+        self, ci: int, stall_s: float, stalls: int,
+        invalidations: Optional[int] = None,
+    ) -> None:
         """A pager prefetch MISS (the synchronous fetch the prefetch
         exists to hide) — emitted per stall, they are the exceptional
-        case the report looks for."""
-        self._emit(
-            {
-                "event": "page",
-                "chunk": int(ci),
-                "stall_s": round(float(stall_s), 6),
-                "pager_stalls": int(stalls),
-                "wall_s": round(time.perf_counter() - self._t0, 6),
-            }
-        )
+        case the report looks for. ``invalidations`` (round 19) rides
+        along when a resume jump discarded the staged page: previously
+        that surfaced as a plain stall, under-reporting what the pager
+        threw away."""
+        row = {
+            "event": "page",
+            "chunk": int(ci),
+            "stall_s": round(float(stall_s), 6),
+            "pager_stalls": int(stalls),
+            "wall_s": round(time.perf_counter() - self._t0, 6),
+        }
+        if invalidations:
+            row["pager_invalidations"] = int(invalidations)
+        self._emit(row)
 
     def checkpoint(
         self, ci: int, nbytes: int, wall_s: float, sink: str = "local"
@@ -326,10 +355,20 @@ class FlightRecorder:
                     row[k] = 0.0
             if isinstance(row.get("phases"), dict):
                 row["phases"] = {k: 0.0 for k in row["phases"]}
+            # Round 19: with the background publisher and the retrying
+            # publisher thread interleaving KV traffic with the loop,
+            # WHICH chunk row a publish/retry delta lands on is a race
+            # outcome — every numeric in these blocks is scrubbed, not
+            # just the ``_s`` walls.
             for blk in ("dcn_publish", "dcn_retry"):
                 if isinstance(row.get(blk), dict):
                     row[blk] = {
-                        k: (0.0 if k.endswith("_s") else v)
+                        k: (
+                            (0.0 if isinstance(v, float) else 0)
+                            if isinstance(v, (int, float))
+                            and not isinstance(v, bool)
+                            else v
+                        )
                         for k, v in row[blk].items()
                     }
         try:

@@ -23,6 +23,7 @@ this module via ``vmap``/``shard_map`` (:mod:`.whatif`, :mod:`..parallel`).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -608,6 +609,15 @@ def replicated_resident_bytes(
     return total
 
 
+def _pager_thread_enabled() -> bool:
+    """Round-19 A/B gate for the threaded pager. Read at pager
+    construction (every ``replay()`` builds a fresh pager), so tests and
+    the ``overlap:`` config section flip it per run: set
+    ``KSIM_PAGER_THREAD=0`` to fetch pages on the chunk-loop thread as
+    rounds 14–18 did."""
+    return os.environ.get("KSIM_PAGER_THREAD", "1") not in ("", "0")
+
+
 class _PodPager:
     """Rolling two-deep host→device page prefetcher (round 14 paged pod
     waves): ``get(ci)`` returns chunk ci's staged page (staging it now if
@@ -616,21 +626,46 @@ class _PodPager:
     are issued while the device is still scanning — the paged twin of the
     double-buffered boundary staging.
 
-    Round 16 attribution (flight recorder): ``stalls`` counts prefetch
-    misses (synchronous fetches the pipeline had to wait for),
-    ``stall_s`` is their cumulative wall, ``prefetches`` counts issued
-    prefetches and ``last_stall_s`` the most recent miss's wall. The
-    counters are pure host bookkeeping around the existing fetch — the
-    staged pages and fetch order are unchanged, so paged placements stay
-    bit-identical with or without anyone reading them."""
+    Round 19 (``threaded=True``, the default via ``KSIM_PAGER_THREAD``):
+    ``prefetch`` hands the encode/pack + ``device_put`` to ONE background
+    worker (a bounded single-slot hand-off — the queue depth stays 2
+    counting the in-flight chunk's own page), so a prefetch only costs
+    loop wall when the fetch genuinely outruns chunk compute. Pages are
+    pure functions of the chunk index, so the staged values are
+    bit-identical wherever the fetch runs. Attribution:
 
-    def __init__(self, fetch):
+    * ``stalls`` / ``stall_s`` — EXPOSED wall: synchronous misses plus
+      (threaded) blocking waits on a still-in-flight prefetch. Miss
+      COUNTS are deterministic (first chunk, resume jumps); wait counts
+      ride ``waits`` because whether a wait occurs is a race outcome.
+    * ``prefetch_wall_s`` — the prefetch fetches' own wall: HIDDEN when
+      threaded, loop-exposed when not. Overlap efficiency is
+      ``prefetch_wall_s / (prefetch_wall_s + stall_s)`` under threading.
+    * ``invalidations`` — staged pages discarded because ``get`` asked
+      for a different chunk (a resume jump): the stale page is dropped
+      and the requested fetch re-issued instead of silently serving a
+      plain miss (round-19 fix — previously indistinguishable from a
+      cold stall in the flight ``page`` rows)."""
+
+    def __init__(self, fetch, threaded: bool = False):
         self._fetch = fetch
-        self._next = None
+        self._next = None  # (ci, page) or (ci, Future) when threaded
         self.stalls = 0
         self.stall_s = 0.0
         self.last_stall_s = 0.0
         self.prefetches = 0
+        self.waits = 0
+        self.wait_s = 0.0
+        self.prefetch_wall_s = 0.0
+        self.invalidations = 0
+        self.threaded = bool(threaded)
+        self._pool = None
+        if self.threaded:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ksim-pager"
+            )
 
     @property
     def depth(self) -> int:
@@ -638,21 +673,70 @@ class _PodPager:
         two-deep counting the in-flight chunk's own page)."""
         return 0 if self._next is None else 1
 
-    def get(self, ci: int):
-        if self._next is not None and self._next[0] == ci:
-            page = self._next[1]
-        else:
-            t0 = time.perf_counter()
-            page = self._fetch(ci)
-            self.last_stall_s = time.perf_counter() - t0
-            self.stall_s += self.last_stall_s
-            self.stalls += 1
-        self._next = None
+    def _timed_fetch(self, ci: int):
+        # Runs on the worker thread when threaded — its wall is the
+        # HIDDEN side of the overlap ledger.
+        t0 = time.perf_counter()
+        page = self._fetch(ci)
+        self.prefetch_wall_s += time.perf_counter() - t0
         return page
+
+    def _resolve(self, staged):
+        """Staged entry → page, charging any blocking wait as exposed
+        stall wall (the fetch outran chunk compute)."""
+        from concurrent.futures import Future
+
+        if not isinstance(staged, Future):
+            return staged
+        if staged.done():
+            return staged.result()
+        t0 = time.perf_counter()
+        page = staged.result()
+        dt = time.perf_counter() - t0
+        self.waits += 1
+        self.wait_s += dt
+        self.stall_s += dt
+        self.last_stall_s = dt
+        return page
+
+    def get(self, ci: int):
+        staged, self._next = self._next, None
+        if staged is not None and staged[0] != ci:
+            # Resume jump: the staged page is for another chunk. Drop it
+            # (draining the worker so the single slot is free again) and
+            # re-issue the fetch for the chunk actually requested.
+            self.invalidations += 1
+            try:
+                self._resolve_quietly(staged[1])
+            except Exception:
+                pass
+            staged = None
+        if staged is not None:
+            return self._resolve(staged[1])
+        t0 = time.perf_counter()
+        page = self._fetch(ci)
+        self.last_stall_s = time.perf_counter() - t0
+        self.stall_s += self.last_stall_s
+        self.stalls += 1
+        return page
+
+    def _resolve_quietly(self, staged) -> None:
+        from concurrent.futures import Future
+
+        if isinstance(staged, Future) and not staged.cancel():
+            staged.result()
 
     def prefetch(self, ci: int) -> None:
         self.prefetches += 1
-        self._next = (ci, self._fetch(ci))
+        if self._pool is not None:
+            self._next = (ci, self._pool.submit(self._timed_fetch, ci))
+        else:
+            self._next = (ci, self._timed_fetch(ci))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
 
 def make_chunk_fn3_src(static3, shared3, rep_slots, wave_width: int, spec: StepSpec):
@@ -1205,33 +1289,49 @@ class JaxReplayEngine:
 
     def _make_exchange_probe(self):
         """Timed probe of the per-slot selection exchange (round 16):
-        a jitted shard_map running the EXACT collective the sharded wave
-        step compiles — one ``all_gather`` of a ``[2 + 2G]`` f32 row
-        over the node axis plus the static (max score, min id) fold
-        (ops.tpu.select_node_sharded). The production chunk program is
-        untouched (the exchange runs inside its scan, where a host clock
-        cannot reach without changing the compiled program — and the
-        compiled program is exactly what bit-parity pins); the probe
-        prices one exchange round at chunk cadence, and the recorder
-        scales it by the chunk's slot count for the per-chunk estimate.
-        Returns a zero-arg callable → seconds for one probed round."""
+        a jitted shard_map running the EXACT collective shape the sharded
+        wave step compiles (ops.tpu.select_node_sharded) — legacy: one
+        ``all_gather`` of a ``[2 + 2G]`` f32 row plus the static
+        (max score, min id) fold; two-phase (round 19, the default): the
+        ``[2]`` f32 all_gather + fold, then the owner-masked ``[2G]``
+        psum. The production chunk program is untouched (the exchange
+        runs inside its scan, where a host clock cannot reach without
+        changing the compiled program — and the compiled program is
+        exactly what bit-parity pins); the probe prices one exchange
+        round at chunk cadence, and the recorder scales it by the
+        chunk's slot count for the per-chunk estimate. Returns a
+        zero-arg callable → seconds for one probed round."""
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
         G = max(self.ec.num_groups, 1)
         n = self.node_shards
         axis = self._shard_ctx.axis
+        two_phase = T.two_phase_exchange()
 
-        def body(row):
-            allrows = jax.lax.all_gather(row, axis)
-            best = allrows[0]
+        def fold(rows):
+            best = rows[0]
             for k in range(1, n):
-                cand = allrows[k]
+                cand = rows[k]
                 better = (cand[0] > best[0]) | (
                     (cand[0] == best[0]) & (cand[1] < best[1])
                 )
                 best = jnp.where(better, cand, best)
             return best
+
+        def body(row):
+            if not two_phase:
+                return fold(jax.lax.all_gather(row, axis))
+            best = fold(jax.lax.all_gather(row[:2], axis))
+            placed = best[0] > T.NEG_INF
+            owner = jnp.where(placed, best[1], 0.0).astype(jnp.int32) // (
+                np.int32(max(self._shard_ctx.n_local, 1))
+            )
+            mine = (
+                (jax.lax.axis_index(axis).astype(jnp.int32) == owner)
+                & placed
+            ).astype(jnp.float32)
+            return best, jax.lax.psum(row[2:] * mine, axis)
 
         fn = jax.jit(
             shard_map(
@@ -2142,7 +2242,7 @@ class JaxReplayEngine:
                     return T.gather_slots(
                         self.pods, idx[pci * C : (pci + 1) * C]
                     )
-            pager = _PodPager(_fetch_page)
+            pager = _PodPager(_fetch_page, threaded=_pager_thread_enabled())
         rec_valid = (
             np.add.accumulate(
                 [
@@ -2154,6 +2254,7 @@ class JaxReplayEngine:
             else None
         )
         rec_stalls_seen = 0
+        rec_inval_seen = 0
         rec_pub = None
         rec_retry = None
         if rec is not None:
@@ -2284,9 +2385,16 @@ class JaxReplayEngine:
                         time.perf_counter() - t_ck,
                     )
             if rec is not None:
-                if pager is not None and pager.stalls > rec_stalls_seen:
-                    rec.page(ci, pager.last_stall_s, pager.stalls)
+                if pager is not None and (
+                    pager.stalls > rec_stalls_seen
+                    or pager.invalidations > rec_inval_seen
+                ):
+                    rec.page(
+                        ci, pager.last_stall_s, pager.stalls,
+                        invalidations=pager.invalidations,
+                    )
                     rec_stalls_seen = pager.stalls
+                    rec_inval_seen = pager.invalidations
                 ex_s = probe() if probe is not None else None
                 if ex_s is not None and tel is not None:
                     tel.phases.add("selection_exchange", ex_s)
@@ -2415,13 +2523,19 @@ class JaxReplayEngine:
             bound=assignments.copy(),
         )
         if rec is not None:
-            # Pager-stall wall joins the phase accumulators (a key only
-            # present when paging is on AND the recorder observed it, so
-            # the canonical PHASE_NAMES-only runs are unchanged).
+            # Pager walls join the phase accumulators (keys only present
+            # when paging is on AND the recorder observed them, so the
+            # canonical PHASE_NAMES-only runs are unchanged).
+            # ``pager_stall`` is the EXPOSED wall; ``pager_prefetch`` the
+            # fetch wall itself — hidden under the round-19 thread,
+            # loop-exposed without it.
             if pager is not None and tel is not None:
                 tel.phases.add("pager_stall", pager.stall_s)
+                tel.phases.add("pager_prefetch", pager.prefetch_wall_s)
             if rec_own:
                 rec.close({"placed": int(placed)})
+        if pager is not None:
+            pager.close()
         return ReplayResult(
             assignments=assignments,
             placed=placed,

@@ -2937,6 +2937,7 @@ class WhatIfEngine:
         # THIS run's publications, KV retries and CRC fallbacks (a prior
         # run in the same process must not leak into the phase map).
         _ps_start = dcn.publish_stats()
+        _bg_start = dcn.bg_publish_stats()
         _rs_start = dcn.retry_stats()
         _cs_start = dcn.crc_stats()
         import contextlib as _ctxlib
@@ -3105,8 +3106,14 @@ class WhatIfEngine:
             if ck_every and ci and ci % ck_every == 0:
                 from .jax_runtime import snapshot_carriers
 
+                # Round-19 split: only the device→host snapshot stays on
+                # the loop thread (it must see the state exactly as of
+                # chunk ci); encode + CRC framing + the retried KV sets
+                # ride the single-flight publisher thread, newest-wins.
+                # Drained before the final gather below — the one place
+                # this leg needs a durable cursor.
                 with run_phases.tick("checkpoint"):
-                    dcn.publish_checkpoint(
+                    dcn.publish_checkpoint_async(
                         ci,
                         {
                             "cursor": ci,
@@ -3388,6 +3395,15 @@ class WhatIfEngine:
                 hs["alloc"][...] = ksaved_alloc
         with run_phases.tick("device_wait"), _pann("device_wait"):
             jax.block_until_ready(states)
+        if ck_every:
+            # Round-19 durable-cursor boundary: every queued background
+            # publication must be on the KV plane before this process
+            # beacons "gather" / completes its work-queue block — a
+            # sibling recovering after that point may only be offered
+            # cursors that are actually complete. Drain wall is exposed
+            # loop wall, attributed to the checkpoint phase.
+            with run_phases.tick("checkpoint"):
+                dcn.drain_publisher()
         wall = time.perf_counter() - t0
 
         to_schedule = int((idx >= 0).sum())
@@ -3579,6 +3595,23 @@ class WhatIfEngine:
                 )
                 fleet_local.phases["ckpt_publish_mib"] = round(
                     (_ps["bytes"] - _ps_start["bytes"]) / 2**20, 3
+                )
+            # Background-publisher attribution (round 19): submissions,
+            # newest-wins coalesces and drain wall — with the publisher
+            # on, ``ckpt_publish`` above is HIDDEN (worker-thread) wall
+            # and the drain wait is the only exposed remainder. Only
+            # present when the publisher actually ran, so overlap-off
+            # and single-process runs keep the pinned phase set.
+            _bg = dcn.bg_publish_stats()
+            if _bg["submitted"] > _bg_start["submitted"]:
+                fleet_local.phases["ckpt_publish_bg_submitted"] = float(
+                    _bg["submitted"] - _bg_start["submitted"]
+                )
+                fleet_local.phases["ckpt_publish_bg_coalesced"] = float(
+                    _bg["coalesced"] - _bg_start["coalesced"]
+                )
+                fleet_local.phases["ckpt_publish_drain_s"] = round(
+                    _bg["drain_wait_s"] - _bg_start["drain_wait_s"], 6
                 )
             # Faultline attribution (round 17): KV retries burned and CRC
             # fallbacks taken during THIS run ride the same phase map,

@@ -227,6 +227,32 @@ class FaultlineSpec:
 
 
 @dataclass
+class OverlapSpec:
+    """Overlap plane (``overlap:`` YAML section, round 19). Config-level
+    spelling of the three stall-hiding gates — each defaults ON in the
+    engines; a field left None inherits the engine/env default, an
+    explicit false exports the opt-out BEFORE ``jax.distributed``
+    bring-up (setdefault — an operator's explicit env wins):
+
+    * ``pagerThread`` → ``KSIM_PAGER_THREAD`` (sim.jax_runtime): run the
+      pod-page encode/pack + device_put on a background worker. Requires
+      ``pagedWaves: true`` when explicitly enabled.
+    * ``backgroundPublisher`` → ``KSIM_DCN_CKPT_ASYNC`` (parallel.dcn):
+      single-flight newest-wins checkpoint publication off the loop
+      thread. Requires a checkpoint cadence (``dcn.recovery:
+      checkpointEvery >= 1`` or a work queue) when explicitly enabled.
+    * ``twoPhaseExchange`` → ``KSIM_TWO_PHASE_EXCHANGE`` (ops.tpu): slim
+      two-phase selection exchange under ``nodeShards``.
+
+    All three are bit-parity pinned (tests/test_overlap.py): placements,
+    deterministic JSONL and checkpoint blobs are identical on vs off."""
+
+    pager_thread: Optional[bool] = None
+    background_publisher: Optional[bool] = None
+    two_phase_exchange: Optional[bool] = None
+
+
+@dataclass
 class TelemetrySpec:
     """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
 
@@ -285,6 +311,9 @@ class SimConfig:
     # default — the recorder is bit-parity pinned but still costs a
     # stream).
     flight_recorder: Optional[FlightRecorderSpec] = None
+    # Overlap plane (round 19): the three stall-hiding gates. None = all
+    # engine defaults (on).
+    overlap: Optional[OverlapSpec] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SimConfig":
@@ -458,6 +487,24 @@ class SimConfig:
             cfg.flight_recorder = FlightRecorderSpec(
                 path=str(fr.get("path", "flight.jsonl")),
                 every=int(fr.get("every", 1)),
+            )
+        ov = d.get("overlap")
+        if ov is not None:
+
+            def _tristate(key: str) -> Optional[bool]:
+                v = ov.get(key)
+                if v is None:
+                    return None
+                if isinstance(v, (bool, int)):
+                    return bool(v)
+                raise ValueError(
+                    f"overlap.{key}: must be true or false, got {v!r}"
+                )
+
+            cfg.overlap = OverlapSpec(
+                pager_thread=_tristate("pagerThread"),
+                background_publisher=_tristate("backgroundPublisher"),
+                two_phase_exchange=_tristate("twoPhaseExchange"),
             )
         return cfg
 

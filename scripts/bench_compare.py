@@ -34,6 +34,12 @@ BENCH_r01..rNN naturally). Each adjacent pair is diffed on:
   or a reshape is informational, and the wall / pager-stall / memory-
   watermark lines (top-level ``rss_peak_mib`` /
   ``replicated_resident_peak_mib``) never gate;
+- overlap accounting (``detail.borg_headline.overlap``, round 19):
+  exposed pager-stall growth beyond the threshold prints a loud
+  REGRESSION note but never gates — pps remains the only headline gate;
+  exchange-sweep files (``exchange_sweep`` key, written by
+  ``scripts/scaling_probe.py --exchange``) ARE gated: per-slot selection
+  payload bytes growing at any matching node_shards point exits nonzero;
 - faultline hardening costs (``detail.fault_injection``, round 17):
   retry-helper wall, CRC framing overhead and the torn-blob fallback
   recovery wall under a fixed injected schedule — printed
@@ -60,8 +66,13 @@ def load_bench(path: str) -> dict:
         doc = json.load(f)
     if isinstance(doc, dict) and "parsed" in doc:
         doc = doc["parsed"]
-    if not isinstance(doc, dict) or "value" not in doc:
-        raise ValueError(f"{path}: not a bench result (no 'value' field)")
+    if not isinstance(doc, dict) or (
+        "value" not in doc and "exchange_sweep" not in doc
+    ):
+        raise ValueError(
+            f"{path}: not a bench result (no 'value' or 'exchange_sweep' "
+            "field)"
+        )
     return doc
 
 
@@ -77,12 +88,62 @@ def phase_shares(detail: dict) -> dict:
     return {k: v / total for k, v in vals.items()}
 
 
+def _sweep_points(doc: dict) -> Optional[dict]:
+    """{node_shards: point} for an exchange-sweep file, else None."""
+    sw = doc.get("exchange_sweep")
+    if not isinstance(sw, dict):
+        return None
+    return {
+        int(p["node_shards"]): p
+        for p in sw.get("points", [])
+        if isinstance(p, dict) and "node_shards" in p
+    }
+
+
 def compare_pair(
     name_a: str, a: dict, name_b: str, b: dict, threshold: float
 ) -> Tuple[List[str], List[str]]:
     """(regressions, notes) for the pair old=a → new=b."""
     regressions: List[str] = []
     notes: List[str] = []
+
+    # Exchange-sweep files (round 19, scripts/scaling_probe.py
+    # --exchange): per-slot selection-exchange payload bytes pinned at
+    # each node_shards point. Payload GROWTH at any matching point is a
+    # gating regression — the two-phase slimming must not silently
+    # regress — while wall moves are informational (probe walls on
+    # shared CI hosts are noisy).
+    ea, eb = _sweep_points(a), _sweep_points(b)
+    if ea is not None or eb is not None:
+        if ea is None or eb is None:
+            notes.append(
+                "exchange_sweep: only one side is a sweep file — "
+                "nothing compared"
+            )
+            return regressions, notes
+        for n in sorted(set(ea) & set(eb)):
+            pa_b, pb_b = ea[n].get("payload_bytes"), eb[n].get("payload_bytes")
+            if isinstance(pa_b, (int, float)) and isinstance(
+                pb_b, (int, float)
+            ):
+                line = (
+                    f"exchange payload_bytes @{n} shards: "
+                    f"{pa_b} -> {pb_b}"
+                )
+                if pb_b > pa_b:
+                    regressions.append(line + "  REGRESSION (payload grew)")
+                else:
+                    notes.append(line)
+            wa_s, wb_s = ea[n].get("wall_s"), eb[n].get("wall_s")
+            if isinstance(wa_s, (int, float)) and isinstance(
+                wb_s, (int, float)
+            ):
+                notes.append(
+                    f"exchange wall_s @{n} shards: {wa_s} -> {wb_s} "
+                    "(informational)"
+                )
+        return regressions, notes
+
     va, vb = float(a["value"]), float(b["value"])
     if va > 0:
         delta = (vb - va) / va
@@ -257,6 +318,45 @@ def compare_pair(
                     f"borg_headline pager_stalls: {st_a} -> {st_b} "
                     "(informational)"
                 )
+            # Overlap sub-block (round 19): exposed stall seconds are
+            # THE wall the threaded pager hides — growth beyond the
+            # threshold is loudly flagged as a REGRESSION note, but pps
+            # above stays the only gate (stall walls on shared CI hosts
+            # are noisy). Only compared when both rounds ran the same
+            # overlap feature set.
+            ova, ovb = bha.get("overlap"), bhb.get("overlap")
+            if isinstance(ova, dict) and isinstance(ovb, dict):
+                same_features = all(
+                    ova.get(k) == ovb.get(k)
+                    for k in ("pager_threaded", "two_phase_exchange")
+                )
+                ea_s = ova.get("exposed_stall_s")
+                eb_s = ovb.get("exposed_stall_s")
+                if not same_features:
+                    notes.append(
+                        "borg_headline overlap: feature set changed — "
+                        "exposed stall not compared"
+                    )
+                elif isinstance(ea_s, (int, float)) and isinstance(
+                    eb_s, (int, float)
+                ):
+                    line = (
+                        f"borg_headline exposed_stall_s: {ea_s} -> {eb_s}"
+                    )
+                    if eb_s > ea_s * (1.0 + threshold) and eb_s - ea_s > 0.01:
+                        notes.append(
+                            line + "  REGRESSION (exposed stall grew; "
+                            "non-gating — pps is the gate)"
+                        )
+                    else:
+                        notes.append(line)
+                    hb = ovb.get("hidden_prefetch_s")
+                    if isinstance(hb, (int, float)) and hb > 0:
+                        notes.append(
+                            f"borg_headline hidden_prefetch_s: "
+                            f"{ovb.get('hidden_prefetch_s')} "
+                            "(absorbed off the critical path)"
+                        )
 
     # Memory watermarks (round 16): top-level rss_peak_mib /
     # replicated_resident_peak_mib — informational trajectory, never a

@@ -21,6 +21,17 @@ then names the DOMINANT regime with its evidence lines:
 * ``dispatch-bound`` — chunk dispatch + device compute dominate; the
   run is doing the work it exists to do (healthy at scale). Remedy:
   kernel-level speed work, not orchestration.
+* ``overlap-starved`` (round 19) — the background machinery exists but
+  the loop still waited on IN-FLIGHT background work: blocking waits on
+  pager prefetch futures (``pager_wait_s``) plus publisher drain wall
+  (``ckpt_publish_drain_s``). Distinct from pager-bound (structural
+  misses — pages never requested in time): here the request was made
+  but hadn't finished. Remedy: deeper prefetch queue, earlier
+  submission, smaller checkpoint payloads.
+
+The report also prints per-layer overlap efficiency — what fraction of
+each hideable wall (pager fetch, checkpoint publication) actually ran
+off the critical path.
 
 Optional: when ``KSIM_PROFILE_DIR`` (or ``--profile-dir <dir>``) holds
 device-profiler traces from the same run, the report lists them next to
@@ -47,6 +58,7 @@ REGIMES = (
     "pager-bound",
     "host-fold-bound",
     "dispatch-bound",
+    "overlap-starved",
 )
 
 
@@ -62,6 +74,10 @@ def aggregate(rows: List[dict]) -> dict:
         "phases": {},
         "pager_stalls": 0,
         "pager_stall_s": 0.0,
+        "pager_waits": 0,
+        "pager_wait_s": 0.0,
+        "pager_prefetch_s": 0.0,
+        "pager_invalidations": 0,
         "exchange_est_s": 0.0,
         "exchange_probe_s": [],
         "fold_s": 0.0,
@@ -94,6 +110,22 @@ def aggregate(rows: List[dict]) -> dict:
             )
             agg["pager_stall_s"] = max(
                 agg["pager_stall_s"], float(r.get("pager_stall_s", 0.0) or 0.0)
+            )
+            # Round-19 pager fields are CUMULATIVE counters like
+            # pager_stalls — max() reconstructs the final value.
+            agg["pager_waits"] = max(
+                agg["pager_waits"], int(r.get("pager_waits", 0) or 0)
+            )
+            agg["pager_wait_s"] = max(
+                agg["pager_wait_s"], float(r.get("pager_wait_s", 0.0) or 0.0)
+            )
+            agg["pager_prefetch_s"] = max(
+                agg["pager_prefetch_s"],
+                float(r.get("pager_prefetch_s", 0.0) or 0.0),
+            )
+            agg["pager_invalidations"] = max(
+                agg["pager_invalidations"],
+                int(r.get("pager_invalidations", 0) or 0),
             )
             if r.get("exchange_est_s") is not None:
                 agg["exchange_est_s"] += float(r["exchange_est_s"])
@@ -132,7 +164,15 @@ def attribute(agg: dict) -> List[Tuple[str, float]]:
     exchange = max(
         agg["exchange_est_s"], ph.get("selection_exchange", 0.0)
     )
-    pager = max(agg["pager_stall_s"], ph.get("pager_stall", 0.0))
+    # Round 19: stall_s INCLUDES the wait-on-in-flight-future portion
+    # (wait_s). Waits move to the overlap-starved surface — the request
+    # was made in time but hadn't finished — so pager-bound keeps only
+    # the structural-miss remainder and no second lands twice.
+    wait = agg["pager_wait_s"]
+    pager = max(
+        max(agg["pager_stall_s"], ph.get("pager_stall", 0.0)) - wait, 0.0
+    )
+    starved = wait + ph.get("ckpt_publish_drain_s", 0.0)
     fold = max(
         agg["fold_s"],
         ph.get("boundary_fold", 0.0) + ph.get("host_mirror", 0.0),
@@ -143,6 +183,7 @@ def attribute(agg: dict) -> List[Tuple[str, float]]:
         ("pager-bound", pager),
         ("host-fold-bound", fold),
         ("dispatch-bound", dispatch),
+        ("overlap-starved", starved),
     ]
     return sorted(pairs, key=lambda kv: -kv[1])
 
@@ -169,7 +210,9 @@ def report(paths: List[str], profile_dir: Optional[str] = None) -> Tuple[str, in
     if not rows:
         return (
             "bottleneck_report: no flight rows in %s — was the recorder on "
-            "(flightRecorder:/flight_recorder=)?" % ", ".join(paths),
+            "(flightRecorder:/flight_recorder=)? For overlap attribution "
+            "(round 19) record a run with the recorder on, e.g. "
+            "examples/config18_overlap.yaml." % ", ".join(paths),
             1,
         )
     agg = aggregate(rows)
@@ -208,8 +251,37 @@ def report(paths: List[str], profile_dir: Optional[str] = None) -> Tuple[str, in
             % (sum(probes) / len(probes), len(probes), agg["exchange_est_s"])
         )
     lines.append(
-        "  pager: %d stalls, %.3fs stalled" % (agg["pager_stalls"], agg["pager_stall_s"])
+        "  pager: %d stalls, %.3fs stalled, %d waits (%.3fs), "
+        "%d invalidations"
+        % (
+            agg["pager_stalls"],
+            agg["pager_stall_s"],
+            agg["pager_waits"],
+            agg["pager_wait_s"],
+            agg["pager_invalidations"],
+        )
     )
+    # Per-layer overlap efficiency (round 19): fraction of each hideable
+    # wall that actually ran off the critical path.
+    if agg["pager_prefetch_s"] > 0:
+        hidden = max(agg["pager_prefetch_s"] - agg["pager_stall_s"], 0.0)
+        lines.append(
+            "  overlap efficiency: pager %.0f%% hidden "
+            "(%.3fs of %.3fs fetch wall off the critical path)"
+            % (
+                100.0 * hidden / agg["pager_prefetch_s"],
+                hidden,
+                agg["pager_prefetch_s"],
+            )
+        )
+    if agg["dcn_publish_s"] > 0:
+        drain = agg["phases"].get("ckpt_publish_drain_s", 0.0)
+        hidden = max(agg["dcn_publish_s"] - drain, 0.0)
+        lines.append(
+            "  overlap efficiency: checkpoint %.0f%% hidden "
+            "(%.3fs publish wall, %.3fs drained at cursor boundaries)"
+            % (100.0 * hidden / agg["dcn_publish_s"], hidden, drain)
+        )
     lines.append(
         "  boundary folds: %d events, %.3fs" % (agg["folds"], agg["fold_s"])
     )

@@ -6,7 +6,10 @@ including the double-kill and the recovering-claimant-kill), transient
 KV errors, added latency, torn checkpoint writes, stale reads, and the
 round-18 work-queue drills (a deterministic straggler resolved by
 speculative re-execution, and a speculator killed mid-speculation with
-the block completing via the lease-expiry steal) — runs each against a
+the block completing via the lease-expiry steal), plus the round-19
+mid-publish kill (a worker SIGKILLed between its device→host snapshot
+and the background publisher's KV publication, recovered from the prior
+complete cursor) — runs each against a
 3-worker DCN fleet with recovery enabled, and asserts the surviving
 workers' end gathers are BYTE-IDENTICAL to a no-failure single-process
 oracle.  The injector only ever touches the coordination plane or the
@@ -134,11 +137,18 @@ def main_oracle() -> int:
 # The mandatory schedules of the acceptance bar: ≥2 concurrent worker
 # deaths; a claimant killed at its first recovery beacon (the ``*``
 # CAS entry — whichever survivor claims first dies, the other hands off
-# via claim generation 1); and two round-18 work-queue drills — a
+# via claim generation 1); two round-18 work-queue drills — a
 # deterministic straggler resolved purely by speculative re-execution
 # (lease expiry pushed out of reach), and a speculator SIGKILLed at its
 # first ``spec`` beacon, after which the straggler's block still
-# completes via the lease-expiry steal at generation 1.
+# completes via the lease-expiry steal at generation 1; and the
+# round-19 mid-publish kill — with checkpoint publication running on
+# the background publisher thread, whichever worker first finishes its
+# second chunk is SIGKILLed in the window between the synchronous
+# device→host snapshot and the (possibly still in-flight) KV
+# publication, under a 50% torn-write rate. The survivor must recover
+# from the prior COMPLETE cursor (the manifest is written last, so a
+# half-published epoch is invisible) and still gather byte-identical.
 MANDATORY = (
     {"name": "double-kill", "kill": "1@run:0,2@run:0", "seed": 1701},
     {"name": "claimant-kill", "kill": "2@run:0,*@recover:-1", "seed": 1702},
@@ -146,15 +156,17 @@ MANDATORY = (
      "stall_s": 600, "straggler_s": 1.0, "seed": 1801},
     {"name": "wq-spec-kill", "wq": 1, "slow": "1@1:4",
      "kill": "*@spec:-1", "stall_s": 2, "straggler_s": 1.0, "seed": 1802},
+    {"name": "mid-publish-kill", "kill": "*@run:1", "torn_rate": 0.5,
+     "seed": 1901},
 )
 
 
 def sample_schedules(seed: int, n: int):
     """``n`` fault schedules, a pure function of ``seed``.  The first
-    four are always the mandatory double-kill, claimant-kill,
-    wq-straggler and wq-spec-kill drills; the rest mix a random named
-    kill (or none) with KV error/latency/torn/stale rates low enough
-    that the bounded retries absorb them."""
+    five are always the mandatory double-kill, claimant-kill,
+    wq-straggler, wq-spec-kill and mid-publish-kill drills; the rest
+    mix a random named kill (or none) with KV error/latency/torn/stale
+    rates low enough that the bounded retries absorb them."""
     rng = random.Random(int(seed) * 9176 + 5)
     out = [dict(s) for s in MANDATORY]
     while len(out) < n:
@@ -355,17 +367,35 @@ def check_schedule(sched: dict, out: dict, oracle: dict):
     if not survivors:
         fails.append(f"{sched['name']}: no surviving worker (rcs {rcs})")
     if wildcard and killed > len(named):
-        # A ``*`` entry fired. Static slicing: a claimant died
-        # mid-recovery, so a survivor must have opened the next claim
-        # generation (the fenced hand-off). Work queue: the speculator
-        # died, so the straggler's block must have completed via the
-        # lease-expiry STEAL at the next lease generation.
-        marker = "steals block" if sched.get("wq") else "opening generation"
+        # A ``*`` entry fired — which hand-off marker to demand depends
+        # on WHERE the wildcard struck. Work queue: the speculator died,
+        # so the straggler's block must have completed via the
+        # lease-expiry STEAL at the next lease generation. Static
+        # slicing at a ``recover`` beacon: a claimant died mid-recovery,
+        # so a survivor must have opened the next claim generation (the
+        # fenced hand-off). Static slicing at a ``run`` beacon (the
+        # round-19 mid-publish drill): an ordinary worker died, so a
+        # survivor must have CLAIMED the dead process's block from its
+        # last COMPLETE published cursor.
+        from kubernetes_simulator_tpu.parallel import faultline
+
+        wild_states = {
+            state
+            for pid_s, state, _ in faultline.parse_kill_schedule(
+                sched.get("kill", "")
+            )
+            if pid_s == "*"
+        }
+        if sched.get("wq"):
+            marker, what = "steals block", "lease steal"
+        elif "recover" in wild_states:
+            marker, what = "opening generation", "claim generation"
+        else:
+            marker, what = "claims dead process", "dead-process claim"
         if marker not in out["blob"]:
             fails.append(
                 f"{sched['name']}: wildcard kill fired but no "
-                f"{'lease steal' if sched.get('wq') else 'claim generation'}"
-                " hand-off appeared in the logs"
+                f"{what} hand-off appeared in the logs"
             )
     if sched.get("wq") and sched.get("slow") and not sched.get("kill"):
         # Pure-straggler drill: with lease expiry out of reach, only a
@@ -442,9 +472,10 @@ def main() -> int:
     ap.add_argument("--oracle", action="store_true",
                     help="internal: run the no-failure oracle")
     ap.add_argument("--schedules", type=int, default=6,
-                    help="number of fault schedules to sample (>= 4 "
+                    help="number of fault schedules to sample (>= 5 "
                          "includes the mandatory double-kill, "
-                         "claimant-kill, wq-straggler and wq-spec-kill)")
+                         "claimant-kill, wq-straggler, wq-spec-kill "
+                         "and mid-publish-kill)")
     ap.add_argument("--seed", type=int, default=17)
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run timeout in seconds")

@@ -7,6 +7,11 @@ so the scaling record holds device-count sweeps (the default sweep below)
 and DCN process-count sweeps side by side. Inside a DCN fleet every
 process prints its local wall; read process 0's line (the others carry a
 [pN] prefix only on failure).
+
+``--exchange [OUT_JSON]`` (round 19) pins the per-slot selection-exchange
+payload bytes and replay wall at node_shards ∈ {1, 2, 4, 8} into a JSON
+that scripts/bench_compare.py diffs — payload growth at any shard count
+gates there.
 """
 
 import os as _os
@@ -104,6 +109,67 @@ def node_sweep(nodes_list, pods_n, paged=False):
             node_probe(nodes, pods_n, ndev, paged=paged)
 
 
+def exchange_sweep(out_path, nodes, pods_n):
+    """Round 19: pin the per-slot selection-exchange payload at
+    node_shards ∈ {1, 2, 4, 8}. Bytes are analytic
+    (ops.tpu.exchange_payload_bytes — the implementation-neutral ring
+    model, so the pin survives backend changes); walls are measured with
+    a real node-sharded replay at every shard count the local device
+    pool can host. The JSON lands under an ``exchange_sweep`` key that
+    scripts/bench_compare.py diffs: payload growth at any shard count
+    gates, wall moves are informational."""
+    import json
+
+    import jax
+
+    from kubernetes_simulator_tpu.ops import tpu as T
+    from kubernetes_simulator_tpu.sim.jax_runtime import JaxReplayEngine
+
+    cluster = make_cluster(nodes, seed=0, taint_fraction=0.1)
+    pods, _ = make_workload(
+        pods_n, seed=0, with_affinity=True, with_spread=True,
+        with_tolerations=True, gang_fraction=0.02, gang_size=4,
+    )
+    ec, ep = encode(cluster, pods)
+    G = max(ec.num_groups, 1)
+    two_phase = T.two_phase_exchange()
+    ndev = len(jax.devices())
+    points = []
+    for n in (1, 2, 4, 8):
+        pt = {
+            "node_shards": n,
+            "payload_bytes": T.exchange_payload_bytes(n, G, two_phase),
+            "payload_bytes_legacy": T.exchange_payload_bytes(n, G, False),
+            "wall_s": None,
+        }
+        if n <= max(ndev, 1):
+            eng = JaxReplayEngine(
+                ec, ep, FrameworkConfig(), node_shards=n,
+            )
+            eng.replay()  # warmup (compile)
+            t0 = time.perf_counter()
+            eng.replay()
+            pt["wall_s"] = round(time.perf_counter() - t0, 3)
+        points.append(pt)
+        print(
+            f"exchange @{n} shards: payload={pt['payload_bytes']}B/slot "
+            f"(legacy {pt['payload_bytes_legacy']}B) wall={pt['wall_s']}",
+            flush=True,
+        )
+    doc = {
+        "exchange_sweep": {
+            "nodes": nodes,
+            "pods": pods_n,
+            "groups": G,
+            "two_phase": bool(two_phase),
+            "points": points,
+        }
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"exchange sweep -> {out_path}", flush=True)
+
+
 def dcn_sweep(proc_counts, S, nodes, pods_n):
     """Re-launch this probe under scripts/dcn_launch.py once per process
     count — the DCN axis of the scaling trajectory (device-count sweeps
@@ -139,9 +205,17 @@ def main():
     ap.add_argument("--pods", type=int, default=10_000)
     ap.add_argument("--paged", action="store_true",
                     help="stream pod pages in the node-axis sweep")
+    ap.add_argument("--exchange", nargs="?", const="exchange_sweep.json",
+                    default=None, metavar="OUT_JSON",
+                    help="round-19 selection-exchange payload sweep at "
+                         "node_shards 1/2/4/8 — writes a JSON "
+                         "bench_compare.py can diff (payload growth "
+                         "gates)")
     args = ap.parse_args()
     node_list = [int(x) for x in str(args.nodes).split(",") if x]
-    if args.inner:
+    if args.exchange:
+        exchange_sweep(args.exchange, node_list[0], args.pods)
+    elif args.inner:
         from kubernetes_simulator_tpu.parallel.mesh import make_mesh
 
         import jax

@@ -30,9 +30,9 @@ N_SCHEDULES = 6
 
 def test_mandatory_schedules_always_sampled():
     """Fast sanity (no fleet): the sampler always leads with the
-    double-kill, claimant-kill, wq-straggler and wq-spec-kill drills,
-    schedules are deterministic in the seed, and sampled kills never
-    name the coordinator."""
+    double-kill, claimant-kill, wq-straggler, wq-spec-kill and
+    mid-publish-kill drills, schedules are deterministic in the seed,
+    and sampled kills never name the coordinator."""
     scheds = F.sample_schedules(SEED, N_SCHEDULES)
     assert len(scheds) == N_SCHEDULES
     assert scheds[0]["name"] == "double-kill"
@@ -44,6 +44,8 @@ def test_mandatory_schedules_always_sampled():
     assert "kill" not in scheds[2]
     assert scheds[3]["name"] == "wq-spec-kill"
     assert scheds[3]["wq"] and scheds[3]["kill"] == "*@spec:-1"
+    assert scheds[4]["name"] == "mid-publish-kill"
+    assert scheds[4]["kill"] == "*@run:1" and scheds[4]["torn_rate"] == 0.5
     assert scheds == F.sample_schedules(SEED, N_SCHEDULES)
     assert scheds != F.sample_schedules(SEED + 1, N_SCHEDULES)
     for sch in scheds:
@@ -92,4 +94,13 @@ def test_fuzz_schedules_byte_identical_to_oracle(tmp_path):
             assert len(killed) == 1, out["rcs"]
             assert "speculates block" in out["blob"], out["blob"][-2000:]
             assert "steals block" in out["blob"], out["blob"][-2000:]
+        if sched["name"] == "mid-publish-kill":
+            # Round 19: exactly one worker dies in the window between
+            # its device→host snapshot and the background publisher's
+            # KV publication; a survivor claims the dead block from the
+            # prior COMPLETE cursor (check_schedule already demanded the
+            # "claims dead process" marker and oracle byte-parity).
+            killed = [p for p, rc in out["rcs"].items() if rc == -9]
+            assert len(killed) == 1, out["rcs"]
+            assert "claims dead process" in out["blob"], out["blob"][-2000:]
     assert not failures, "\n".join(failures)

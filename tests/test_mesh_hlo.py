@@ -14,6 +14,8 @@ device-gather src signature with device-side releases — so this suite
 now lowers those exact programs, at the headline scenario count as well
 as the small smoke shape, plus the bucketed release program."""
 
+import re
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -221,13 +223,48 @@ def test_node_sharded_chunk_collectives_whitelisted():
     )
 
 
-def test_node_sharded_fit_only_is_single_exchange():
-    """Fit-only drops the packed plugin folds: the surviving collective
-    set is the selection exchange alone (all-gather + the partition-id
-    that turns local argmins into global node ids) — the literal 'one
-    tiny reduce per slot' of the round-14 design."""
-    ops = _collective_hits(_node_sharded_hlo(fit_only=True))
+def _gather_row_widths(txt):
+    """Per-shard row widths of every all-gather in the compiled program
+    (the gathered operand is f32[nshards, width])."""
+    return sorted({
+        int(m.group(1))
+        for m in re.finditer(r"= f32\[8,(\d+)\][^ ]* all-gather\(", txt)
+    })
+
+
+def test_node_sharded_fit_only_two_phase_exchange():
+    """Round 19 slims the selection exchange to two phases: phase 1
+    all-gathers ONLY the slim (score, global-node-id) pair — a 2-wide
+    f32 row per shard — and phase 2 moves the winner's domain rows with
+    a single owner-masked all-reduce. Fit-only drops the packed plugin
+    folds, so the compiled op set is exactly those two exchanges plus
+    the partition-id for global-id/owner arithmetic, and every gathered
+    row is provably the slim pair, never the old (2+2G)-wide one."""
+    txt = _node_sharded_hlo(fit_only=True)
+    ops = _collective_hits(txt)
+    assert set(ops) == {"all-gather", "all-reduce", "partition-id"}, (
+        f"fit-only two-phase program op set drifted: {ops}"
+    )
+    assert _gather_row_widths(txt) == [2], (
+        "two-phase phase-1 gather must move only the (score, id) pair — "
+        f"saw per-shard row widths {_gather_row_widths(txt)}"
+    )
+
+
+def test_node_sharded_fit_only_legacy_single_exchange(monkeypatch):
+    """The legacy single-exchange program (KSIM_TWO_PHASE_EXCHANGE=0)
+    is still the round-14 shape: one wide all-gather carrying
+    (score, id, gdom, hasdom) = 2+2G floats per shard, no all-reduce.
+    Pinned so the A/B switch stays a real program-level fork."""
+    monkeypatch.setenv("KSIM_TWO_PHASE_EXCHANGE", "0")
+    txt = _node_sharded_hlo(fit_only=True)
+    ops = _collective_hits(txt)
     assert "all-gather" in ops
     assert set(ops) <= {"all-gather", "partition-id"}, (
-        f"fit-only node-sharded program grew extra collectives: {ops}"
+        f"legacy fit-only program grew extra collectives: {ops}"
+    )
+    widths = _gather_row_widths(txt)
+    assert len(widths) == 1 and widths[0] > 2, (
+        "legacy exchange should gather the combined (2+2G)-wide row — "
+        f"saw {widths}"
     )
