@@ -35,7 +35,10 @@ batched sweep — the config2 search space, i.e. the full default plugin
 set's 5 Score weights plus the NodeResourcesFit strategy selector; 0
 population disables), BENCH_RECOVERY (0 skips the ``detail.dcn_recovery``
 cost block), BENCH_RECOVERY_REPS, BENCH_CKPT_EVERY (cadence for the
-fleet-only publication-overhead run), BENCH_BORG / BENCH_BORG_NODES /
+fleet-only publication-overhead run), BENCH_DURABLE (0 skips the round-20
+``detail.durable_ground`` durability-journal micro-bench: journal write
+overhead vs the encode wall, cold-resume wall, adopted-block count),
+BENCH_BORG / BENCH_BORG_NODES /
 BENCH_BORG_PODS (borg_scale detail block), BENCH_HEADLINE /
 BENCH_HEADLINE_NODES / BENCH_HEADLINE_PODS / BENCH_HEADLINE_FLIGHT
 (round 16 ``borg_headline`` composed run — Borg-shaped trace through
@@ -426,6 +429,111 @@ def main():
             }
         }
 
+    # Durable-ground accounting (round 20) — informational detail only
+    # (bench_compare.py diffs it without gating). Fleet-free micro-bench
+    # of the durability-journal layer (parallel.dcn, KSIM_DCN_DURABLE_DIR)
+    # in a throwaway directory:
+    #   * journal_write_overhead_pct: the mirror wall (framed chunks +
+    #     manifest-last, temp-then-rename) as a share of the encode+frame
+    #     wall the publication already pays — the budget the round-19
+    #     publisher thread hides it behind;
+    #   * cold_resume_wall_s: walk the journal exactly as a restarted
+    #     fleet's load_checkpoint would (namespace scan + full kf1/crc
+    #     validation of the newest cursor per block);
+    #   * adopted_blocks: completed work-queue blocks a fresh fleet
+    #     adopts from the journal without re-execution (_journal_wq_scan
+    #     over mirrored result blobs + done records).
+    durable_block = {}
+    if int(os.environ.get("BENCH_DURABLE", "1") or 0):
+        import shutil
+        import tempfile
+        import zlib
+
+        from kubernetes_simulator_tpu.parallel.dcn import (
+            _encode_payload,
+            _frame_chunk,
+            _journal_ckpt_entries,
+            _journal_read_blob,
+            _journal_wq_result,
+            _journal_wq_scan,
+            _journal_write_blob,
+            _journal_write_json,
+        )
+
+        jdir = tempfile.mkdtemp(prefix="ksim_bench_journal_")
+        prev_jdir = os.environ.get("KSIM_DCN_DURABLE_DIR")
+        os.environ["KSIM_DCN_DURABLE_DIR"] = jdir
+        try:
+            rng_d = np.random.default_rng(20)
+            n_epochs, n_blocks = 8, 4
+            snaps, encode_wall, mirror_wall = [], 0.0, 0.0
+            for epoch_i in range(n_epochs):
+                snap = {
+                    "cursor": epoch_i,
+                    "leaves": {
+                        "states": rng_d.integers(
+                            -1, nodes, size=(256, 512), dtype=np.int32
+                        )
+                    },
+                }
+                t0 = time.perf_counter()
+                raw = _encode_payload(snap)
+                crc, blob_len = 0, 0
+                for ch in raw:
+                    crc = zlib.crc32(ch.encode("ascii"), crc)
+                    blob_len += len(ch)
+                chunks = [_frame_chunk(ch) for ch in raw]
+                manifest = json.dumps(
+                    {"n": len(chunks), "crc": f"{crc & 0xFFFFFFFF:08x}",
+                     "len": blob_len},
+                    sort_keys=True,
+                )
+                encode_wall += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                ok = _journal_write_blob(
+                    os.path.join("ckpt", "1", "0", "0-64", str(epoch_i)),
+                    chunks, manifest,
+                )
+                mirror_wall += time.perf_counter() - t0
+                assert ok, "journal mirror failed in a fresh tempdir"
+                snaps.append(snap)
+            for bid in range(n_blocks):
+                _journal_wq_result(
+                    os.path.join("wq", "1", "bench"), bid, snaps[bid]
+                )
+                _journal_write_json(
+                    os.path.join("wq", "1", "bench", "done", str(bid)),
+                    {"pid": 0, "gen": 0, "spec": 0},
+                )
+            t0 = time.perf_counter()
+            entries = _journal_ckpt_entries(0, 1)
+            newest = max(int(cur) for _, cur in entries)
+            _journal_read_blob(
+                os.path.join("ckpt", "1", "0", "0-64", str(newest))
+            )
+            cold_wall = time.perf_counter() - t0
+            adopted, _hint = _journal_wq_scan(1, "bench", n_blocks)
+            durable_block = {
+                "durable_ground": {
+                    "journal_epochs": n_epochs,
+                    "journal_write_wall_s": round(mirror_wall, 4),
+                    "journal_write_overhead_pct": round(
+                        100.0 * mirror_wall / encode_wall
+                        if encode_wall > 0 else 0.0,
+                        1,
+                    ),
+                    "cold_resume_wall_s": round(cold_wall, 4),
+                    "cold_resume_cursors_seen": len(entries),
+                    "adopted_blocks": len(adopted),
+                }
+            }
+        finally:
+            if prev_jdir is None:
+                os.environ.pop("KSIM_DCN_DURABLE_DIR", None)
+            else:
+                os.environ["KSIM_DCN_DURABLE_DIR"] = prev_jdir
+            shutil.rmtree(jdir, ignore_errors=True)
+
     scaling = {}
     if mesh is not None and nproc == 1:
         runs_ref = max(1, int(os.environ.get("BENCH_REF_RUNS", 2)))
@@ -765,6 +873,7 @@ def main():
                     **rec_block,
                     **fault_block,
                     **wq_block,
+                    **durable_block,
                     **scaling,
                     **cont,
                     **tune_sweep,

@@ -445,6 +445,58 @@ def _overlap_errors(cfg) -> list:
     return errors
 
 
+def _durable_errors(cfg) -> list:
+    """Actionable refusals for the ``dcn.durable`` section (round 20).
+    Shared by validate_config and the pre-dispatch env export in main().
+    A durability journal outside a DCN fleet, or on a config with no
+    checkpoint cadence at all, is refused — the journal would sit empty
+    while claiming crash-restart coverage; an unwritable journal
+    directory is refused up front rather than discovered at the first
+    mirrored publication."""
+    du = getattr(cfg, "dcn_durable", None)
+    if du is None:
+        return []
+    errors = []
+    if not du.dir:
+        if du.resume:
+            errors.append(
+                "dcn.durable.resume: true requires dcn.durable.dir — "
+                "there is no journal to seed the fleet from"
+            )
+        return errors
+    if int(os.environ.get("KSIM_DCN_NPROC", "1") or 1) <= 1:
+        errors.append(
+            "dcn.durable.dir: the durability journal mirrors a DCN "
+            "fleet's checkpoint/queue publications — launch through "
+            "scripts/dcn_launch.py (ideally --supervise); "
+            "KSIM_DCN_NPROC is unset/1, so there is no fleet state to "
+            "make durable"
+        )
+    rec = getattr(cfg, "dcn_recovery", None)
+    wq = getattr(cfg, "dcn_workqueue", None)
+    has_ckpt = (
+        rec is not None and rec.enable and rec.checkpoint_every >= 1
+    ) or (wq is not None and wq.enable)
+    if not has_ckpt:
+        errors.append(
+            "dcn.durable.dir: the journal rides checkpoint/queue "
+            "publication — enable dcn.recovery with checkpointEvery >= 1 "
+            "(or dcn.workQueue) so there is something durable to mirror"
+        )
+    try:
+        os.makedirs(du.dir, exist_ok=True)
+        probe = os.path.join(du.dir, f".ksim_probe.{os.getpid()}")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+    except OSError as e:
+        errors.append(
+            f"dcn.durable.dir: {du.dir!r} is not writable ({e}) — the "
+            "journal must outlive the fleet, so it is created eagerly"
+        )
+    return errors
+
+
 def validate_config(cfg) -> list:
     """Structural checks → list of actionable error strings (empty = ok)."""
     from .framework.registry import available_strategies
@@ -701,6 +753,7 @@ def validate_config(cfg) -> list:
     errors.extend(_workqueue_errors(cfg))
     errors.extend(_faultline_errors(cfg))
     errors.extend(_overlap_errors(cfg))
+    errors.extend(_durable_errors(cfg))
     return errors
 
 
@@ -838,6 +891,24 @@ def main(argv=None) -> int:
             ):
                 if val is not None:
                     os.environ.setdefault(env, "1" if val else "0")
+        # Durable-ground knobs (round 20, dcn.durable:) ride the same
+        # pre-dispatch export — resume seeding happens during the first
+        # replay's bring-up, so the journal path must be pinned before
+        # any engine touches the coordination plane.
+        du = (
+            getattr(cfg_pre, "dcn_durable", None)
+            if cfg_pre is not None
+            else None
+        )
+        if du is not None and (du.dir or du.resume):
+            errors = _durable_errors(cfg_pre)
+            if errors:
+                for e in errors:
+                    log.error("config: %s", e)
+                return 2
+            os.environ.setdefault("KSIM_DCN_DURABLE_DIR", str(du.dir))
+            if du.resume:
+                os.environ.setdefault("KSIM_DCN_RESUME", "1")
     # Multi-host DCN bring-up (round 11): a no-op without the
     # KSIM_DCN_* env set by scripts/dcn_launch.py. Enables the compile
     # cache BEFORE jax.distributed.initialize (documented ordering).

@@ -654,6 +654,279 @@ def _unframe_chunk(framed: str) -> str:
     return data
 
 
+# -- durable ground (round 20) -----------------------------------------------
+#
+# Everything the recovery stack stores — checkpoint blobs, work-queue
+# results, the done/lease ledger — lives in the jax.distributed KV
+# store, which dies with process 0. The durability journal mirrors the
+# same framed bytes to a filesystem directory (``KSIM_DCN_DURABLE_DIR``
+# / ``dcn.durable:`` YAML) on the existing publication paths, so a
+# WHOLE-FLEET crash — coordinator included — becomes restartable: a
+# fresh fleet brought up with ``KSIM_DCN_RESUME=1`` (dcn_launch
+# --resume, set automatically by --supervise relaunches) seeds its new
+# KV plane from the journal. Completed work-queue blocks are adopted
+# without re-execution; in-flight blocks resume from their newest
+# complete durable cursor. The layout mirrors the KV namespace:
+#
+#   <dir>/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>/{0..n-1, manifest.json}
+#   <dir>/wq/<seq>/<name>/result/<bid>/{0..n-1, manifest.json}
+#   <dir>/wq/<seq>/<name>/done/<bid>     one JSON done meta per block
+#   <dir>/wq/<seq>/<name>/lease/<bid>    newest durable lease holder
+#
+# Chunk files carry the SAME kf1 CRC32+length frames as the KV values,
+# and manifest.json is the SAME JSON manifest — written temp-then-
+# ``os.replace`` and LAST, so a reader that finds a manifest never sees
+# an in-flight blob, and a blob torn by a crash (or by the faultline
+# torn-write injector, which every journal file is routed through)
+# fails frame validation on resume and the reader falls back to the
+# prior complete cursor, exactly like the KV path. The namespaces line
+# up across restarts because the gather sequence is deterministic: a
+# resumed fleet replays the same ``_seq``, so epochs and wq prefixes
+# match the dead fleet's byte-for-byte. Writers are best-effort (never
+# raise — durability must not take a healthy run down) and the
+# checkpoint mirror runs inside :func:`publish_checkpoint`, i.e. on the
+# round-19 background publisher thread, so the sync loop gains no new
+# stall. With the directory unset every hook below is a no-op and the
+# round-19 byte-identity bars are untouched.
+
+# writes/write_wall_s/bytes: journal mirror traffic this process;
+# adopted: work-queue blocks adopted from the journal without
+# re-execution; resumes: checkpoint loads satisfied from the journal.
+JOURNAL_STATS = {
+    "writes": 0,
+    "write_wall_s": 0.0,
+    "bytes": 0,
+    "adopted": 0,
+    "resumes": 0,
+}
+
+
+def journal_stats() -> dict:
+    """Snapshot of :data:`JOURNAL_STATS` (copy — callers diff it)."""
+    return dict(JOURNAL_STATS)
+
+
+def durable_dir() -> Optional[str]:
+    """Root of the durability journal (``KSIM_DCN_DURABLE_DIR``), or
+    None — the default — for no journal at all."""
+    d = str(os.environ.get("KSIM_DCN_DURABLE_DIR", "")).strip()
+    return d or None
+
+
+def resume_enabled() -> bool:
+    """Seed this fleet from the durability journal (``KSIM_DCN_RESUME``;
+    set by ``dcn_launch --resume`` and by every supervised relaunch).
+    Only meaningful with :func:`durable_dir` set."""
+    return str(
+        os.environ.get("KSIM_DCN_RESUME", "0")
+    ).strip().lower() in ("1", "true", "yes", "on")
+
+
+def _journal_write_file(path: str, data: str) -> None:
+    """One torn-write-proof journal file: write a same-directory temp,
+    then ``os.replace`` (atomic on POSIX). The payload is routed through
+    ``faultline.file_blob`` so the torn-write injector tears journal
+    files exactly like KV blobs — the CRC frames catch it on resume."""
+    from . import faultline
+
+    data = faultline.file_blob(data)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _journal_write_blob(subdir: str, chunks, manifest: str) -> bool:
+    """Mirror one framed blob (checkpoint or work-queue result) to
+    ``<durable_dir>/<subdir>/``: chunk files ``0..n-1`` first,
+    ``manifest.json`` LAST. Best-effort: returns False instead of
+    raising — a full disk degrades durability, never the run."""
+    root = durable_dir()
+    if not root:
+        return False
+    t0 = time.perf_counter()
+    try:
+        d = os.path.join(root, subdir)
+        os.makedirs(d, exist_ok=True)
+        nbytes = 0
+        for j, ch in enumerate(chunks):
+            _journal_write_file(os.path.join(d, str(j)), ch)
+            nbytes += len(ch)
+        _journal_write_file(os.path.join(d, "manifest.json"), manifest)
+    except OSError:
+        return False
+    JOURNAL_STATS["writes"] += 1
+    JOURNAL_STATS["write_wall_s"] += time.perf_counter() - t0
+    JOURNAL_STATS["bytes"] += nbytes + len(manifest)
+    return True
+
+
+def _journal_write_json(rel: str, obj: dict) -> bool:
+    """One atomic JSON ledger record at ``<durable_dir>/<rel>`` (the
+    work-queue done/lease entries). Best-effort like the blob writer."""
+    root = durable_dir()
+    if not root:
+        return False
+    try:
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _journal_write_file(path, json.dumps(obj, sort_keys=True))
+    except OSError:
+        return False
+    JOURNAL_STATS["writes"] += 1
+    return True
+
+
+def _journal_read_json(rel: str):
+    """Parsed JSON at ``<durable_dir>/<rel>`` or None (absent, torn by a
+    crash mid-replace — impossible on POSIX but cheap to tolerate — or
+    not JSON)."""
+    root = durable_dir()
+    if not root:
+        return None
+    try:
+        with open(os.path.join(root, rel)) as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def _journal_ckpt_entries(pid: int, ep: int) -> Dict[tuple, Dict[str, str]]:
+    """The journal mirror of one process's checkpoint namespace, in
+    ``load_checkpoint``'s table shape: ``{(blk, cur): {leaf: value}}``
+    with the manifest under leaf ``"n"``. Cursors missing
+    ``manifest.json`` were in flight when the fleet died and are skipped
+    (the exact KV in-flight rule); frame validation happens in the
+    caller's newest-first candidate walk, so a torn journal chunk falls
+    back to the prior complete cursor there."""
+    out: Dict[tuple, Dict[str, str]] = {}
+    root = durable_dir()
+    if not root:
+        return out
+    base = os.path.join(root, "ckpt", str(int(ep)), str(int(pid)))
+    try:
+        blks = os.listdir(base)
+    except OSError:
+        return out
+    for blk in blks:
+        bdir = os.path.join(base, blk)
+        try:
+            curs = os.listdir(bdir)
+        except OSError:
+            continue
+        for cur in curs:
+            cdir = os.path.join(bdir, cur)
+            try:
+                names = os.listdir(cdir)
+            except OSError:
+                continue
+            if "manifest.json" not in names:
+                continue  # in flight when the fleet died
+            kv: Dict[str, str] = {}
+            try:
+                for name in names:
+                    if name.endswith(".tmp"):
+                        continue
+                    with open(os.path.join(cdir, name)) as f:
+                        kv["n" if name == "manifest.json" else name] = (
+                            f.read()
+                        )
+            except OSError:
+                continue
+            out[(blk, cur)] = kv
+    return out
+
+
+def _journal_read_blob(subdir: str):
+    """Decode one journaled blob directory through the full integrity
+    stack (manifest chunk count, per-chunk kf1 frames, whole-blob
+    crc/length) — the work-queue result reader. Returns the decoded
+    payload or raises (``ValueError``/``OSError``/decode errors) so the
+    caller can count the fallback and re-execute."""
+    root = durable_dir()
+    if not root:
+        raise OSError("no durable journal configured")
+    d = os.path.join(root, subdir)
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = json.loads(f.read())
+    chunks = []
+    for j in range(int(man["n"])):
+        with open(os.path.join(d, str(j))) as f:
+            chunks.append(_unframe_chunk(f.read()))
+    crc = 0
+    for ch in chunks:
+        crc = zlib.crc32(ch.encode("ascii"), crc)
+    if (
+        f"{crc & 0xFFFFFFFF:08x}" != man.get("crc")
+        or sum(len(ch) for ch in chunks) != int(man.get("len", -1))
+    ):
+        raise ValueError("manifest crc/length mismatch over journal blob")
+    return _decode_payload(chunks)
+
+
+def _journal_wq_scan(seq: int, name: str, nb: int):
+    """Resume scan of the work-queue journal for gather ``seq``:
+    ``(adopted, resume_hint)``. ``adopted`` maps bid -> (done meta,
+    decoded payload) for blocks whose durable done record AND result
+    blob both validate — the fresh fleet adopts those without
+    re-execution. A done record whose result blob is missing or torn is
+    dropped (the block re-executes; counted as a CRC fallback).
+    ``resume_hint`` maps each unfinished bid to the pid holding its
+    newest durable lease — the execute path then resumes from that
+    pid's durable block checkpoint."""
+    adopted: Dict[int, tuple] = {}
+    hint: Dict[int, int] = {}
+    if not durable_dir():
+        return adopted, hint
+    from ..utils.metrics import log
+
+    base = os.path.join("wq", str(int(seq)), str(name))
+    for bid in range(int(nb)):
+        meta = _journal_read_json(os.path.join(base, "done", str(bid)))
+        if isinstance(meta, dict):
+            try:
+                payload = _journal_read_blob(
+                    os.path.join(base, "result", str(bid))
+                )
+            except Exception as e:
+                CRC_STATS["frames_bad"] += 1
+                CRC_STATS["fallbacks"] += 1
+                log.warning(
+                    "dcn journal: block %d's durable result failed "
+                    "validation (%s) — re-executing it", bid, e,
+                )
+            else:
+                adopted[bid] = (meta, payload)
+                continue
+        lease = _journal_read_json(os.path.join(base, "lease", str(bid)))
+        if isinstance(lease, dict) and int(lease.get("pid", -1)) >= 0:
+            hint[bid] = int(lease["pid"])
+    return adopted, hint
+
+
+def _journal_wq_result(jbase: str, bid: int, payload) -> bool:
+    """Mirror one work-queue block result to the journal (framed chunks
+    + manifest, the checkpoint blob treatment). Called BEFORE the
+    first-complete-wins done-CAS, so a durable done record never names
+    a result the journal doesn't hold."""
+    if not durable_dir():
+        return False
+    raw = _encode_payload(payload)
+    crc, blob_len = 0, 0
+    for ch in raw:
+        crc = zlib.crc32(ch.encode("ascii"), crc)
+        blob_len += len(ch)
+    manifest = json.dumps(
+        {"n": len(raw), "crc": f"{crc & 0xFFFFFFFF:08x}", "len": blob_len},
+        sort_keys=True,
+    )
+    return _journal_write_blob(
+        os.path.join(jbase, "result", str(int(bid))),
+        [_frame_chunk(ch) for ch in raw],
+        manifest,
+    )
+
+
 # In-process subscribers to fleet events (round 18): the flight recorder
 # registers a callback here so lease/steal/speculation/claim events land
 # in its JSONL stream alongside the chunk rows. Callbacks receive the
@@ -810,20 +1083,34 @@ def publish_checkpoint(
             op="publish_checkpoint",
             key=f"{prefix}/n",
         )
+        # Durable ground (round 20): mirror the SAME framed chunks and
+        # manifest to the journal. Already on the publisher thread when
+        # the round-19 async gate is on, so the loop gains no stall;
+        # best-effort, and a no-op with the journal unset.
+        journaled = durable_dir() is not None and _journal_write_blob(
+            os.path.join(
+                "ckpt", str(ep), str(pid), f"{lo}-{hi}", str(int(cursor))
+            ),
+            chunks,
+            manifest,
+        )
         wall = time.perf_counter() - t0
         nbytes = sum(len(ch) for ch in chunks)
         PUBLISH_STATS["count"] += 1
         PUBLISH_STATS["wall_s"] += wall
         PUBLISH_STATS["bytes"] += nbytes
-        _mirror_event(
-            {
-                "kind": "ckpt_publish",
-                "pid": pid,
-                "cursor": int(cursor),
-                "bytes": nbytes,
-                "wall_s": round(wall, 6),
-            }
-        )
+        ev = {
+            "kind": "ckpt_publish",
+            "pid": pid,
+            "cursor": int(cursor),
+            "bytes": nbytes,
+            "wall_s": round(wall, 6),
+        }
+        if journaled:
+            # Key present only with the journal on — round-19 event
+            # streams stay byte-unchanged with dcn.durable off.
+            ev["journal"] = 1
+        _mirror_event(ev)
         return True
     except Exception:
         return False
@@ -1010,6 +1297,21 @@ def load_checkpoint(
             continue
         blk, cur, leaf = parts[-3], parts[-2], parts[-1]
         table.setdefault((blk, cur), {})[leaf] = val
+    # Durable ground (round 20): merge the journal mirror into the
+    # candidate table — this is how a resumed fleet's empty KV plane
+    # gets seeded with the dead fleet's checkpoints (epochs align
+    # because the gather sequence replays deterministically). KV wins
+    # on a per-leaf collision (same bytes by construction); journal-
+    # sourced candidates ride the exact same newest-first walk, CRC
+    # validation and prior-cursor fallback below.
+    journal_keys: set = set()
+    if durable_dir() is not None:
+        for bc, jkv in _journal_ckpt_entries(int(pid), ep).items():
+            dst = table.setdefault(bc, {})
+            for leaf, val in jkv.items():
+                if leaf not in dst:
+                    dst[leaf] = val
+                    journal_keys.add(bc)
     candidates = []
     for (blk, cur), kv in table.items():
         if "n" not in kv:
@@ -1021,8 +1323,10 @@ def load_checkpoint(
             continue
         if before_cursor is not None and cursor >= int(before_cursor):
             continue
-        candidates.append((cursor, (lo, hi), kv))
-    for cursor, block, kv in sorted(candidates, reverse=True):
+        candidates.append((cursor, (lo, hi), kv, (blk, cur)))
+    for cursor, block, kv, raw_key in sorted(
+        candidates, key=lambda t: (t[0], t[1]), reverse=True
+    ):
         try:
             man = json.loads(kv["n"])
             if isinstance(man, dict):
@@ -1058,6 +1362,19 @@ def load_checkpoint(
                 "checkpoint", int(pid), cursor, e,
             )
             continue
+        if raw_key in journal_keys:
+            # The winning candidate came (at least partly) from the
+            # durable journal — the resume-seeding event the flight
+            # recorder and dcn_launch --watch surface.
+            JOURNAL_STATS["resumes"] += 1
+            _mirror_event(
+                {
+                    "event": "journal_resume",
+                    "pid": int(pid),
+                    "cursor": int(cursor),
+                    "block": [int(block[0]), int(block[1])],
+                }
+            )
         return {"cursor": cursor, "block": block, "payload": payload}
     return None
 
@@ -1578,6 +1895,28 @@ def wq_run(name: str, blocks: list, execute) -> list:
     done: Dict[int, dict] = {}  # bid -> winning done meta
     spec_tried: set = set()  # (bid, gen) speculator elections entered
     spec_deferred: set = set()  # leader's one-sweep election deferrals
+    jbase = os.path.join("wq", str(_seq), str(name))  # journal namespace
+
+    # Durable ground (round 20): a fleet restarted over the dead one's
+    # journal adopts every block whose durable done record AND result
+    # blob validate — no re-execution, and the adopted payloads are the
+    # dead fleet's bytes, so the assembled gather is byte-identical to
+    # an uninterrupted run. Adoption goes straight into `done`/`local`
+    # (NOT through _note_done: the old fleet's steal/speculation flags
+    # must not arm the degraded exit in this healthy fleet). Unfinished
+    # blocks keep the newest durable lease holder as a resume hint —
+    # the execute path loads that pid's durable block checkpoint.
+    resume_hint: Dict[int, int] = {}
+    if resume_enabled() and durable_dir():
+        adopted, resume_hint = _journal_wq_scan(_seq, name, nb)
+        for bid, (meta, payload) in sorted(adopted.items()):
+            done[bid] = meta
+            local[bid] = payload
+            JOURNAL_STATS["adopted"] += 1
+            _mirror_event(
+                {"event": "journal_adopt", "pid": int(pid),
+                 "block": int(bid), "from": int(meta.get("pid", -1))}
+            )
 
     def _lease_key(bid: int, gen: int) -> str:
         return f"{prefix}/lease/{int(bid)}/{int(gen)}"
@@ -1612,6 +1951,11 @@ def wq_run(name: str, blocks: list, execute) -> list:
 
     def _note_done(bid: int, meta: dict) -> None:
         done[bid] = meta
+        # Durable done ledger (round 20): every learner mirrors the
+        # winning meta — the same KV bytes from every process, so the
+        # atomic-replace writes are idempotent, and the record survives
+        # the winner dying right after its CAS landed.
+        _journal_write_json(os.path.join(jbase, "done", str(bid)), meta)
         # A stolen or speculated block means some process may never reach
         # the collective shutdown barrier (a dead holder can't; a live
         # straggler may be unboundedly late) — EVERY process that learns
@@ -1642,6 +1986,13 @@ def wq_run(name: str, blocks: list, execute) -> list:
         _ACTIVE_LEASE[0] = {
             "key": _renew_key(bid), "bid": int(bid), "gen": int(gen),
         }
+        # Durable lease ledger (round 20): the newest holder of each
+        # block, so a restarted fleet knows WHOSE durable checkpoint to
+        # resume an in-flight block from.
+        _journal_write_json(
+            os.path.join(jbase, "lease", str(bid)),
+            {"pid": int(pid), "gen": int(gen), "t": time.time()},
+        )
         t0 = time.monotonic()
         try:
             payload = execute(bid, lo, hi, resume_pid, gen, speculative, qd)
@@ -1651,6 +2002,7 @@ def wq_run(name: str, blocks: list, execute) -> list:
         _publish_for(
             c, f"{prefix}/result/{bid}", pid, payload, tolerant=True
         )
+        _journal_wq_result(jbase, bid, payload)
         win = _wq_cas(
             c, _done_key(bid),
             {"pid": int(pid), "gen": int(gen), "spec": bool(speculative),
@@ -1724,7 +2076,7 @@ def wq_run(name: str, blocks: list, execute) -> list:
             continue
         if _try_lease(bid, 0):
             WQ_STATS["leases"] += 1
-            _run_block(bid, 0, -1, False)
+            _run_block(bid, 0, resume_hint.get(bid, -1), False)
 
     # Phase B — wait for the remaining blocks; steal expired leases, lease
     # late-appearing pending blocks, and speculate on stragglers.
@@ -1783,7 +2135,7 @@ def wq_run(name: str, blocks: list, execute) -> list:
                 # a fleet with more blocks than processes racing here).
                 if _try_lease(bid, 0):
                     WQ_STATS["leases"] += 1
-                    _run_block(bid, 0, -1, False)
+                    _run_block(bid, 0, resume_hint.get(bid, -1), False)
                     progressed = True
                 continue
             holder = int(lease.get("pid", -1))
@@ -1869,7 +2221,11 @@ def wq_run(name: str, blocks: list, execute) -> list:
     for bid in range(nb):
         win = done[bid]
         wpid = int(win.get("pid", -1))
-        if wpid == pid and bid in local:
+        if bid in local:
+            # Ours (winner or byte-identical duplicate) or adopted from
+            # the durable journal — the journal-adopted case is the one
+            # where `wpid` names a DEAD fleet's process whose result
+            # keys don't exist in this fleet's KV plane at all.
             parts.append(local[bid])
             continue
         rp = f"{prefix}/result/{bid}/{wpid}"

@@ -76,8 +76,10 @@ def parse_kill_schedule(spec: str) -> List[Tuple[str, str, int]]:
     """Parse ``KSIM_FAULTLINE_KILL`` into ``(pid, state, chunk)`` entries.
 
     Grammar: comma-separated ``<pid>@<state>:<chunk>`` tokens where
-    ``pid`` is a process index or ``*`` (any process — resolved to
-    exactly one via a KV CAS), ``state`` is a heartbeat state (``run``,
+    ``pid`` is a process index, ``*`` (any process — resolved to
+    exactly one via a KV CAS) or ``all`` (round 20: EVERY process,
+    coordinator included, no CAS — the whole-fleet-death drill for the
+    supervised-restart path), ``state`` is a heartbeat state (``run``,
     ``recover``, ``gather``; defaults to ``run`` when omitted), and
     ``chunk`` is the heartbeat cursor at or after which the kill fires
     (``-1`` fires on the first matching beat).  Raises ``ValueError``
@@ -97,7 +99,7 @@ def parse_kill_schedule(spec: str) -> List[Tuple[str, str, int]]:
             pid_s, state = head, "run"
         pid_s = pid_s.strip()
         state = state.strip()
-        if pid_s != "*":
+        if pid_s not in ("*", "all"):
             if not pid_s.lstrip("-").isdigit() or int(pid_s) < 0:
                 raise ValueError(
                     f"faultline kill entry {tok!r}: pid must be a non-negative "
@@ -404,18 +406,34 @@ def maybe_kill(chunk: int, state: str) -> None:
     one process per entry dies, whichever heartbeats first — byte-parity
     of the surviving fleet must hold regardless of which one.  ``*``
     never matches process 0: it hosts the jax.distributed coordination
-    service, whose death aborts every healthy task (unsurvivable by
-    construction) — killing the coordinator must be asked for by name.
+    service, whose death aborts every healthy task — killing the
+    coordinator must be asked for by name (``0@run:N``) or via ``all``
+    (every process, no CAS), the round-20 drills for the supervised
+    durable-journal restart.
+
+    Round 20: kill entries fire only in the ORIGINAL fleet
+    (``KSIM_DCN_RESTART_COUNT`` unset or 0).  A supervised relaunch
+    exports the attempt number, so a resumed fleet replays the same
+    schedule config without re-dying at the same chunk; the rate-driven
+    classes (torn/kv_error/...) stay active — the CRC stack absorbs
+    them either way.
     """
     if not active():
         return
+    try:
+        if int(os.environ.get("KSIM_DCN_RESTART_COUNT", "0") or 0) > 0:
+            return
+    except ValueError:
+        pass
     inj = injector()
     if not inj.kill_entries:
         return
     for idx, (pid_s, st, thr) in enumerate(inj.kill_entries):
         if st != state or int(chunk) < thr:
             continue
-        if pid_s == "*":
+        if pid_s == "all":
+            pass  # every process dies — no CAS, no pid filter
+        elif pid_s == "*":
             if inj.pid == 0 or idx in _KILLED_CAS:
                 continue
             try:

@@ -307,7 +307,11 @@ class FlightRecorder:
     def fleet_event(self, event: dict) -> None:
         """One fleet coordination event (parallel.dcn._mirror_event):
         lease / steal / speculate / block_done / spec_lost / join /
-        claim / recovered. Flattened into the row — every field but the
+        claim / recovered, plus the round-20 durability events —
+        journal_adopt (a completed block adopted from the durable
+        journal without re-execution) and journal_resume (a checkpoint
+        restore whose winning cursor came from the journal rather than
+        the live KV store). Flattened into the row — every field but the
         wall clocks is deterministic for a fixed schedule."""
         ev = dict(event)
         kind = ev.pop("event", "?")

@@ -883,6 +883,25 @@ def snapshot_carriers(tree) -> list:
     ]
 
 
+def checkpoint_payload(cursor: int, sig, carriers, outs) -> dict:
+    """The one checkpoint-blob payload schema every chunk-loop resume
+    path seeds from — claimant recovery (round 15), work-queue steals
+    and speculation (round 18), and the durable-journal whole-fleet
+    restart (round 20): the loop cursor, the engine signature the
+    restorer must match, the host-layout carrier leaves, and the
+    per-chunk outputs accumulated so far (host-resident, so the payload
+    is device-free and survives pickling into the KV store and the
+    filesystem journal alike)."""
+    import jax
+
+    return {
+        "cursor": int(cursor),
+        "sig": list(sig),
+        "leaves": snapshot_carriers(carriers),
+        "outs": jax.device_get(outs),
+    }
+
+
 def restore_carriers(tree, host_leaves):
     """Inverse of :func:`snapshot_carriers` against a freshly-built
     carrier ``tree`` of identical structure: each host leaf is cast to

@@ -3026,15 +3026,33 @@ class WhatIfEngine:
         # for_pid < 0 is a generation-0 queue lease: nobody ran this block
         # before us, so there is no checkpoint to resume — execute from
         # chunk 0 (steals/speculation name the holder via for_pid >= 0).
-        if (
-            recovering
-            and ck_ok
-            and int(self._dcn_recovery.get("for_pid", -1)) >= 0
+        resume_pid, resume_epoch = -1, None
+        if recovering and ck_ok:
+            resume_pid = int(self._dcn_recovery.get("for_pid", -1))
+            resume_epoch = self._dcn_recovery.get("epoch")
+        elif (
+            ck_ok
+            and ck_every > 0
+            and wq_info is None
+            and self._dcn_sliced
+            and not self._dcn_spare
+            and dcn.resume_enabled()
+            and dcn.durable_dir()
         ):
+            # Durable ground (round 20): a restarted fleet (dcn_launch
+            # --resume after whole-fleet death) seeds each process's OWN
+            # static block from its newest complete durable checkpoint.
+            # Epoch defaults to checkpoint_epoch(), which matches the
+            # dead fleet's — the gather sequence replays
+            # deterministically — and load_checkpoint merges the journal
+            # mirror into its candidate walk, so the torn-newest-cursor
+            # fallback applies to journal files too.
+            resume_pid = dcn.process_info()[1]
+        if resume_pid >= 0:
             from ..utils.metrics import log as _log
             from .jax_runtime import restore_carriers
 
-            dead = int(self._dcn_recovery.get("for_pid", -1))
+            dead = resume_pid
             # Round 17: walk the dead process's checkpoints newest-first.
             # dcn.load_checkpoint already skips CRC-invalid blobs; this
             # loop additionally falls back past blobs that validate on
@@ -3045,7 +3063,7 @@ class WhatIfEngine:
             while True:
                 ckd = dcn.load_checkpoint(
                     dead,
-                    epoch=self._dcn_recovery.get("epoch"),
+                    epoch=resume_epoch,
                     before_cursor=before,
                 )
                 if ckd is None:
@@ -3104,23 +3122,19 @@ class WhatIfEngine:
             if ci < start_ci:
                 continue  # chunks already carried by the resumed state
             if ck_every and ci and ci % ck_every == 0:
-                from .jax_runtime import snapshot_carriers
+                from .jax_runtime import checkpoint_payload
 
                 # Round-19 split: only the device→host snapshot stays on
                 # the loop thread (it must see the state exactly as of
                 # chunk ci); encode + CRC framing + the retried KV sets
-                # ride the single-flight publisher thread, newest-wins.
-                # Drained before the final gather below — the one place
-                # this leg needs a durable cursor.
+                # — and the round-20 durable-journal mirror — ride the
+                # single-flight publisher thread, newest-wins. Drained
+                # before the final gather below — the one place this
+                # leg needs a durable cursor.
                 with run_phases.tick("checkpoint"):
                     dcn.publish_checkpoint_async(
                         ci,
-                        {
-                            "cursor": ci,
-                            "sig": _ck_sig,
-                            "leaves": snapshot_carriers(_carriers()),
-                            "outs": jax.device_get(outs),
-                        },
+                        checkpoint_payload(ci, _ck_sig, _carriers(), outs),
                         hb_block,
                         epoch=(self._dcn_recovery or {}).get("epoch"),
                     )

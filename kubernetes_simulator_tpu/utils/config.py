@@ -188,6 +188,27 @@ class DcnWorkQueueSpec:
 
 
 @dataclass
+class DcnDurableSpec:
+    """Durable ground (``dcn.durable:`` YAML section, round 20 —
+    parallel.dcn). Config-level spelling of the ``KSIM_DCN_DURABLE_DIR``
+    / ``KSIM_DCN_RESUME`` env knobs, exported by the CLI (setdefault)
+    before ``jax.distributed`` bring-up. ``dir`` is the
+    filesystem-backed durability journal the fleet mirrors its
+    checkpoint blobs, work-queue results and done/lease ledger into
+    (the writes ride the round-19 background publisher — the sync path
+    gains no stall); ``resume: true`` seeds a fresh fleet's KV store
+    from that journal on bring-up: completed blocks are adopted without
+    re-execution, in-flight blocks resume from their newest complete
+    durable cursor, and the end gather is byte-identical to an
+    uninterrupted run. A bare string is shorthand for ``dir``.
+    validate_config refuses a journal without a DCN fleet or without
+    any checkpoint cadence — there would be nothing durable to mirror."""
+
+    dir: Optional[str] = None
+    resume: bool = False
+
+
+@dataclass
 class FlightRecorderSpec:
     """Flight recorder (``flightRecorder:`` YAML section, round 16 —
     sim.flight). ``path`` is the JSONL stream sink (suffixed per process
@@ -291,6 +312,7 @@ class SimConfig:
     chaos: Optional[ChaosSpec] = None
     dcn_recovery: Optional[DcnRecoverySpec] = None
     dcn_workqueue: Optional[DcnWorkQueueSpec] = None
+    dcn_durable: Optional[DcnDurableSpec] = None
     faultline: Optional[FaultlineSpec] = None
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     output: Optional[str] = None
@@ -442,6 +464,16 @@ class SimConfig:
                     block_size=int(wq.get("blockSize", 0)),
                     speculate=bool(wq.get("speculate", False)),
                     straggler_s=float(wq.get("stragglerS", 0.0)),
+                )
+            du = dc.get("durable")
+            if du is not None:
+                if isinstance(du, str):
+                    # Shorthand: `durable: /path` means `durable: {dir:
+                    # /path}` — mirror-only, no resume.
+                    du = {"dir": du}
+                cfg.dcn_durable = DcnDurableSpec(
+                    dir=du.get("dir"),
+                    resume=bool(du.get("resume", False)),
                 )
         fl = d.get("faultline")
         if fl is not None:

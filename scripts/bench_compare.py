@@ -442,6 +442,31 @@ def compare_pair(
                 notes.append(
                     f"work_queue {key}: {ga} -> {gb} (informational)"
                 )
+
+    # Durability-journal accounting (round 20): informational, never a
+    # regression — the block is a fleet-free micro-bench of the journal
+    # mirror (which rides the background publisher, so it prices
+    # durability, not the headline sync path) plus the cold-resume walk
+    # a supervised restart pays once.
+    ua, ub = da.get("durable_ground"), db.get("durable_ground")
+    if isinstance(ub, dict) and not isinstance(ua, dict):
+        notes.append(
+            "durable_ground: first appearance "
+            f"(journal write overhead {ub.get('journal_write_overhead_pct')}%"
+            f", cold resume {ub.get('cold_resume_wall_s')}s, "
+            f"adopted blocks {ub.get('adopted_blocks')})"
+        )
+    elif isinstance(ua, dict) and isinstance(ub, dict):
+        for key in (
+            "journal_write_overhead_pct",
+            "cold_resume_wall_s",
+            "adopted_blocks",
+        ):
+            ga, gb = ua.get(key), ub.get(key)
+            if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
+                notes.append(
+                    f"durable_ground {key}: {ga} -> {gb} (informational)"
+                )
     return regressions, notes
 
 

@@ -46,6 +46,21 @@ joiners (and every worker) can relieve a LIVE straggler, not just a
 dead process. ``--watch`` renders the queue live: per-block lease
 owners from the beacons, plus lease / steal / speculate / block-done /
 join events.
+
+``--supervise`` (round 20) closes the one hole every in-fleet mechanism
+shares: whole-fleet death, coordinator included — the jax.distributed
+KV store dies with process 0 and takes every lease, checkpoint and
+result with it. With ``--durable DIR`` (or ``KSIM_DCN_DURABLE_DIR``)
+the fleet mirrors all of that to a filesystem journal, and the
+supervisor watches the launch: any attempt that ends without a single
+completed process is relaunched — fresh coordination port, same
+journal — with ``KSIM_DCN_RESUME=1`` and ``KSIM_DCN_RESTART_COUNT``
+exported, under a bounded exponential-backoff restart budget
+(``--max-restarts`` / ``--restart-backoff``). The resumed fleet adopts
+completed work-queue blocks from the journal and resumes in-flight
+blocks from their newest complete durable cursor; its end gather is
+byte-identical to an uninterrupted run. ``--resume`` alone runs one
+attempt seeded from an existing journal (no supervision loop).
 """
 
 from __future__ import annotations
@@ -75,6 +90,9 @@ def child_env(
     devices_per_proc: int,
     hb_dir: str = "",
     join_delay: float = 0.0,
+    durable: str = "",
+    resume: bool = False,
+    restart_count: int = 0,
 ) -> dict:
     env = dict(os.environ)
     env["KSIM_DCN_COORD"] = f"127.0.0.1:{port}"
@@ -82,6 +100,16 @@ def child_env(
     env["KSIM_DCN_PID"] = str(pid)
     if hb_dir:
         env["KSIM_DCN_HB_DIR"] = hb_dir
+    if durable:
+        # Round 20 durable ground: the fleet mirrors checkpoints, queue
+        # results and the done/lease ledger to this journal directory.
+        env["KSIM_DCN_DURABLE_DIR"] = durable
+    if resume:
+        env["KSIM_DCN_RESUME"] = "1"
+    if restart_count > 0:
+        # Consumed by faultline (kill schedules fire only in the
+        # original fleet) and visible to anything attributing restarts.
+        env["KSIM_DCN_RESTART_COUNT"] = str(restart_count)
     if join_delay > 0:
         # Round 18 joiner: defer this process's work-queue contribution
         # (the coordination connect still happens at launch — the
@@ -238,6 +266,17 @@ class FleetWatch:
             )
         elif kind == "join":
             msg = f"{wp} JOINS the fleet mid-replay"
+        # Round 20 durable-journal trail:
+        elif kind == "journal_adopt":
+            msg = (
+                f"{wp} ADOPTS {blk} from the durable journal "
+                f"(completed by dead fleet's p{e.get('from', '?')})"
+            )
+        elif kind == "journal_resume":
+            msg = (
+                f"{wp} RESUMES from durable checkpoint at chunk "
+                f"{e.get('cursor', '?')}"
+            )
         else:
             msg = json.dumps(e, sort_keys=True)
         return f"dcn_launch[watch]: {msg}"
@@ -330,111 +369,24 @@ class FleetWatch:
         )
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        description=__doc__,
-        formatter_class=argparse.RawDescriptionHelpFormatter,
-    )
-    ap.add_argument("--nproc", type=int, default=2)
-    ap.add_argument(
-        "--devices-per-proc", type=int, default=4,
-        help="virtual CPU devices per process (default 4: 2 procs "
-             "reproduce the 8-device single-host mesh)",
-    )
-    ap.add_argument(
-        "--timeout", type=float, default=900.0,
-        help="kill the fleet after this many seconds",
-    )
-    ap.add_argument(
-        "--watch", action="store_true",
-        help="tail worker heartbeats and print fleet progress "
-             "(chunks/sec per process, stragglers flagged) plus round-15 "
-             "claim/recovery events to stderr",
-    )
-    ap.add_argument(
-        "--elastic", type=int, default=0, metavar="SPARES",
-        help="launch SPARES extra spare processes (no scenario block; "
-             "claim-eligible capacity) and enable survivor recovery: a "
-             "worker dying mid-replay no longer kills the fleet — the "
-             "launch succeeds as long as any process completes "
-             "(KSIM_DCN_SPARES / KSIM_DCN_RECOVER)",
-    )
-    ap.add_argument(
-        "--join", type=int, default=0, metavar="JOINERS",
-        help="round 18: launch JOINERS extra processes at the tail of "
-             "the pid range and enable the work-stealing block queue "
-             "(KSIM_DCN_WORKQUEUE=1 unless set): each joiner defers its "
-             "queue contribution by --join-delay seconds (staggered), "
-             "then leases pending blocks — true elastic capacity, not "
-             "just dead-block claims",
-    )
-    ap.add_argument(
-        "--join-delay", type=float, default=5.0, metavar="SECONDS",
-        help="base contribution delay for --join processes (joiner k "
-             "waits k×delay seconds; KSIM_DCN_JOIN_DELAY_S)",
-    )
-    ap.add_argument(
-        "--watch-interval", type=float, default=2.0,
-        help="seconds between --watch progress lines",
-    )
-    ap.add_argument(
-        "--flight", default=os.environ.get("KSIM_FLIGHT_WATCH", ""),
-        metavar="PATH",
-        help="round 16: with --watch, also tail this flight-recorder "
-             "stream (process 0's path; .p<pid> siblings are tailed "
-             "automatically) and print rolling pps / pager stalls / "
-             "exchange ms per process — point it at the same path the "
-             "children's flightRecorder: config writes. Missing streams "
-             "are tolerated (the recorder is off by default)",
-    )
-    ap.add_argument("cmd", nargs=argparse.REMAINDER,
-                    help="command to run in every process (after --)")
-    args = ap.parse_args(argv)
-    cmd = args.cmd
-    if cmd and cmd[0] == "--":
-        cmd = cmd[1:]
-    if not cmd:
-        ap.error("no command given (append: -- python -m ... )")
-    if args.nproc < 1:
-        ap.error("--nproc must be >= 1")
-    if args.elastic < 0:
-        ap.error("--elastic must be >= 0")
-    if args.join < 0:
-        ap.error("--join must be >= 0")
-    if args.join and args.elastic:
-        ap.error(
-            "--join and --elastic are mutually exclusive: joiners ride "
-            "the work queue (any process leases any pending block), "
-            "which subsumes spare capacity"
-        )
-    if args.join_delay < 0:
-        ap.error("--join-delay must be >= 0")
-    nproc = args.nproc + args.elastic + args.join
-    elastic = args.elastic > 0
-    if elastic:
-        # Spares own no scenario block (parallel.dcn.spare_count); the
-        # recovery knob defaults on so survivors/spare claim dead blocks.
-        os.environ["KSIM_DCN_SPARES"] = str(args.elastic)
-        os.environ.setdefault("KSIM_DCN_RECOVER", "1")
-    if args.join:
-        # Round 18 joiners are spare-pid processes under the work queue:
-        # they own no static block, connect at launch (the runtime
-        # barriers on connects) and defer their queue contribution.
-        os.environ["KSIM_DCN_SPARES"] = str(args.join)
-        os.environ.setdefault("KSIM_DCN_WORKQUEUE", "1")
-    tolerant = elastic or str(
-        os.environ.get("KSIM_DCN_RECOVER", "0")
-    ).strip().lower() in ("1", "true", "yes", "on")
-
-    hb_dir = ""
-    watch = None
-    if args.watch:
-        hb_dir = tempfile.mkdtemp(prefix="ksim_hb_")
-        watch = FleetWatch(
-            hb_dir, nproc,
-            stall_s=float(os.environ.get("KSIM_DCN_STALL_S", "60")),
-            flight_path=args.flight,
-        )
+def launch_once(
+    cmd,
+    args,
+    nproc: int,
+    tolerant: bool,
+    hb_dir: str,
+    watch,
+    attempt: int = 0,
+    resume: bool = False,
+    durable: str = "",
+) -> int:
+    """One fleet attempt: launch ``nproc`` processes on a fresh
+    coordination port, monitor them to completion, and return the
+    attempt's exit code (0 = at least one process — all of them, when
+    ``tolerant`` is off — completed the replay). Extracted from main()
+    in round 20 so ``--supervise`` can run it in a bounded restart
+    loop; ``attempt``/``resume``/``durable`` ride into every child's
+    environment."""
     port = free_port()
     procs, tails = [], []
     for pid in range(nproc):
@@ -445,7 +397,8 @@ def main(argv=None) -> int:
             join_delay = args.join_delay * (pid - args.nproc + 1)
         env = child_env(
             pid, nproc, port, args.devices_per_proc, hb_dir,
-            join_delay=join_delay,
+            join_delay=join_delay, durable=durable, resume=resume,
+            restart_count=attempt,
         )
         if pid == 0:
             p = subprocess.Popen(cmd, env=env)
@@ -546,9 +499,204 @@ def main(argv=None) -> int:
                 p.kill()
         for p in procs:
             p.wait()
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument(
+        "--devices-per-proc", type=int, default=4,
+        help="virtual CPU devices per process (default 4: 2 procs "
+             "reproduce the 8-device single-host mesh)",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=900.0,
+        help="kill the fleet after this many seconds",
+    )
+    ap.add_argument(
+        "--watch", action="store_true",
+        help="tail worker heartbeats and print fleet progress "
+             "(chunks/sec per process, stragglers flagged) plus round-15 "
+             "claim/recovery events to stderr",
+    )
+    ap.add_argument(
+        "--elastic", type=int, default=0, metavar="SPARES",
+        help="launch SPARES extra spare processes (no scenario block; "
+             "claim-eligible capacity) and enable survivor recovery: a "
+             "worker dying mid-replay no longer kills the fleet — the "
+             "launch succeeds as long as any process completes "
+             "(KSIM_DCN_SPARES / KSIM_DCN_RECOVER)",
+    )
+    ap.add_argument(
+        "--join", type=int, default=0, metavar="JOINERS",
+        help="round 18: launch JOINERS extra processes at the tail of "
+             "the pid range and enable the work-stealing block queue "
+             "(KSIM_DCN_WORKQUEUE=1 unless set): each joiner defers its "
+             "queue contribution by --join-delay seconds (staggered), "
+             "then leases pending blocks — true elastic capacity, not "
+             "just dead-block claims",
+    )
+    ap.add_argument(
+        "--join-delay", type=float, default=5.0, metavar="SECONDS",
+        help="base contribution delay for --join processes (joiner k "
+             "waits k×delay seconds; KSIM_DCN_JOIN_DELAY_S)",
+    )
+    ap.add_argument(
+        "--watch-interval", type=float, default=2.0,
+        help="seconds between --watch progress lines",
+    )
+    ap.add_argument(
+        "--durable", default=os.environ.get("KSIM_DCN_DURABLE_DIR", ""),
+        metavar="DIR",
+        help="round 20: durability-journal directory "
+             "(KSIM_DCN_DURABLE_DIR) — the fleet mirrors checkpoint "
+             "blobs, work-queue results and the done/lease ledger there, "
+             "so a whole-fleet crash is restartable with --resume or "
+             "--supervise",
+    )
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="round 20: seed the fleet from an existing --durable "
+             "journal (KSIM_DCN_RESUME=1): completed blocks are adopted "
+             "without re-execution, in-flight blocks resume from their "
+             "newest complete durable cursor",
+    )
+    ap.add_argument(
+        "--supervise", action="store_true",
+        help="round 20: watch the fleet for whole-fleet death "
+             "(coordinator included) and relaunch it with --resume on a "
+             "fresh coordination port, under the --max-restarts / "
+             "--restart-backoff budget; requires --durable",
+    )
+    ap.add_argument(
+        "--max-restarts", type=int, default=3, metavar="N",
+        help="restart budget for --supervise (default 3)",
+    )
+    ap.add_argument(
+        "--restart-backoff", type=float, default=1.0, metavar="SECONDS",
+        help="base delay before a supervised relaunch; doubles per "
+             "attempt (default 1.0)",
+    )
+    ap.add_argument(
+        "--flight", default=os.environ.get("KSIM_FLIGHT_WATCH", ""),
+        metavar="PATH",
+        help="round 16: with --watch, also tail this flight-recorder "
+             "stream (process 0's path; .p<pid> siblings are tailed "
+             "automatically) and print rolling pps / pager stalls / "
+             "exchange ms per process — point it at the same path the "
+             "children's flightRecorder: config writes. Missing streams "
+             "are tolerated (the recorder is off by default)",
+    )
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to run in every process (after --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (append: -- python -m ... )")
+    if args.nproc < 1:
+        ap.error("--nproc must be >= 1")
+    if args.elastic < 0:
+        ap.error("--elastic must be >= 0")
+    if args.join < 0:
+        ap.error("--join must be >= 0")
+    if args.join and args.elastic:
+        ap.error(
+            "--join and --elastic are mutually exclusive: joiners ride "
+            "the work queue (any process leases any pending block), "
+            "which subsumes spare capacity"
+        )
+    if args.join_delay < 0:
+        ap.error("--join-delay must be >= 0")
+    if args.supervise and not args.durable:
+        ap.error(
+            "--supervise requires --durable DIR (or KSIM_DCN_DURABLE_DIR)"
+            ": without a journal there is nothing for a restarted fleet "
+            "to resume from"
+        )
+    if args.resume and not args.durable:
+        ap.error("--resume requires --durable DIR (or KSIM_DCN_DURABLE_DIR)")
+    if args.max_restarts < 0:
+        ap.error("--max-restarts must be >= 0")
+    if args.restart_backoff < 0:
+        ap.error("--restart-backoff must be >= 0")
+    nproc = args.nproc + args.elastic + args.join
+    elastic = args.elastic > 0
+    if elastic:
+        # Spares own no scenario block (parallel.dcn.spare_count); the
+        # recovery knob defaults on so survivors/spare claim dead blocks.
+        os.environ["KSIM_DCN_SPARES"] = str(args.elastic)
+        os.environ.setdefault("KSIM_DCN_RECOVER", "1")
+    if args.join:
+        # Round 18 joiners are spare-pid processes under the work queue:
+        # they own no static block, connect at launch (the runtime
+        # barriers on connects) and defer their queue contribution.
+        os.environ["KSIM_DCN_SPARES"] = str(args.join)
+        os.environ.setdefault("KSIM_DCN_WORKQUEUE", "1")
+    tolerant = elastic or str(
+        os.environ.get("KSIM_DCN_RECOVER", "0")
+    ).strip().lower() in ("1", "true", "yes", "on")
+
+    hb_dir = ""
+    watch = None
+    if args.watch:
+        hb_dir = tempfile.mkdtemp(prefix="ksim_hb_")
+        watch = FleetWatch(
+            hb_dir, nproc,
+            stall_s=float(os.environ.get("KSIM_DCN_STALL_S", "60")),
+            flight_path=args.flight,
+        )
+    try:
+        if not args.supervise:
+            return launch_once(
+                cmd, args, nproc, tolerant, hb_dir, watch,
+                attempt=0, resume=args.resume, durable=args.durable,
+            )
+        # Round 20 supervision loop: each attempt gets a fresh
+        # coordination port (the old coordinator may have died holding
+        # the socket); every relaunch resumes from the journal with the
+        # attempt number exported. Whole-fleet death is exactly "the
+        # attempt returned nonzero": a tolerant fleet already absorbs
+        # partial death in-attempt, so a failed attempt means nobody
+        # completed the replay — coordinator death included.
+        attempt = 0
+        while True:
+            rc = launch_once(
+                cmd, args, nproc, tolerant, hb_dir, watch,
+                attempt=attempt,
+                resume=args.resume or attempt > 0,
+                durable=args.durable,
+            )
+            if rc == 0:
+                if attempt > 0:
+                    print(
+                        f"dcn_launch: fleet completed after {attempt} "
+                        "supervised restart(s)", file=sys.stderr,
+                    )
+                return 0
+            if attempt >= args.max_restarts:
+                print(
+                    f"dcn_launch: restart budget exhausted after "
+                    f"{attempt} restart(s) — exit {rc}", file=sys.stderr,
+                )
+                return rc
+            delay = args.restart_backoff * (2 ** attempt)
+            attempt += 1
+            print(
+                f"dcn_launch: whole fleet died (exit {rc}) — "
+                f"relaunching with --resume in {delay:.1f}s "
+                f"(attempt {attempt}/{args.max_restarts})",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+    finally:
         if hb_dir:
             shutil.rmtree(hb_dir, ignore_errors=True)
-    return rc
 
 
 if __name__ == "__main__":

@@ -16,6 +16,14 @@ oracle.  The injector only ever touches the coordination plane or the
 holder's wall-clock, so any divergence is a real semantics bug, not
 noise.
 
+Round 20 adds the two SUPERVISED drills of the durable-ground
+acceptance bar, run through ``scripts/dcn_launch.py --supervise`` over
+a durability journal: the coordinator SIGKILLed by name (``0@run:1`` —
+previously the canonical unsurvivable death) and the whole fleet killed
+mid-publish (``all@run:1`` under a 50% torn-write rate).  Both must end
+with the supervisor relaunching the fleet with ``--resume`` and the
+restarted fleet's gather byte-identical to the no-failure oracle.
+
 Usage (also importable — tests/test_faultline_fuzz.py drives the same
 functions from the pytest slow slice):
 
@@ -149,6 +157,14 @@ def main_oracle() -> int:
 # publication, under a 50% torn-write rate. The survivor must recover
 # from the prior COMPLETE cursor (the manifest is written last, so a
 # half-published epoch is invisible) and still gather byte-identical.
+#
+# Round 20 appends the two SUPERVISED durable-ground drills, which run
+# under ``dcn_launch.py --supervise`` with a durability journal instead
+# of a hand-rolled Popen fleet: the coordinator SIGKILLed by name
+# (``0@run:1``), and the whole fleet killed at once (``all@run:1``)
+# under a 50% torn-write rate that also tears journal files.  Both end
+# only when the supervisor's relaunched fleet gathers byte-identical to
+# the oracle — whole-fleet death is now inside the bar, not outside it.
 MANDATORY = (
     {"name": "double-kill", "kill": "1@run:0,2@run:0", "seed": 1701},
     {"name": "claimant-kill", "kill": "2@run:0,*@recover:-1", "seed": 1702},
@@ -158,15 +174,20 @@ MANDATORY = (
      "kill": "*@spec:-1", "stall_s": 2, "straggler_s": 1.0, "seed": 1802},
     {"name": "mid-publish-kill", "kill": "*@run:1", "torn_rate": 0.5,
      "seed": 1901},
+    {"name": "coord-kill-restart", "kill": "0@run:1", "supervised": 1,
+     "seed": 2001},
+    {"name": "fleet-kill-restart", "kill": "all@run:1", "torn_rate": 0.5,
+     "supervised": 1, "seed": 2002},
 )
 
 
 def sample_schedules(seed: int, n: int):
     """``n`` fault schedules, a pure function of ``seed``.  The first
-    five are always the mandatory double-kill, claimant-kill,
-    wq-straggler, wq-spec-kill and mid-publish-kill drills; the rest
-    mix a random named kill (or none) with KV error/latency/torn/stale
-    rates low enough that the bounded retries absorb them."""
+    seven are always the mandatory double-kill, claimant-kill,
+    wq-straggler, wq-spec-kill, mid-publish-kill and the two supervised
+    durable-ground drills (coord-kill-restart, fleet-kill-restart); the
+    rest mix a random named kill (or none) with KV error/latency/torn/
+    stale rates low enough that the bounded retries absorb them."""
     rng = random.Random(int(seed) * 9176 + 5)
     out = [dict(s) for s in MANDATORY]
     while len(out) < n:
@@ -204,6 +225,9 @@ def named_kill_pids(sched: dict):
     ):
         if pid_s == "*":
             wildcard += 1
+        elif pid_s == "all":
+            if state == "run" and chunk < CHUNKS_PER_WORKER:
+                named.update(range(NPROC))
         elif state == "run" and chunk < CHUNKS_PER_WORKER:
             named.add(int(pid_s))
     return named, wildcard
@@ -257,11 +281,87 @@ def run_oracle(timeout_s: float = 600.0) -> dict:
     return json.loads(lines[-1][len("FAULTLINE_RESULT "):])
 
 
+def run_supervised_schedule(sched: dict, hb_dir: str,
+                            timeout_s: float = 600.0) -> dict:
+    """Run one schedule through ``scripts/dcn_launch.py --supervise``
+    over a durability journal.  The supervisor owns ports, pids and
+    relaunch-with-``--resume``; the fault env rides through untouched
+    (``maybe_kill`` self-disarms on KSIM_DCN_RESTART_COUNT > 0, so the
+    kill fires only in the first life).  Worker 0 inherits the
+    supervisor's stdout, so its FAULTLINE_RESULT lines — one per life —
+    land in the captured blob; the LAST one is the restarted fleet's
+    gather."""
+    durable = os.path.join(hb_dir, "journal")
+    os.makedirs(durable, exist_ok=True)
+    env = _child_env({
+        "KSIM_DCN_RECOVER": "1",
+        "KSIM_DCN_CKPT_EVERY": "1",
+        "KSIM_DCN_TIMEOUT_S": "600",
+        "KSIM_DCN_STALL_S": sched.get("stall_s", 2),
+        "KSIM_DCN_POLL_S": "0.3",
+        "KSIM_DCN_HEARTBEAT_EVERY": "1",
+        "KSIM_DCN_MAX_CLAIMS": "2",
+        "KSIM_DCN_RETRY_BASE_S": "0.01",
+        "KSIM_DCN_HB_DIR": hb_dir,
+        "KSIM_FAULTLINE": "1",
+        "KSIM_FAULTLINE_SEED": sched.get("seed", 0),
+        "KSIM_FAULTLINE_KV_ERROR_RATE": sched.get("kv_error_rate", 0.0),
+        "KSIM_FAULTLINE_KV_DELAY_RATE": sched.get("kv_delay_rate", 0.0),
+        "KSIM_FAULTLINE_KV_DELAY_S": "0.01",
+        "KSIM_FAULTLINE_TORN_RATE": sched.get("torn_rate", 0.0),
+        "KSIM_FAULTLINE_STALE_RATE": sched.get("stale_rate", 0.0),
+        "KSIM_FAULTLINE_KILL": sched.get("kill", ""),
+        "KSIM_FAULTLINE_SLOW": sched.get("slow", ""),
+    })
+    # The supervisor assigns coordinator address, pids and nproc itself;
+    # stray values from an outer fleet would poison its children.
+    for k in ("KSIM_DCN_COORD", "KSIM_DCN_PID", "KSIM_DCN_NPROC",
+              "KSIM_DCN_DURABLE_DIR", "KSIM_DCN_RESUME",
+              "KSIM_DCN_RESTART_COUNT"):
+        env.pop(k, None)
+    cmd = [
+        sys.executable, os.path.join(_REPO, "scripts", "dcn_launch.py"),
+        "--nproc", str(NPROC), "--devices-per-proc", "2",
+        "--supervise", "--durable", durable,
+        "--max-restarts", "2", "--restart-backoff", "0.2",
+        "--timeout", str(max(min(timeout_s / 2.0, 240.0), 60.0)),
+        "--", sys.executable, _SELF, "--worker",
+    ]
+    try:
+        p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        blob = "\n".join(
+            str(s or "") for s in (e.stdout, e.stderr)
+        ) or "supervised fleet timed out"
+        return {"skip": SKIP_MARKER in blob, "timeout": True,
+                "supervised": True, "rcs": {}, "results": {}, "blob": blob}
+    blob = (p.stdout or "") + "\n" + (p.stderr or "")
+    results = {}
+    lines = [
+        l for l in (p.stdout or "").splitlines()
+        if l.startswith("FAULTLINE_RESULT ")
+    ]
+    if p.returncode == 0 and lines:
+        results[0] = json.loads(lines[-1][len("FAULTLINE_RESULT "):])
+    return {
+        "skip": SKIP_MARKER in blob,
+        "timeout": False,
+        "supervised": True,
+        "rcs": {0: p.returncode},
+        "results": results,
+        "blob": blob,
+    }
+
+
 def run_schedule(sched: dict, hb_dir: str, timeout_s: float = 600.0) -> dict:
     """Run one schedule against a fresh 3-worker fleet.  Returns
     ``{"skip": bool, "rcs": {pid: rc}, "results": {pid: payload},
     "blob": str}`` — ``results`` holds every surviving worker's gathered
-    payload."""
+    payload.  Supervised schedules are delegated to
+    ``run_supervised_schedule``."""
+    if sched.get("supervised"):
+        return run_supervised_schedule(sched, hb_dir, timeout_s=timeout_s)
     port = _free_port()
     base = _child_env({
         "KSIM_DCN_COORD": f"127.0.0.1:{port}",
@@ -343,9 +443,41 @@ def run_schedule(sched: dict, hb_dir: str, timeout_s: float = 600.0) -> dict:
     }
 
 
+def check_supervised(sched: dict, out: dict, oracle: dict):
+    """Assertions for a supervised drill: the kill must actually have
+    forced a relaunch-with-``--resume``, the supervisor must end clean
+    within its restart budget, and the restarted fleet's gather must be
+    byte-identical to the no-failure oracle."""
+    name = sched["name"]
+    if out.get("timeout"):
+        return [f"{name}: supervised fleet timed out"]
+    fails = []
+    rc = out["rcs"].get(0)
+    if rc != 0:
+        fails.append(f"{name}: supervisor exited {rc}")
+    if "relaunching with --resume" not in out["blob"]:
+        fails.append(
+            f"{name}: the kill fired but no supervised relaunch "
+            "appeared in the logs"
+        )
+    got = out["results"].get(0)
+    if got is None:
+        if rc == 0:
+            fails.append(f"{name}: restarted fleet printed no result")
+    elif got != oracle:
+        diff = [k for k in oracle if got.get(k) != oracle[k]]
+        fails.append(
+            f"{name}: restarted fleet diverged from the no-failure "
+            f"oracle in {diff}"
+        )
+    return fails
+
+
 def check_schedule(sched: dict, out: dict, oracle: dict):
     """Byte-parity + liveness assertions for one schedule run.  Returns
     a list of failure strings (empty ⇒ the schedule passed)."""
+    if sched.get("supervised"):
+        return check_supervised(sched, out, oracle)
     fails = []
     if out.get("timeout"):
         return [f"{sched['name']}: fleet timed out"]
@@ -471,11 +603,12 @@ def main() -> int:
                     help="internal: run as one fleet worker")
     ap.add_argument("--oracle", action="store_true",
                     help="internal: run the no-failure oracle")
-    ap.add_argument("--schedules", type=int, default=6,
-                    help="number of fault schedules to sample (>= 5 "
+    ap.add_argument("--schedules", type=int, default=8,
+                    help="number of fault schedules to sample (>= 7 "
                          "includes the mandatory double-kill, "
-                         "claimant-kill, wq-straggler, wq-spec-kill "
-                         "and mid-publish-kill)")
+                         "claimant-kill, wq-straggler, wq-spec-kill, "
+                         "mid-publish-kill and the supervised "
+                         "coord-kill-restart / fleet-kill-restart)")
     ap.add_argument("--seed", type=int, default=17)
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-run timeout in seconds")

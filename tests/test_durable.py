@@ -1,0 +1,422 @@
+"""Round-20 durable ground (ISSUE round 20): the filesystem-backed
+durability journal (``KSIM_DCN_DURABLE_DIR`` / ``dcn.durable:``) that
+makes WHOLE-FLEET death — coordinator included — restartable.
+
+Fast, in-process pins (the live supervised-restart drills ride the slow
+faultline fuzz suite): the journal mirror writes the same framed bytes
+as the KV plane with manifest-last / temp-then-rename discipline;
+``load_checkpoint`` seeds an EMPTY KV plane from the journal and walks
+torn/truncated/stale journal blobs through the exact round-17
+prior-complete-cursor fallback; ``wq_run`` adopts a dead fleet's
+completed blocks without re-execution; the faultline ``all`` kill token
+parses and fires for every pid while ``KSIM_DCN_RESTART_COUNT`` disarms
+kill schedules in relaunched fleets; and the ``dcn.durable`` YAML
+section round-trips with its validate_config refusals.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+)
+
+from kubernetes_simulator_tpu.parallel import dcn  # noqa: E402
+from kubernetes_simulator_tpu.parallel import faultline  # noqa: E402
+from kubernetes_simulator_tpu.utils.config import SimConfig  # noqa: E402
+
+
+class _FakeKV:
+    """In-memory stand-in for the jaxlib coordination-service KV client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.store:
+            raise RuntimeError(f"key exists: {key}")
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        import time
+
+        if key in self.store:
+            return self.store[key]
+        time.sleep(timeout_ms / 1000.0)
+        raise RuntimeError(f"Deadline Exceeded: {key}")
+
+    def key_value_dir_get(self, prefix):
+        return [
+            (k, v) for k, v in sorted(self.store.items())
+            if k.startswith(prefix)
+        ]
+
+
+def _fleet(monkeypatch, nproc=2, pid=1, journal=None):
+    kv = _FakeKV()
+    monkeypatch.setattr(dcn, "process_info", lambda: (nproc, pid))
+    monkeypatch.setattr(dcn, "_client", lambda: kv)
+    monkeypatch.setattr(dcn, "_degraded_exit_armed", [True])
+    monkeypatch.setattr(dcn, "DEGRADED", set())
+    if journal is not None:
+        monkeypatch.setenv("KSIM_DCN_DURABLE_DIR", str(journal))
+    else:
+        monkeypatch.delenv("KSIM_DCN_DURABLE_DIR", raising=False)
+    monkeypatch.delenv("KSIM_DCN_RESUME", raising=False)
+    return kv
+
+
+def _payload(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "cursor": 3,
+        "leaves": {"states": rng.integers(-1, 64, size=(8, 16),
+                                          dtype=np.int32)},
+    }
+
+
+# -- journal writer discipline ----------------------------------------------
+
+
+def test_journal_blob_roundtrip_and_manifest_last(tmp_path, monkeypatch):
+    """A mirrored blob reads back byte-identical through the full
+    integrity stack, leaves no temp files, and a blob directory missing
+    its manifest is invisible to the checkpoint-entry scan (the exact KV
+    in-flight rule)."""
+    monkeypatch.setenv("KSIM_DCN_DURABLE_DIR", str(tmp_path))
+    pay = _payload(1)
+    raw = dcn._encode_payload(pay)
+    import zlib
+
+    crc, blob_len = 0, 0
+    for ch in raw:
+        crc = zlib.crc32(ch.encode("ascii"), crc)
+        blob_len += len(ch)
+    manifest = json.dumps(
+        {"n": len(raw), "crc": f"{crc & 0xFFFFFFFF:08x}", "len": blob_len},
+        sort_keys=True,
+    )
+    sub = os.path.join("ckpt", "7", "1", "4-8", "3")
+    assert dcn._journal_write_blob(
+        sub, [dcn._frame_chunk(ch) for ch in raw], manifest
+    )
+    d = tmp_path / "ckpt" / "7" / "1" / "4-8" / "3"
+    assert (d / "manifest.json").exists()
+    assert not list(tmp_path.rglob("*.tmp")), "temp file left behind"
+    got = dcn._journal_read_blob(sub)
+    np.testing.assert_array_equal(
+        got["leaves"]["states"], pay["leaves"]["states"]
+    )
+    # No manifest ⇒ in flight ⇒ skipped by the resume scan.
+    os.remove(d / "manifest.json")
+    assert dcn._journal_ckpt_entries(1, 7) == {}
+
+
+def test_journal_write_noop_without_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("KSIM_DCN_DURABLE_DIR", raising=False)
+    assert dcn.durable_dir() is None
+    assert dcn._journal_write_blob("ckpt/1/0/0-4/0", ["x"], "{}") is False
+    assert dcn._journal_write_json("wq/1/g/done/0", {"pid": 0}) is False
+    assert not list(tmp_path.iterdir())
+
+
+# -- checkpoint mirror + resume seeding --------------------------------------
+
+
+def test_publish_checkpoint_mirrors_journal(tmp_path, monkeypatch):
+    """publish_checkpoint writes the SAME framed bytes to the KV plane
+    and the journal (manifest included), stamps the mirror in
+    JOURNAL_STATS, and flags the ckpt_publish event — and with the
+    journal unset the event is byte-unchanged from round 19."""
+    kv = _fleet(monkeypatch, nproc=2, pid=1, journal=tmp_path)
+    events = []
+    monkeypatch.setattr(dcn, "EVENT_SINKS", [events.append])
+    js0 = dcn.journal_stats()
+    assert dcn.publish_checkpoint(3, _payload(2), (4, 8), epoch=7)
+    js1 = dcn.journal_stats()
+    assert js1["writes"] == js0["writes"] + 1
+    assert js1["bytes"] > js0["bytes"]
+    d = tmp_path / "ckpt" / "7" / "1" / "4-8" / "3"
+    man = json.loads((d / "manifest.json").read_text())
+    assert man == json.loads(kv.store["ksim/ckpt/7/1/4-8/3/n"])
+    for j in range(int(man["n"])):
+        assert (d / str(j)).read_text() == kv.store[f"ksim/ckpt/7/1/4-8/3/{j}"]
+    pub = [e for e in events if e.get("kind") == "ckpt_publish"]
+    assert pub and pub[-1].get("journal") == 1
+    # Journal off: same publication, no journal key in the event.
+    events.clear()
+    kv2 = _fleet(monkeypatch, nproc=2, pid=1, journal=None)
+    monkeypatch.setattr(dcn, "EVENT_SINKS", [events.append])
+    assert dcn.publish_checkpoint(3, _payload(2), (4, 8), epoch=7)
+    pub = [e for e in events if e.get("kind") == "ckpt_publish"]
+    assert pub and "journal" not in pub[-1]
+    assert kv2.store["ksim/ckpt/7/1/4-8/3/n"] == kv.store[
+        "ksim/ckpt/7/1/4-8/3/n"
+    ]
+
+
+def test_load_checkpoint_seeds_fresh_kv_from_journal(tmp_path, monkeypatch):
+    """The restart path: fleet A publishes with the journal on, dies;
+    fleet B (EMPTY KV plane) load_checkpoints the same pid/epoch and
+    gets A's newest checkpoint from the journal — with the
+    journal_resume event mirrored for the watcher."""
+    _fleet(monkeypatch, nproc=2, pid=1, journal=tmp_path)
+    pay1, pay3 = _payload(3), _payload(4)
+    assert dcn.publish_checkpoint(1, pay1, (4, 8), epoch=7)
+    assert dcn.publish_checkpoint(3, pay3, (4, 8), epoch=7)
+    # Fresh fleet: new KV store, same journal.
+    _fleet(monkeypatch, nproc=2, pid=0, journal=tmp_path)
+    events = []
+    monkeypatch.setattr(dcn, "EVENT_SINKS", [events.append])
+    js0 = dcn.journal_stats()
+    got = dcn.load_checkpoint(1, epoch=7)
+    assert got["cursor"] == 3 and got["block"] == (4, 8)
+    np.testing.assert_array_equal(
+        got["payload"]["leaves"]["states"], pay3["leaves"]["states"]
+    )
+    assert dcn.journal_stats()["resumes"] == js0["resumes"] + 1
+    res = [e for e in events if e.get("event") == "journal_resume"]
+    assert res and res[-1]["cursor"] == 3 and res[-1]["block"] == [4, 8]
+    # before_cursor honored on journal candidates (the stale-payload
+    # retry path): strictly older cursors only.
+    assert dcn.load_checkpoint(1, epoch=7, before_cursor=3)["cursor"] == 1
+    # Epoch isolation holds for the journal exactly like the KV plane.
+    assert dcn.load_checkpoint(1, epoch=8) is None
+
+
+def test_torn_journal_chunk_falls_back_to_prior_cursor(
+    tmp_path, monkeypatch
+):
+    """Satellite 4: a journal blob torn by a crash (or the faultline
+    torn-write injector) fails frame validation on resume and the reader
+    falls back to the PRIOR complete durable cursor, counting the
+    fallback in CRC_STATS."""
+    _fleet(monkeypatch, nproc=2, pid=1, journal=tmp_path)
+    assert dcn.publish_checkpoint(1, _payload(5), (4, 8), epoch=7)
+    assert dcn.publish_checkpoint(3, _payload(6), (4, 8), epoch=7)
+    # Tear the newest cursor's first chunk mid-file (manifest intact —
+    # exactly what a crash between replace()s can leave).
+    chunk = tmp_path / "ckpt" / "7" / "1" / "4-8" / "3" / "0"
+    blob = chunk.read_text()
+    chunk.write_text(blob[: len(blob) // 2])
+    _fleet(monkeypatch, nproc=2, pid=0, journal=tmp_path)
+    crc0 = dict(dcn.CRC_STATS)
+    got = dcn.load_checkpoint(1, epoch=7)
+    assert got["cursor"] == 1, "torn newest blob must not win"
+    assert dcn.CRC_STATS["fallbacks"] > crc0["fallbacks"]
+    # Truncated to nothing ⇒ same fallback; missing manifest ⇒ the
+    # cursor is invisible (in-flight rule) rather than a fallback.
+    chunk.write_text("")
+    assert dcn.load_checkpoint(1, epoch=7)["cursor"] == 1
+    os.remove(tmp_path / "ckpt" / "7" / "1" / "4-8" / "3" / "manifest.json")
+    crc1 = dict(dcn.CRC_STATS)
+    assert dcn.load_checkpoint(1, epoch=7)["cursor"] == 1
+    assert dcn.CRC_STATS["fallbacks"] == crc1["fallbacks"]
+
+
+# -- work-queue adoption -----------------------------------------------------
+
+
+def test_wq_scan_adopts_done_blocks_and_rejects_torn(tmp_path, monkeypatch):
+    """_journal_wq_scan adopts blocks whose done record AND result blob
+    validate, drops a done record over a torn result (the block
+    re-executes, counted as a CRC fallback), and surfaces the newest
+    durable lease holder for unfinished blocks."""
+    monkeypatch.setenv("KSIM_DCN_DURABLE_DIR", str(tmp_path))
+    jbase = os.path.join("wq", "1", "g")
+    for bid in (0, 1):
+        assert dcn._journal_wq_result(jbase, bid, _payload(10 + bid))
+        assert dcn._journal_write_json(
+            os.path.join(jbase, "done", str(bid)),
+            {"pid": 1, "gen": 0, "spec": False},
+        )
+    assert dcn._journal_write_json(
+        os.path.join(jbase, "lease", "2"), {"pid": 1, "gen": 0, "t": 0.0}
+    )
+    # Tear block 1's result.
+    chunk = tmp_path / "wq" / "1" / "g" / "result" / "1" / "0"
+    chunk.write_text(chunk.read_text()[:10])
+    crc0 = dict(dcn.CRC_STATS)
+    adopted, hint = dcn._journal_wq_scan(1, "g", 3)
+    assert sorted(adopted) == [0]
+    meta, pay = adopted[0]
+    assert meta["pid"] == 1
+    np.testing.assert_array_equal(
+        pay["leaves"]["states"], _payload(10)["leaves"]["states"]
+    )
+    assert hint == {2: 1}
+    assert dcn.CRC_STATS["fallbacks"] > crc0["fallbacks"]
+
+
+def test_wq_run_adopts_journal_without_reexecution(tmp_path, monkeypatch):
+    """The tentpole resume bar, in-process: run a work queue with the
+    journal on, then bring up a FRESH fleet (empty KV) over the same
+    journal with KSIM_DCN_RESUME=1 — every block is adopted without
+    calling execute, and the assembled gather is byte-identical."""
+    monkeypatch.setenv("KSIM_DCN_STALL_S", "60")
+    monkeypatch.setenv("KSIM_DCN_POLL_S", "0.05")
+    blocks = [(0, 4), (4, 8), (8, 12)]
+
+    def execute(bid, lo, hi, resume_pid, gen, speculative, qd):
+        return {"bid": bid, "rows": list(range(lo, hi))}
+
+    _fleet(monkeypatch, nproc=1, pid=0, journal=tmp_path)
+    monkeypatch.setattr(dcn, "_seq", 0)
+    first = dcn.wq_run("g", blocks, execute)
+    assert [p["bid"] for p in first] == [0, 1, 2]
+
+    def boom(*a, **k):
+        raise AssertionError("an adopted block must not re-execute")
+
+    _fleet(monkeypatch, nproc=1, pid=0, journal=tmp_path)
+    monkeypatch.setenv("KSIM_DCN_RESUME", "1")
+    monkeypatch.setattr(dcn, "_seq", 0)
+    events = []
+    monkeypatch.setattr(dcn, "EVENT_SINKS", [events.append])
+    js0 = dcn.journal_stats()
+    second = dcn.wq_run("g", blocks, boom)
+    assert second == first
+    assert dcn.journal_stats()["adopted"] == js0["adopted"] + 3
+    adopts = [e for e in events if e.get("event") == "journal_adopt"]
+    assert sorted(e["block"] for e in adopts) == [0, 1, 2]
+    # Without resume the journal alone changes nothing: the queue
+    # re-executes (fresh KV again, resume off).
+    _fleet(monkeypatch, nproc=1, pid=0, journal=tmp_path)
+    monkeypatch.setattr(dcn, "_seq", 0)
+    third = dcn.wq_run("g", blocks, execute)
+    assert third == first
+
+
+# -- faultline: the all token + restart disarm -------------------------------
+
+
+def test_parse_kill_schedule_all_token():
+    assert faultline.parse_kill_schedule("all@run:1") == [("all", "run", 1)]
+    assert faultline.parse_kill_schedule("0@run:1,all@run:2") == [
+        ("0", "run", 1), ("all", "run", 2),
+    ]
+    with pytest.raises(ValueError):
+        faultline.parse_kill_schedule("some@run:1")
+
+
+def test_maybe_kill_all_fires_and_restart_disarms(monkeypatch):
+    """The ``all`` token kills EVERY pid (no CAS, coordinator included)
+    — and any kill schedule is inert once KSIM_DCN_RESTART_COUNT > 0
+    (the supervised relaunch replays the same config without re-dying
+    at the same chunk)."""
+    kills = []
+    monkeypatch.setattr(faultline.os, "kill", lambda p, s: kills.append(p))
+    monkeypatch.setenv("KSIM_FAULTLINE", "1")
+    monkeypatch.setenv("KSIM_FAULTLINE_KILL", "all@run:1")
+    monkeypatch.delenv("KSIM_DCN_RESTART_COUNT", raising=False)
+    for pid in (0, 2):
+        faultline.reset()
+        monkeypatch.setenv("KSIM_DCN_PID", str(pid))
+        faultline.maybe_kill(0, "run")
+        assert kills == []  # below the chunk threshold
+        faultline.maybe_kill(1, "run")
+        assert kills == [os.getpid()]
+        kills.clear()
+    # A relaunched fleet replays the same schedule without dying.
+    monkeypatch.setenv("KSIM_DCN_RESTART_COUNT", "1")
+    faultline.reset()
+    faultline.maybe_kill(1, "run")
+    assert kills == []
+    faultline.reset()
+
+
+# -- config + validate refusals ----------------------------------------------
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "c.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_config_durable_parsing(tmp_path):
+    cfg = SimConfig.load(_write(tmp_path, """
+strategy: jax
+dcn:
+  recovery: {enable: true, checkpointEvery: 2}
+  durable: {dir: /tmp/j, resume: true}
+"""))
+    assert cfg.dcn_durable.dir == "/tmp/j"
+    assert cfg.dcn_durable.resume is True
+    # Bare-string shorthand: dir only, no resume.
+    cfg = SimConfig.load(_write(tmp_path, """
+strategy: jax
+dcn:
+  recovery: {enable: true, checkpointEvery: 2}
+  durable: /tmp/j2
+"""))
+    assert cfg.dcn_durable.dir == "/tmp/j2"
+    assert cfg.dcn_durable.resume is False
+
+
+def test_validate_refuses_durable_without_fleet(tmp_path, monkeypatch):
+    from kubernetes_simulator_tpu.cli import _durable_errors
+
+    monkeypatch.delenv("KSIM_DCN_NPROC", raising=False)
+    cfg = SimConfig.load(_write(tmp_path, f"""
+strategy: jax
+dcn:
+  recovery: {{enable: true, checkpointEvery: 1}}
+  durable: {tmp_path / 'j'}
+"""))
+    errs = _durable_errors(cfg)
+    assert any("dcn_launch" in e for e in errs)
+    monkeypatch.setenv("KSIM_DCN_NPROC", "3")
+    assert _durable_errors(cfg) == []
+
+
+def test_validate_refuses_durable_without_checkpoints(tmp_path, monkeypatch):
+    from kubernetes_simulator_tpu.cli import _durable_errors
+
+    monkeypatch.setenv("KSIM_DCN_NPROC", "3")
+    cfg = SimConfig.load(_write(tmp_path, f"""
+strategy: jax
+dcn:
+  durable: {tmp_path / 'j'}
+"""))
+    errs = _durable_errors(cfg)
+    assert any("checkpointEvery" in e for e in errs)
+    # A work queue is a checkpoint cadence too (per-block epochs).
+    cfg = SimConfig.load(_write(tmp_path, f"""
+strategy: jax
+dcn:
+  workQueue: {{enable: true}}
+  durable: {tmp_path / 'j'}
+"""))
+    assert _durable_errors(cfg) == []
+
+
+def test_validate_refuses_resume_without_dir(tmp_path):
+    from kubernetes_simulator_tpu.cli import _durable_errors
+
+    cfg = SimConfig.load(_write(tmp_path, """
+strategy: jax
+dcn:
+  recovery: {enable: true, checkpointEvery: 1}
+  durable: {resume: true}
+"""))
+    errs = _durable_errors(cfg)
+    assert any("resume" in e for e in errs)
+
+
+def test_validate_accepts_example_config19(tmp_path, monkeypatch):
+    from kubernetes_simulator_tpu.cli import validate_config
+
+    monkeypatch.setenv("KSIM_DCN_NPROC", "3")
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "config19_durable.yaml"
+    )
+    cfg = SimConfig.load(path)
+    assert cfg.dcn_durable is not None and cfg.dcn_durable.dir
+    # Point the journal at a writable scratch dir for the probe.
+    cfg.dcn_durable.dir = str(tmp_path / "journal")
+    assert validate_config(cfg) == []

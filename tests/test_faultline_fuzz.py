@@ -8,6 +8,12 @@ leaves the claim-generation hand-off in the logs.
 
 The schedules are a pure function of the seed, so a red run here
 reproduces exactly with ``python scripts/faultline_fuzz.py --seed 17``.
+
+Round 20 widens the bar with the two supervised durable-ground drills:
+the coordinator SIGKILLed by name and the whole fleet killed at once,
+both run under ``dcn_launch.py --supervise`` over a durability journal
+and both required to end byte-identical to the oracle after a
+relaunch-with-``--resume``.
 """
 
 import os
@@ -25,14 +31,16 @@ sys.path.insert(
 import faultline_fuzz as F  # noqa: E402
 
 SEED = 17
-N_SCHEDULES = 6
+N_SCHEDULES = 8
 
 
 def test_mandatory_schedules_always_sampled():
     """Fast sanity (no fleet): the sampler always leads with the
-    double-kill, claimant-kill, wq-straggler, wq-spec-kill and
-    mid-publish-kill drills, schedules are deterministic in the seed,
-    and sampled kills never name the coordinator."""
+    double-kill, claimant-kill, wq-straggler, wq-spec-kill,
+    mid-publish-kill and the two supervised durable-ground drills,
+    schedules are deterministic in the seed, and unsupervised kills
+    never name the coordinator (supervised drills MAY — that is their
+    whole point: the supervisor relaunches the fleet)."""
     scheds = F.sample_schedules(SEED, N_SCHEDULES)
     assert len(scheds) == N_SCHEDULES
     assert scheds[0]["name"] == "double-kill"
@@ -46,12 +54,23 @@ def test_mandatory_schedules_always_sampled():
     assert scheds[3]["wq"] and scheds[3]["kill"] == "*@spec:-1"
     assert scheds[4]["name"] == "mid-publish-kill"
     assert scheds[4]["kill"] == "*@run:1" and scheds[4]["torn_rate"] == 0.5
+    assert scheds[5]["name"] == "coord-kill-restart"
+    assert scheds[5]["kill"] == "0@run:1" and scheds[5]["supervised"]
+    assert scheds[6]["name"] == "fleet-kill-restart"
+    assert scheds[6]["kill"] == "all@run:1" and scheds[6]["supervised"]
+    assert scheds[6]["torn_rate"] == 0.5
     assert scheds == F.sample_schedules(SEED, N_SCHEDULES)
     assert scheds != F.sample_schedules(SEED + 1, N_SCHEDULES)
     for sch in scheds:
         named, _ = F.named_kill_pids(sch)
+        if sch.get("supervised"):
+            # The round-20 drills kill the coordinator on purpose; the
+            # supervisor's relaunch is what makes that survivable.
+            assert 0 in named, sch
+            continue
         assert 0 not in named, (
-            "the fuzzer must not kill the coordination-service host"
+            "an unsupervised schedule must not kill the "
+            "coordination-service host"
         )
 
 
@@ -103,4 +122,15 @@ def test_fuzz_schedules_byte_identical_to_oracle(tmp_path):
             killed = [p for p, rc in out["rcs"].items() if rc == -9]
             assert len(killed) == 1, out["rcs"]
             assert "claims dead process" in out["blob"], out["blob"][-2000:]
+        if sched["name"] in ("coord-kill-restart", "fleet-kill-restart"):
+            # Round 20: the supervisor absorbed the (previously
+            # unsurvivable) death, relaunched with --resume, and the
+            # restarted fleet's gather matched the oracle byte-for-byte
+            # (check_supervised demanded all three).  Pin the mechanics:
+            # a relaunch marker and a clean supervisor exit.
+            assert out.get("supervised"), out
+            assert out["rcs"].get(0) == 0, out["blob"][-2000:]
+            assert "relaunching with --resume" in out["blob"], (
+                out["blob"][-2000:]
+            )
     assert not failures, "\n".join(failures)
