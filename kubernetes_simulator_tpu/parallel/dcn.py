@@ -41,6 +41,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from . import trace as _trace
+
 # Cross-process gathers performed by this process since import. Tests
 # diff it around a replay to pin "one gather per replay, zero per chunk".
 GATHER_COUNT = 0
@@ -441,6 +443,23 @@ def heartbeat(
     if _ACTIVE_LEASE[0] is not None:
         beat.setdefault("leased_blocks", 1)
         beat.setdefault("wq_block", int(_ACTIVE_LEASE[0].get("bid", -1)))
+        # Round 21: the lease generation and block trace id ride the
+        # beacon so dcn_launch --watch names the generation live and the
+        # post-mortem can tie a beacon to the block's causal chain.
+        beat.setdefault("wq_gen", int(_ACTIVE_LEASE[0].get("gen", 0)))
+        if _trace.enabled():
+            beat.setdefault(
+                "trace",
+                _trace.block_trace(_ACTIVE_LEASE[0].get("bid", -1)),
+            )
+    restarts = os.environ.get("KSIM_DCN_RESTART_COUNT")
+    if restarts:
+        # Supervised-relaunch life (round 20 supervisor; surfaced round
+        # 21): lets the watcher tell attempt N's fleet from attempt 0's.
+        try:
+            beat["restart"] = int(restarts)
+        except ValueError:
+            pass
     blob = json.dumps(beat, sort_keys=True)
     hb_dir = os.environ.get("KSIM_DCN_HB_DIR")
     if hb_dir:
@@ -478,16 +497,18 @@ def heartbeat(
     if _ACTIVE_LEASE[0] is not None:
         lease = _ACTIVE_LEASE[0]
         t0 = time.perf_counter()
-        renew = json.dumps(
-            {
-                "pid": int(pid),
-                "gen": int(lease.get("gen", 0)),
-                "block": int(lease.get("bid", -1)),
-                "chunk": int(chunk),
-                "t": time.time(),
-            },
-            sort_keys=True,
-        )
+        renew_rec = {
+            "pid": int(pid),
+            "gen": int(lease.get("gen", 0)),
+            "block": int(lease.get("bid", -1)),
+            "chunk": int(chunk),
+            "t": time.time(),
+        }
+        if _trace.enabled():
+            renew_rec["trace"] = _trace.block_trace(
+                lease.get("bid", -1)
+            )
+        renew = json.dumps(renew_rec, sort_keys=True)
         try:
             kv_retry(
                 lambda: _client().key_value_set(
@@ -941,7 +962,13 @@ def _mirror_event(event: dict) -> None:
     (dcn_launch --watch) can surface a rebalance live, and forward it to
     the in-process :data:`EVENT_SINKS` (flight recorder). Best-effort;
     single ``write`` of one line keeps concurrent appenders from tearing
-    each other."""
+    each other.
+
+    Round 21: every event is stamped with its causal trace identity
+    (``trace``/``span``/``parent`` — see :mod:`parallel.trace`) before
+    fan-out, so the events.jsonl mirror and every in-process sink carry
+    identical stamps."""
+    _trace.stamp(event)
     for sink in list(EVENT_SINKS):
         try:
             sink(dict(event))
@@ -1060,11 +1087,17 @@ def publish_checkpoint(
         for ch in raw_chunks:
             blob_crc = zlib.crc32(ch.encode("ascii"), blob_crc)
         chunks = [_frame_chunk(ch) for ch in raw_chunks]
-        manifest = json.dumps(
-            {"n": len(chunks), "crc": f"{blob_crc & 0xFFFFFFFF:08x}",
-             "len": blob_len},
-            sort_keys=True,
-        )
+        man = {
+            "n": len(chunks),
+            "crc": f"{blob_crc & 0xFFFFFFFF:08x}",
+            "len": blob_len,
+        }
+        if _trace.enabled():
+            # Round 21: the cursor's trace id rides BOTH the KV manifest
+            # and the journal mirror (same string — the mirror-equality
+            # pin holds); chunk payload bytes are untouched either way.
+            man["trace"] = _trace.ckpt_trace(pid, int(cursor))
+        manifest = json.dumps(man, sort_keys=True)
         lo, hi = int(block[0]), int(block[1])
         ep = checkpoint_epoch() if epoch is None else int(epoch)
         prefix = f"{CKPT_PREFIX}/{ep}/{pid}/{lo}-{hi}/{int(cursor)}"
@@ -1290,6 +1323,10 @@ def load_checkpoint(
         return None
     from ..utils.metrics import log
 
+    try:
+        _, me = process_info()
+    except Exception:
+        me = -1
     table: Dict[tuple, Dict[str, str]] = {}
     for key, val in entries:
         parts = str(key).strip("/").split("/")
@@ -1361,7 +1398,28 @@ def load_checkpoint(
                 "validation (%s) — falling back to the prior complete "
                 "checkpoint", int(pid), cursor, e,
             )
+            # Round 21: the fallback is a causal hop — the post-mortem
+            # links an injected torn write to the fallback it provoked
+            # through the shared ckpt trace id.
+            _mirror_event(
+                {"event": "ckpt_fallback", "pid": int(pid),
+                 "cursor": int(cursor), "by": int(me),
+                 "reason": str(e)[:80]}
+            )
             continue
+        # Round 21: every successful load is an event — it carries the
+        # RESUMED cursor the invariant audit compares against the newest
+        # complete durable cursor, and (via trace.CTX) a link back to
+        # the block whose resume asked for it.
+        _mirror_event(
+            {
+                "event": "ckpt_load",
+                "pid": int(pid),
+                "cursor": int(cursor),
+                "block": [int(block[0]), int(block[1])],
+                "by": int(me),
+            }
+        )
         if raw_key in journal_keys:
             # The winning candidate came (at least partly) from the
             # durable journal — the resume-seeding event the flight
@@ -1373,6 +1431,7 @@ def load_checkpoint(
                     "pid": int(pid),
                     "cursor": int(cursor),
                     "block": [int(block[0]), int(block[1])],
+                    "by": int(me),
                 }
             )
         return {"cursor": cursor, "block": block, "payload": payload}
@@ -1545,7 +1604,11 @@ def _maybe_recover(c, prefix: str, p: int, name: str, recover) -> bool:
             # into the recovery engine so telemetry can attribute which
             # claim attempt produced the block — gen > 0 means an earlier
             # claimant died mid-recovery and this is the hand-off.
-            payload = recover(p, gen)
+            _trace.CTX[0] = _trace.static_trace(p)
+            try:
+                payload = recover(p, gen)
+            finally:
+                _trace.CTX[0] = None
             _publish_for(c, prefix, p, payload)
             log.warning(
                 "dcn: process %d resumed and republished process %d's "
@@ -1915,7 +1978,8 @@ def wq_run(name: str, blocks: list, execute) -> list:
             JOURNAL_STATS["adopted"] += 1
             _mirror_event(
                 {"event": "journal_adopt", "pid": int(pid),
-                 "block": int(bid), "from": int(meta.get("pid", -1))}
+                 "block": int(bid), "from": int(meta.get("pid", -1)),
+                 "gen": int(meta.get("gen", 0) or 0)}
             )
 
     def _lease_key(bid: int, gen: int) -> str:
@@ -1963,7 +2027,9 @@ def wq_run(name: str, blocks: list, execute) -> list:
         if meta.get("spec") or int(meta.get("gen", 0) or 0) > 0:
             _arm_degraded_exit()
 
-    def _run_block(bid, gen, resume_pid, speculative, renew_age=0.0):
+    def _run_block(
+        bid, gen, resume_pid, speculative, renew_age=0.0, threshold=0.0
+    ):
         from ..utils.metrics import log
 
         lo, hi = blocks[bid]
@@ -1979,10 +2045,15 @@ def wq_run(name: str, blocks: list, execute) -> list:
             pid, verb, bid, lo, hi, gen,
             f" (resuming from pid {resume_pid})" if resume_pid >= 0 else "",
         )
-        _mirror_event(
-            {"event": kind, "pid": int(pid), "block": int(bid),
-             "gen": int(gen), "from": int(resume_pid)}
-        )
+        ev = {"event": kind, "pid": int(pid), "block": int(bid),
+              "gen": int(gen), "from": int(resume_pid)}
+        if kind in ("steal", "speculate"):
+            # Evidence for the post-mortem's "every steal is preceded by
+            # a stale renewal" invariant: the renewal age observed at
+            # the decision, and the threshold it had to exceed.
+            ev["renew_age_s"] = round(float(renew_age), 3)
+            ev["threshold_s"] = round(float(threshold), 3)
+        _mirror_event(ev)
         _ACTIVE_LEASE[0] = {
             "key": _renew_key(bid), "bid": int(bid), "gen": int(gen),
         }
@@ -1994,10 +2065,12 @@ def wq_run(name: str, blocks: list, execute) -> list:
             {"pid": int(pid), "gen": int(gen), "t": time.time()},
         )
         t0 = time.monotonic()
+        _trace.CTX[0] = _trace.block_trace(bid)
         try:
             payload = execute(bid, lo, hi, resume_pid, gen, speculative, qd)
         finally:
             _ACTIVE_LEASE[0] = None
+            _trace.CTX[0] = None
         local[bid] = payload
         _publish_for(
             c, f"{prefix}/result/{bid}", pid, payload, tolerant=True
@@ -2186,7 +2259,10 @@ def wq_run(name: str, blocks: list, execute) -> list:
                 )
                 if win is not None and int(win.get("pid", -1)) == pid:
                     WQ_STATS["spec_attempts"] += 1
-                    _run_block(bid, gen, holder, True, renew_age=age)
+                    _run_block(
+                        bid, gen, holder, True,
+                        renew_age=age, threshold=strag,
+                    )
                     progressed = True
                 continue
             if age > stall and gen < gen_cap:
@@ -2198,7 +2274,10 @@ def wq_run(name: str, blocks: list, execute) -> list:
                     WQ_STATS["steals"] += 1
                     DEGRADED.add(holder)
                     _arm_degraded_exit()
-                    _run_block(bid, gen + 1, holder, False)
+                    _run_block(
+                        bid, gen + 1, holder, False,
+                        renew_age=age, threshold=stall,
+                    )
                     progressed = True
                 continue
         if not progressed:

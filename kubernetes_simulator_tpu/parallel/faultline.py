@@ -263,6 +263,21 @@ _PROXY = None
 _KILLED_CAS: set = set()
 
 
+def _emit_fault(event: dict) -> None:
+    """Mirror one injection as a fleet event (round 21 black box): the
+    post-mortem links an injected fault to the retry/fallback/steal it
+    provoked through the trace id derived from the injected key (see
+    ``parallel.trace.trace_for_key``). Lazy dcn import — faultline is
+    imported BY dcn — and best-effort: telemetry never alters the
+    injection schedule or takes the run down."""
+    try:
+        from . import dcn
+
+        dcn._mirror_event(event)
+    except Exception:
+        pass
+
+
 def injector() -> Injector:
     """The process-wide injector singleton (lazily built from env)."""
     global _INJECTOR
@@ -296,43 +311,57 @@ class _KvProxy:
         # key -> previously observed value, for stale-read injection.
         self._seen: dict = {}
 
-    def _delay(self):
+    def _fault(self, cls: str, key, op: str) -> None:
+        _emit_fault(
+            {"event": "fault_inject", "pid": int(self._inj.pid),
+             "class": cls, "key": str(key), "op": op,
+             "n": int(self._inj.counts.get(cls, 0))}
+        )
+
+    def _delay(self, key, op: str):
         if self._inj.hit("kv_delay"):
             import time
 
+            self._fault("kv_delay", key, op)
             time.sleep(self._inj.kv_delay_s)
 
     def key_value_set(self, key, value, *args, **kwargs):
         if key.startswith(_SELF_PREFIX):
             return self.raw.key_value_set(key, value, *args, **kwargs)
-        self._delay()
+        self._delay(key, "set")
         if self._inj.hit("kv_error"):
+            self._fault("kv_error", key, "set")
             raise FaultlineInjected(f"injected KV set error for {key!r}")
         if key.startswith(_TEAR_PREFIX) and self._inj.hit("torn"):
             log.debug("faultline: tearing write of %s", key)
+            self._fault("torn", key, "set")
             value = self._inj.tear(value)
         return self.raw.key_value_set(key, value, *args, **kwargs)
 
     def blocking_key_value_get(self, key, *args, **kwargs):
-        self._delay()
+        self._delay(key, "get")
         if self._inj.hit("kv_error"):
+            self._fault("kv_error", key, "get")
             raise FaultlineInjected(f"injected KV get error for {key!r}")
         prev = self._seen.get(key)
         val = self.raw.blocking_key_value_get(key, *args, **kwargs)
         self._seen[key] = val
         if prev is not None and self._inj.hit("stale"):
+            self._fault("stale", key, "get")
             return prev
         return val
 
     def key_value_dir_get(self, prefix, *args, **kwargs):
-        self._delay()
+        self._delay(prefix, "dir_get")
         if self._inj.hit("kv_error"):
+            self._fault("kv_error", prefix, "dir_get")
             raise FaultlineInjected(f"injected KV dir-get error for {prefix!r}")
         skey = ("dir", prefix)
         prev = self._seen.get(skey)
         val = self.raw.key_value_dir_get(prefix, *args, **kwargs)
         self._seen[skey] = val
         if prev is not None and self._inj.hit("stale"):
+            self._fault("stale", prefix, "dir_get")
             return prev
         return val
 
@@ -361,6 +390,11 @@ def file_blob(blob: str) -> str:
         return blob
     inj = injector()
     if inj.hit("file"):
+        _emit_fault(
+            {"event": "fault_inject", "pid": int(inj.pid),
+             "class": "file", "op": "mirror",
+             "n": int(inj.counts.get("file", 0))}
+        )
         return inj.tear(blob)
     return blob
 
@@ -387,6 +421,14 @@ def maybe_slow(chunk: int, state: str) -> float:
                 "faultline: slowing process %d by %.3gs (schedule entry "
                 "%r at chunk=%d)",
                 inj.pid, factor, f"{pid_s}@{thr}:{factor:g}", int(chunk),
+            )
+            # Round 21: the injected straggle is the causal root of the
+            # speculation it provokes — linked via trace.CTX (the block
+            # this process is executing while it sleeps).
+            _emit_fault(
+                {"event": "fault_slow", "pid": int(inj.pid),
+                 "class": "slow", "chunk": int(chunk),
+                 "factor": float(factor), "n": int(inj.slow_count)}
             )
         import time
 
@@ -454,5 +496,14 @@ def maybe_kill(chunk: int, state: str) -> None:
             f"{pid_s}@{st}:{thr}",
             state,
             int(chunk),
+        )
+        # Round 21 black box: one last event line BEFORE the SIGKILL —
+        # the post-mortem ties the death to the block/recovery this
+        # process was executing (trace.CTX) and to the steal/claim a
+        # survivor raises against it.
+        _emit_fault(
+            {"event": "fault_kill", "pid": int(inj.pid),
+             "class": "kill", "state": str(state), "chunk": int(chunk),
+             "n": int(idx)}
         )
         os.kill(os.getpid(), signal.SIGKILL)

@@ -1,0 +1,242 @@
+"""Fleet black box (round 21): causal trace identity for every
+scenario-block lifecycle and checkpoint cursor.
+
+Every fleet coordination event the DCN layer mirrors (``dcn.
+_mirror_event`` → ``events.jsonl`` + flight-recorder fleet rows) is
+stamped with three read-only telemetry fields:
+
+``trace``
+    Stable identity of the THING the event is about:
+
+    - ``blk:<bid>``       work-queue scenario block ``bid``
+    - ``blk:s<pid>``      static-slice block owned by (dead) ``pid``
+    - ``ckpt:<pid>:<cur>`` ``pid``'s checkpoint blob at chunk ``cur``
+
+``span``
+    The hop itself: ``<trace>/<hop>.g<gen>.p<pid>`` for block hops
+    (exec / spec / done / dup / spec_lost / adopt / claim / recover) and
+    ``<trace>/<hop>.p<pid>`` for checkpoint hops (publish / load /
+    journal_resume / fallback).
+
+``parent``
+    The span that causally produced this one (absent for roots): a
+    steal's parent is the expired holder's exec span, a dup-discard's
+    parent is the loser's own exec span, a checkpoint load's parent is
+    the publish span that wrote the blob, and so on.
+
+Some events additionally carry ``link`` — a second trace id bridging
+two lifecycles (e.g. a ``ckpt_load`` during a steal links the loaded
+``ckpt:`` trace to the block being resumed), so the post-mortem's flow
+arrows can follow a block across a process death.
+
+Every value is a pure function of protocol state (pid / gen / bid /
+cursor) — no wall clocks, no randomness — so stamped telemetry streams
+stay deterministic for a fixed schedule, and stamping changes NOTHING
+outside telemetry: placements, result JSONL and checkpoint blobs are
+byte-identical with ``KSIM_TRACE=0`` (the off switch; default on).
+
+``scripts/fleet_postmortem.py`` consumes these fields to rebuild one
+causally-ordered fleet timeline and audit the protocol invariants.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Execution context for cross-lifecycle links: the block trace this
+# process is currently executing/recovering (set by dcn's work-queue
+# runner and recovery claim path around the execute callback). Read by
+# checkpoint-side stamping so a load/publish during a resume carries a
+# ``link`` back to the block that caused it. Single-slot on purpose —
+# one block executes at a time per process; the background publisher
+# thread reads whatever block is current, which is the block whose
+# state it is publishing.
+CTX = [None]
+
+
+def enabled() -> bool:
+    """Trace stamping gate (``KSIM_TRACE``; default ON). Off mode
+    exists for the byte-identity parity bar, not for production."""
+    return os.environ.get("KSIM_TRACE", "1") not in ("", "0")
+
+
+def block_trace(bid) -> str:
+    """Trace id of work-queue scenario block ``bid``."""
+    return f"blk:{int(bid)}"
+
+
+def static_trace(dead_pid) -> str:
+    """Trace id of the static-slice block owned by ``dead_pid``."""
+    return f"blk:s{int(dead_pid)}"
+
+
+def ckpt_trace(pid, cursor) -> str:
+    """Trace id of ``pid``'s checkpoint blob at chunk ``cursor``."""
+    return f"ckpt:{int(pid)}:{int(cursor)}"
+
+
+def exec_span(bid, gen, pid) -> str:
+    """Span of one execution attempt of block ``bid`` at generation
+    ``gen`` by ``pid`` — created by a lease (g0) or a steal (g>0)."""
+    return f"{block_trace(bid)}/exec.g{int(gen)}.p{int(pid)}"
+
+
+def spec_span(bid, gen, pid) -> str:
+    """Span of a one-shot speculative re-execution (same generation as
+    the straggling holder — speculation burns no lease generation)."""
+    return f"{block_trace(bid)}/spec.g{int(gen)}.p{int(pid)}"
+
+
+def publish_span(pid, cursor) -> str:
+    """Span of the publication that wrote ``ckpt:<pid>:<cursor>``."""
+    return f"{ckpt_trace(pid, cursor)}/publish.p{int(pid)}"
+
+
+def trace_for_key(key: str) -> Optional[str]:
+    """Derive the trace id a coordination-plane KV key belongs to, or
+    None for keys outside any traced lifecycle (heartbeats, gather
+    payload slots, exit rendezvous). Used by faultline to stamp an
+    injected fault with the lifecycle it perturbs."""
+    parts = str(key).strip("/").split("/")
+    if len(parts) < 3 or parts[0] != "ksim":
+        return None
+    try:
+        if parts[1] == "ckpt" and len(parts) >= 6:
+            # ksim/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>[/<leaf>]
+            return ckpt_trace(int(parts[3]), int(parts[5]))
+        if parts[1] == "claim" and len(parts) >= 5:
+            # ksim/claim/<seq>/<name>/<dead_pid>/<gen>
+            return static_trace(int(parts[4]))
+        if parts[1] == "wq" and len(parts) >= 6:
+            # ksim/wq/<seq>/<name>/<sub>/<bid>[/...]
+            if parts[4] in ("lease", "renew", "done", "spec", "result"):
+                return block_trace(int(parts[5]))
+    except (ValueError, IndexError):
+        return None
+    return None
+
+
+def stamp(event: dict) -> dict:
+    """Add ``trace``/``span``/``parent`` (and ``link`` where a second
+    lifecycle is bridged) to one fleet event dict, in place. The single
+    choke point — ``dcn._mirror_event`` calls it before fan-out, so the
+    events.jsonl mirror, the flight-recorder fleet rows and any other
+    sink all carry identical stamps. Unknown kinds and missing fields
+    degrade to no stamp, never an error; a no-op with the gate off or
+    when the event already carries a ``trace`` (pre-stamped)."""
+    if not enabled() or "trace" in event:
+        return event
+    try:
+        kind = event.get("event", event.get("kind"))
+        pid = event.get("pid")
+        bid = event.get("block")
+        gen = event.get("gen", 0)
+        if kind == "lease":
+            event["trace"] = block_trace(bid)
+            event["span"] = exec_span(bid, gen, pid)
+        elif kind == "steal":
+            event["trace"] = block_trace(bid)
+            event["span"] = exec_span(bid, gen, pid)
+            if int(event.get("from", -1)) >= 0:
+                event["parent"] = exec_span(
+                    bid, int(gen) - 1, event["from"]
+                )
+        elif kind == "speculate":
+            event["trace"] = block_trace(bid)
+            event["span"] = spec_span(bid, gen, pid)
+            if int(event.get("from", -1)) >= 0:
+                event["parent"] = exec_span(bid, gen, event["from"])
+        elif kind == "block_done":
+            event["trace"] = block_trace(bid)
+            event["span"] = (
+                f"{block_trace(bid)}/done.g{int(gen)}.p{int(pid)}"
+            )
+            event["parent"] = (
+                spec_span(bid, gen, pid)
+                if event.get("spec")
+                else exec_span(bid, gen, pid)
+            )
+        elif kind == "spec_lost":
+            event["trace"] = block_trace(bid)
+            event["span"] = (
+                f"{block_trace(bid)}/spec_lost.g{int(gen)}.p{int(pid)}"
+            )
+            event["parent"] = spec_span(bid, gen, pid)
+        elif kind == "dup_discard":
+            event["trace"] = block_trace(bid)
+            event["span"] = (
+                f"{block_trace(bid)}/dup.g{int(gen)}.p{int(pid)}"
+            )
+            event["parent"] = exec_span(bid, gen, pid)
+        elif kind == "journal_adopt":
+            event["trace"] = block_trace(bid)
+            event["span"] = f"{block_trace(bid)}/adopt.p{int(pid)}"
+            if "gen" in event and int(event.get("from", -1)) >= 0:
+                # The dead fleet's done span — adoption is causally the
+                # continuation of the completion the journal preserved.
+                event["parent"] = (
+                    f"{block_trace(bid)}/done.g{int(event['gen'])}"
+                    f".p{int(event['from'])}"
+                )
+        elif kind == "claim":
+            tr = static_trace(event["for"])
+            event["trace"] = tr
+            event["span"] = (
+                f"{tr}/claim.g{int(gen)}.p{int(event['claimant'])}"
+            )
+            if int(gen) > 0:
+                # The fenced hand-off: gen>0 means an earlier claimant
+                # died mid-recovery. Its claimant pid is not in this
+                # event; the post-mortem resolves the prefix.
+                event["parent"] = f"{tr}/claim.g{int(gen) - 1}"
+        elif kind == "recovered":
+            tr = static_trace(event["for"])
+            event["trace"] = tr
+            event["span"] = (
+                f"{tr}/recover.g{int(gen)}.p{int(event['claimant'])}"
+            )
+            event["parent"] = (
+                f"{tr}/claim.g{int(gen)}.p{int(event['claimant'])}"
+            )
+        elif kind == "ckpt_publish":
+            cur = event["cursor"]
+            event["trace"] = ckpt_trace(pid, cur)
+            event["span"] = publish_span(pid, cur)
+            if CTX[0]:
+                event["link"] = CTX[0]
+        elif kind in ("journal_resume", "ckpt_load", "ckpt_fallback"):
+            cur = event["cursor"]
+            hop = {
+                "journal_resume": "journal_resume",
+                "ckpt_load": "load",
+                "ckpt_fallback": "fallback",
+            }[kind]
+            by = event.get("by", pid)
+            event["trace"] = ckpt_trace(pid, cur)
+            event["span"] = (
+                f"{ckpt_trace(pid, cur)}/{hop}.p{int(by)}"
+            )
+            event["parent"] = publish_span(pid, cur)
+            if CTX[0]:
+                event["link"] = CTX[0]
+        elif kind in ("fault_inject", "fault_kill", "fault_slow"):
+            tr = event.get("key") and trace_for_key(event["key"])
+            if not tr and CTX[0]:
+                tr = CTX[0]
+            if not tr and kind == "fault_kill":
+                # A kill outside any block context is the causal HEAD
+                # of the dead pid's static-recovery lifecycle: the
+                # survivor's claim/recovered events share this trace,
+                # so the post-mortem flow arrow runs dead → claimant.
+                tr = static_trace(pid)
+            base = tr if tr else "fault"
+            tag = event.get("class", kind)
+            seq = event.get("n", 0)
+            event["span"] = f"{base}/{kind}.{tag}.n{int(seq)}.p{int(pid)}"
+            if tr:
+                event["trace"] = tr
+        # join and unknown kinds: no trace identity.
+    except (KeyError, TypeError, ValueError):
+        pass
+    return event

@@ -49,6 +49,12 @@ FLIGHT_WALL_FIELDS = (
     "wall_s",
     "rolling_pps",
     "stall_s",
+    # Round 21: the renewal age observed at a steal/speculate decision
+    # is wall-clock evidence (the threshold it exceeded is config and
+    # stays). Trace stamps (trace/span/parent/link) are handled in
+    # _emit: dropped entirely in deterministic mode so streams are
+    # byte-identical with KSIM_TRACE on and off.
+    "renew_age_s",
     "pager_stall_s",
     "pager_prefetch_s",
     "pager_wait_s",
@@ -311,10 +317,18 @@ class FlightRecorder:
         journal_adopt (a completed block adopted from the durable
         journal without re-execution) and journal_resume (a checkpoint
         restore whose winning cursor came from the journal rather than
-        the live KV store). Flattened into the row — every field but the
-        wall clocks is deterministic for a fixed schedule."""
+        the live KV store). Round 21 adds ckpt_load / ckpt_fallback and
+        the faultline fault_* kinds, each stamped with its causal trace
+        identity (trace/span/parent — parallel.trace) by dcn before this
+        sink sees it. Flattened into the row — every field but the wall
+        clocks is deterministic for a fixed schedule."""
         ev = dict(event)
-        kind = ev.pop("event", "?")
+        # ckpt_publish events name their kind under "kind" (pinned by
+        # test_durable); pop BOTH so the payload can never shadow the
+        # row's own kind="flight" stamp (round 21 fix — shadowed rows
+        # were invisible to read_stream).
+        kind = ev.pop("event", None) or ev.pop("kind", None) or "?"
+        ev.pop("kind", None)
         self._emit(
             {
                 "event": "fleet",
@@ -375,6 +389,12 @@ class FlightRecorder:
                         )
                         for k, v in row[blk].items()
                     }
+            # Round 21: trace identity fields are deterministic values
+            # but their PRESENCE depends on KSIM_TRACE — drop them so
+            # deterministic streams are byte-identical stamping-on vs
+            # stamping-off (the parity bar); live streams keep them.
+            for k in ("trace", "span", "parent", "link"):
+                row.pop(k, None)
         try:
             self._writer.write(row)
         except OSError:

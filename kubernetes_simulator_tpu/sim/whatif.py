@@ -2241,7 +2241,12 @@ class WhatIfEngine:
             # generation while we are still warming up.
             dcn.heartbeat(
                 -1, block=(lo, hi), state="recover",
-                extra={"recovering_for": int(dead_pid)},
+                extra={
+                    "recovering_for": int(dead_pid),
+                    # Round 21: the fenced claim generation, surfaced by
+                    # dcn_launch --watch as recovering-p<dead>@g<gen>.
+                    "recover_gen": int(gen),
+                },
             )
         eng = WhatIfEngine(
             self.ec, self.pods, rb["scenarios"],
@@ -2980,7 +2985,8 @@ class WhatIfEngine:
                 extra={
                     "recovering_for": int(
                         self._dcn_recovery.get("for_pid", -1)
-                    )
+                    ),
+                    "recover_gen": int(self._dcn_recovery.get("gen", 0)),
                 },
             )
         else:

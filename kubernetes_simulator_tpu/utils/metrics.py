@@ -56,7 +56,15 @@ if not log.handlers:
 #        KSIM_DETERMINISTIC_JSONL zeroes every wall-clock-derived flight
 #        field (sim.flight.FLIGHT_WALL_FIELDS) so fixed-seed recorder
 #        streams are byte-stable.
-SCHEMA_VERSION = 5
+#   v6 — fleet black box (round 21): rows may carry the causal trace
+#        identity fields "trace"/"span"/"parent"/"link" (parallel.trace
+#        — pure functions of protocol state, never scrubbed), flight
+#        streams may carry "fleet" event rows (dcn fleet events
+#        flattened by the recorder), and a new "postmortem" row kind
+#        (scripts/fleet_postmortem.py audit summary: events ingested,
+#        links resolved, invariant verdicts, audit wall). Non-flight
+#        rows keep the v4 rules; v1–v5 files validate byte-unchanged.
+SCHEMA_VERSION = 6
 TUNE_SCHEMA_VERSION = 3
 
 

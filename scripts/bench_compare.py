@@ -467,6 +467,27 @@ def compare_pair(
                 notes.append(
                     f"durable_ground {key}: {ga} -> {gb} (informational)"
                 )
+
+    # Fleet black-box accounting (round 21): informational, never a
+    # regression — post-mortem reconstruction runs OFFLINE over a dead
+    # run's artifacts, so its cost is operator wall time, not fleet
+    # time. Tracked so a causal-link resolution collapse (stamping
+    # regression) or an audit-wall blow-up is visible in review.
+    pa, pb = da.get("postmortem"), db.get("postmortem")
+    if isinstance(pb, dict) and not isinstance(pa, dict):
+        notes.append(
+            "postmortem: first appearance "
+            f"(audit wall {pb.get('audit_wall_s')}s, "
+            f"events ingested {pb.get('events_ingested')}, "
+            f"causal links resolved {pb.get('links_resolved')})"
+        )
+    elif isinstance(pa, dict) and isinstance(pb, dict):
+        for key in ("audit_wall_s", "events_ingested", "links_resolved"):
+            ga, gb = pa.get(key), pb.get(key)
+            if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
+                notes.append(
+                    f"postmortem {key}: {ga} -> {gb} (informational)"
+                )
     return regressions, notes
 
 

@@ -137,7 +137,43 @@ _OPTIONAL_FLIGHT = {
 }
 
 
-def _validate_flight(row: dict) -> List[str]:
+# v6 (fleet black box, round 21): any row may carry the causal trace
+# identity fields stamped by parallel.trace — pure functions of
+# protocol state (pid/gen/bid/cursor), so they survive the
+# deterministic scrub. Flight streams gain "fleet" event rows (dcn
+# fleet events flattened by the recorder; their payload keys are
+# event-specific and intentionally open, like every flight row), and a
+# new "postmortem" row kind carries the fleet_postmortem.py audit
+# summary with the same relaxed base as flight rows. v1–v5 files
+# validate byte-unchanged — the v5 dispatch below is untouched.
+_OPTIONAL_TRACE = {
+    "trace": str,
+    "span": str,
+    "parent": str,
+    "link": str,
+}
+_FLIGHT_EVENTS_V6 = _FLIGHT_EVENTS + ("fleet",)
+_OPTIONAL_FLIGHT_V6 = {
+    **_OPTIONAL_FLIGHT,
+    **_OPTIONAL_TRACE,
+    "fleet_event": str,
+    "renew_age_s": _NUM,
+    "threshold_s": _NUM,
+    "dcn_retry": dict,
+}
+_POSTMORTEM_REQUIRED = {
+    "events_ingested": int,
+    "links_resolved": int,
+    "violations": int,
+    "warnings": int,
+    "audit_wall_s": _NUM,
+    "invariants": dict,
+}
+
+
+def _validate_flight(
+    row: dict, events=_FLIGHT_EVENTS, optional=_OPTIONAL_FLIGHT
+) -> List[str]:
     errs = []
     if not isinstance(row.get("ts"), _NUM):
         errs.append(f"ts: expected a number, got {row.get('ts')!r}")
@@ -146,14 +182,25 @@ def _validate_flight(row: dict) -> List[str]:
         if not isinstance(v, t) or isinstance(v, bool):
             errs.append(f"{k}: expected {t}, got {v!r}")
     ev = row.get("event")
-    if isinstance(ev, str) and ev not in _FLIGHT_EVENTS:
+    if isinstance(ev, str) and ev not in events:
         errs.append(f"event: unknown {ev!r}")
-    for k, t in _OPTIONAL_FLIGHT.items():
+    for k, t in optional.items():
         if k in row and (
             not isinstance(row[k], t)
             or (isinstance(row[k], bool) and t is not bool)
         ):
             errs.append(f"{k}: expected {t}, got {row[k]!r}")
+    return errs
+
+
+def _validate_postmortem(row: dict) -> List[str]:
+    errs = []
+    if not isinstance(row.get("ts"), _NUM):
+        errs.append(f"ts: expected a number, got {row.get('ts')!r}")
+    for k, t in _POSTMORTEM_REQUIRED.items():
+        v = row.get(k)
+        if not isinstance(v, t) or isinstance(v, bool):
+            errs.append(f"{k}: expected {t}, got {v!r}")
     return errs
 
 
@@ -283,10 +330,20 @@ def validate_row(row: dict) -> List[str]:
         return _validate_v3(row)
     if schema == 5 and row.get("kind") == "flight":
         return _validate_flight(row)
-    if schema in (4, 5):
+    if schema == 6 and row.get("kind") == "flight":
+        return _validate_flight(
+            row, events=_FLIGHT_EVENTS_V6, optional=_OPTIONAL_FLIGHT_V6
+        )
+    if schema == 6 and row.get("kind") == "postmortem":
+        return _validate_postmortem(row)
+    if schema in (4, 5, 6):
         for k, t in _OPTIONAL_V4.items():
             if k in row and not isinstance(row[k], t):
                 errs.append(f"{k}: expected {t}, got {row[k]!r}")
+        if schema == 6:
+            for k, t in _OPTIONAL_TRACE.items():
+                if k in row and not isinstance(row[k], t):
+                    errs.append(f"{k}: expected {t}, got {row[k]!r}")
         if isinstance(row.get("fragmentation"), dict):
             errs.extend(_check_fragmentation(row["fragmentation"]))
         # Fall through: everything else follows the v2 rules.
@@ -352,7 +409,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     for e in all_errs:
         print(e)
     if not all_errs:
-        print(f"ok: {len(argv)} file(s) validate against schema v2/v3/v4/v5")
+        print(
+            f"ok: {len(argv)} file(s) validate against schema "
+            f"v2/v3/v4/v5/v6"
+        )
     return 1 if all_errs else 0
 
 

@@ -206,21 +206,36 @@ class FleetWatch:
     def events(self) -> list:
         """New claim/recovery events from the KV mirror's append-only
         ``events.jsonl`` (round 15: parallel.dcn._mirror_event) since the
-        last call — the operator-visible trail of a live rebalance."""
+        last call — the operator-visible trail of a live rebalance.
+        Round 21: tolerant of a supervisor relaunch truncating the file
+        mid-tail (a shrink resets the byte cursor to the new epoch's
+        head) and of a mid-write partial final line (only complete
+        lines are consumed; the tail waits for the next interval)."""
         path = os.path.join(self.hb_dir, "events.jsonl")
         try:
-            with open(path) as f:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() < self._ev_pos:
+                    self._ev_pos = 0  # truncated underneath the tail
                 f.seek(self._ev_pos)
                 blob = f.read()
-                self._ev_pos = f.tell()
         except OSError:
             return []
+        cut = blob.rfind(b"\n")
+        if cut < 0:
+            return []  # no complete line yet — keep the cursor put
+        self._ev_pos += cut + 1
         out = []
-        for line in blob.splitlines():
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
+        for line in blob[:cut].split(b"\n"):
+            line = line.strip()
+            if not line:
                 continue
+            try:
+                row = json.loads(line.decode("utf-8", "replace"))
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                out.append(row)
         return out
 
     @staticmethod
@@ -277,6 +292,24 @@ class FleetWatch:
                 f"{wp} RESUMES from durable checkpoint at chunk "
                 f"{e.get('cursor', '?')}"
             )
+        # Round 21 black-box trail:
+        elif kind == "ckpt_load":
+            msg = (
+                f"p{e.get('by', '?')} loads {wp}'s checkpoint at chunk "
+                f"{e.get('cursor', '?')}"
+            )
+        elif kind == "ckpt_fallback":
+            msg = (
+                f"p{e.get('by', '?')} FALLS BACK from {wp}'s torn "
+                f"checkpoint at chunk {e.get('cursor', '?')}"
+            )
+        elif kind == "fault_kill":
+            msg = f"{wp} FAULT-KILLED (state {e.get('state', '?')})"
+        elif kind in ("fault_inject", "fault_slow"):
+            msg = (
+                f"{wp} fault {e.get('class', '?')} injected"
+                + (f" on {e.get('key')}" if e.get("key") else "")
+            )
         else:
             msg = json.dumps(e, sort_keys=True)
         return f"dcn_launch[watch]: {msg}"
@@ -318,13 +351,18 @@ class FleetWatch:
             state = b.get("state", "?")
             if state == "recover" and "recovering_for" in b:
                 # Round 15: a claimant re-executing a dead sibling's
-                # block beats under its OWN pid with the dead pid named.
+                # block beats under its OWN pid with the dead pid named
+                # (round 21: plus the fenced claim generation).
                 state = f"recovering-p{b['recovering_for']}"
+                if "recover_gen" in b:
+                    state += f"@g{b['recover_gen']}"
             if "wq_block" in b and int(b.get("leased_blocks", 0)):
                 # Round 18: the lease this process is executing ("spec"
                 # state = speculative re-execution of a straggler's
-                # block).
+                # block). Round 21: plus the lease generation it holds.
                 state = f"{state}@b{b['wq_block']}"
+                if "wq_gen" in b:
+                    state += f".g{b['wq_gen']}"
             seg = (
                 f"p{pid} {state} "
                 f"chunk {chunk}"
@@ -341,6 +379,10 @@ class FleetWatch:
                 # Fleet utilization gauge (round 13): the end-of-replay
                 # gather beacon carries the mean scenario CPU utilization.
                 seg += f" util={float(b['util_cpu']):.1%}"
+            if "restart" in b:
+                # Round 21: which supervised life this process is on
+                # (KSIM_DCN_RESTART_COUNT, exported by the relauncher).
+                seg += f" life={b['restart']}"
             if straggler:
                 seg += " [STRAGGLER]"
             segs.append(seg)

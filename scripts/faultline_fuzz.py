@@ -552,6 +552,53 @@ def check_schedule(sched: dict, out: dict, oracle: dict):
     return fails
 
 
+_PM_MOD = [None]
+
+
+def _postmortem_mod():
+    """Load scripts/fleet_postmortem.py by path (scripts/ is not a
+    package) and cache it — the fuzz loop audits every drill."""
+    if _PM_MOD[0] is None:
+        import importlib.util
+
+        path = os.path.join(_REPO, "scripts", "fleet_postmortem.py")
+        spec = importlib.util.spec_from_file_location(
+            "fleet_postmortem", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _PM_MOD[0] = mod
+    return _PM_MOD[0]
+
+
+def run_blackbox_audit(sched: dict, hb_dir: str):
+    """Round-21 cap: after every drill, reconstruct the fleet's black
+    box from its heartbeat-mirror directory (events.jsonl + beacons +
+    the durable journal when the drill was supervised) and run the
+    protocol-invariant audit.  Any violation is a drill failure — the
+    post-mortem must hold even on runs that SIGKILLed processes
+    mid-write.  Returns failure strings (empty ⇒ audit passed)."""
+    pm = _postmortem_mod()
+    try:
+        report = pm.run_postmortem(hb_dir, quiet=True)
+    except Exception as e:  # the tool must never crash on drill debris
+        return [f"{sched['name']}: post-mortem crashed: {e!r}"]
+    fails = [
+        f"{sched['name']}: post-mortem invariant "
+        f"{v['invariant']} violated [{v['trace']}]: {v['detail']}"
+        for v in report["violations"]
+    ]
+    print(
+        f"faultline fuzz: post-mortem {sched['name']}: "
+        f"{report['events_ingested']} events, "
+        f"{report['links_resolved']} causal links, audit "
+        f"{'FAILED' if fails else 'ok'} "
+        f"({report['audit_wall_s'] * 1000.0:.1f}ms)",
+        flush=True,
+    )
+    return fails
+
+
 def main_fuzz(seed: int, n: int, timeout_s: float) -> int:
     import tempfile
 
@@ -566,6 +613,9 @@ def main_fuzz(seed: int, n: int, timeout_s: float) -> int:
               flush=True)
         with tempfile.TemporaryDirectory() as hb:
             out = run_schedule(sched, hb, timeout_s=timeout_s)
+            pm_fails = []
+            if not out.get("skip") and not out.get("timeout"):
+                pm_fails = run_blackbox_audit(sched, hb)
         if out["skip"]:
             skipped += 1
             print(
@@ -573,7 +623,7 @@ def main_fuzz(seed: int, n: int, timeout_s: float) -> int:
                 "CPU backend)", flush=True,
             )
             continue
-        fails = check_schedule(sched, out, oracle)
+        fails = check_schedule(sched, out, oracle) + pm_fails
         if fails:
             failures.extend(fails)
             print(f"faultline fuzz: [{i + 1}/{n}] FAIL: {fails}",

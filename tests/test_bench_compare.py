@@ -186,6 +186,29 @@ def test_dcn_recovery_block_is_informational_only():
     assert any("dcn_recovery: first appearance" in n for n in notes)
 
 
+def test_postmortem_block_is_informational_only():
+    # Round 21: post-mortem reconstruction runs OFFLINE over a dead
+    # run's artifacts — even a big audit-wall jump is a note, never a
+    # regression; a causal-link collapse is visible the same way.
+    pm_a = {"audit_wall_s": 0.01, "events_ingested": 40,
+            "links_resolved": 30}
+    pm_b = {"audit_wall_s": 1.5, "events_ingested": 40,
+            "links_resolved": 2}
+    a, b = _bench(100.0), _bench(100.0)
+    a["detail"]["postmortem"] = pm_a
+    b["detail"]["postmortem"] = pm_b
+    reg, notes = compare_pair("a", a, "b", b, 0.10)
+    assert reg == []
+    assert any(
+        "postmortem audit_wall_s" in n and "informational" in n
+        for n in notes)
+    assert any("postmortem links_resolved: 30 -> 2" in n for n in notes)
+    # First appearance: one summary note.
+    reg, notes = compare_pair("a", _bench(100.0), "b", b, 0.10)
+    assert reg == []
+    assert any("postmortem: first appearance" in n for n in notes)
+
+
 def test_main_exit_codes(tmp_path, capsys):
     ok_a = _write(tmp_path, "a.json", _bench(100.0), wrap=True)
     ok_b = _write(tmp_path, "b.json", _bench(101.0))

@@ -4,6 +4,7 @@ updates state used by subsequent pods"; completions are the other half of
 that contract). Anchor = greedy_replay(completions_chunk_waves=...)."""
 
 import numpy as np
+import pytest
 
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.models.core import (
@@ -223,6 +224,7 @@ def test_whatif_device_release_path_matches_host_path():
     ).any()
 
 
+@pytest.mark.slow
 def test_whatif_device_release_full_plugin_envelope():
     """Round 4: the device-release path covers anti/pref count planes,
     multi-topology traces and singleton host-scale rows (the bench /

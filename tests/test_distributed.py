@@ -141,6 +141,7 @@ def _run_dcn(nproc: int, timeout: int = 180) -> None:
     np.testing.assert_array_equal(outs[0], _reference_placed())
 
 
+@pytest.mark.slow
 def test_two_process_dcn_matches_single_process():
     _run_dcn(2)
 

@@ -143,6 +143,7 @@ def test_double_buffer_checkpoint_blobs_identical(tmp_path):
     dict(with_affinity=True, with_spread=True, gang_fraction=0.2,
          gang_size=3),
 ])
+@pytest.mark.slow
 def test_double_buffer_feature_knobs(knobs):
     """Affinity/spread planes and gang scheduling ride the same boundary
     bookkeeping — on == off with every feature knob lit (one combined

@@ -2,6 +2,7 @@
 (SURVEY.md §5)."""
 
 import numpy as np
+import pytest
 
 from kubernetes_simulator_tpu.framework.framework import FrameworkConfig
 from kubernetes_simulator_tpu.models.encode import PAD, encode
@@ -58,6 +59,7 @@ def test_whatif_fork_from_checkpoint(tmp_path):
     assert res.placed[1] <= res.placed[0]
 
 
+@pytest.mark.slow
 def test_whatif_fork_from_padded_checkpoint(tmp_path):
     """Regression: the source replay pads its wave list to a multiple of
     chunk_waves; a checkpoint taken past the real wave count must not make

@@ -4,7 +4,7 @@ The contract under test: the recorder is a pure OBSERVER. Turning it on
 changes no placement, no deterministic JSONL byte, and no checkpoint
 blob byte across every engine mode it instruments — plain, nodeShards,
 pagedWaves, kube-boundary — including a cross-mode resume. Its own
-stream is schema-v5 valid, byte-stable for a fixed seed under
+stream is schema-v6 valid, byte-stable for a fixed seed under
 KSIM_DETERMINISTIC_JSONL, and carries the attribution the bottleneck
 report names regimes from. Pager stall counters are pinned on a crafted
 slow-page trace (a sleeping fetch) without any engine in the loop.
@@ -170,7 +170,7 @@ def test_deterministic_jsonl_parity_and_byte_stability(
             assert v == 0.0
 
 
-def test_flight_stream_validates_against_schema_v5(case, tmp_path):
+def test_flight_stream_validates_against_schema_v6(case, tmp_path):
     from check_metrics_schema import validate_file  # noqa: E402
 
     ec, ep = case
@@ -181,7 +181,7 @@ def test_flight_stream_validates_against_schema_v5(case, tmp_path):
     ).replay()
     assert validate_file(path) == []
     rows = read_stream(path)
-    assert all(r["schema"] == 5 for r in rows)
+    assert all(r["schema"] == 6 for r in rows)
     # The sharded run's chunk rows carry the exchange attribution.
     cks = [r for r in rows if r["event"] == "chunk"]
     assert cks and all("exchange_est_s" in r for r in cks)
@@ -217,6 +217,7 @@ def test_pager_stall_counters_on_crafted_slow_page_trace():
     assert fetched == [0, 1, 2, 5]
 
 
+@pytest.mark.slow
 def test_recorder_page_events_and_stall_rows(case, tmp_path):
     """A paged replay's recorder stream carries the pager gauges on
     chunk rows and a page event for the cold-start stall."""
@@ -260,6 +261,7 @@ def test_recorder_every_cadence(tmp_path):
     assert rows[0]["event"] == "start" and rows[-1]["event"] == "end"
 
 
+@pytest.mark.slow
 def test_bottleneck_report_names_regime(case, tmp_path, capsys):
     """End to end: record a composed (sharded × paged is refused, so
     sharded) replay, run the report, get a named dominant regime with
@@ -349,3 +351,71 @@ def test_fleetwatch_flight_lines_tolerant(tmp_path):
     assert w.flight_lines() == []
     # Recorder off entirely: FleetWatch without a flight path is silent.
     assert FleetWatch(str(tmp_path), 2).flight_lines() == []
+
+
+def test_fleetwatch_events_tail_survives_truncation(tmp_path):
+    """Round 21: the --watch events tail consumes only complete lines,
+    and a supervisor relaunch truncating events.jsonl underneath the
+    tail resets the byte cursor instead of seeking past EOF."""
+    from dcn_launch import FleetWatch  # noqa: E402
+
+    ev = tmp_path / "events.jsonl"
+    w = FleetWatch(str(tmp_path), 2)
+    assert w.events() == []  # no file yet: silent
+
+    ev.write_text(json.dumps({"event": "lease", "pid": 0, "block": 3}) + "\n")
+    got = w.events()
+    assert [e["event"] for e in got] == ["lease"]
+    # Mid-write partial final line: held back until it completes.
+    with open(ev, "a") as f:
+        f.write('{"event": "steal", "pid": 1, "blo')
+    assert w.events() == []
+    with open(ev, "a") as f:
+        f.write('ck": 3, "from": 0, "gen": 1}\n')
+    assert [e["event"] for e in w.events()] == ["steal"]
+    # Supervisor relaunch truncates the file to a new epoch's head: the
+    # shrink resets the cursor and the new epoch's rows surface.
+    ev.write_text(
+        json.dumps({"event": "journal_adopt", "pid": 0, "block": 3,
+                    "from": 1}) + "\n"
+    )
+    assert [e["event"] for e in w.events()] == ["journal_adopt"]
+
+
+def test_fleetwatch_line_shows_generations_and_life(tmp_path):
+    """Round 21 --watch extras: recovery claim generation, work-queue
+    lease generation, and the supervised-restart life counter."""
+    import time as _time
+
+    from dcn_launch import FleetWatch  # noqa: E402
+
+    w = FleetWatch(str(tmp_path), 2)
+    now = _time.time()
+    line = w.line({
+        0: {"state": "recover", "recovering_for": 1, "recover_gen": 2,
+            "chunk": 4, "total_chunks": 8, "t": now, "restart": 1},
+        1: {"state": "run", "wq_block": 5, "wq_gen": 1,
+            "leased_blocks": 1, "chunk": 6, "total_chunks": 8, "t": now},
+    })
+    assert "recovering-p1@g2" in line
+    assert "life=1" in line
+    assert "run@b5.g1" in line
+
+
+def test_fleetwatch_event_line_renders_round21_kinds():
+    """event_line covers the checkpoint and faultline trail kinds the
+    round-21 black box stamps into the KV mirror."""
+    from dcn_launch import FleetWatch  # noqa: E402
+
+    el = FleetWatch.event_line
+    assert "loads p1's checkpoint" in el(
+        {"event": "ckpt_load", "by": 2, "pid": 1, "cursor": 4})
+    assert "FALLS BACK" in el(
+        {"event": "ckpt_fallback", "by": 2, "pid": 1})
+    assert "FAULT-KILLED" in el(
+        {"event": "fault_kill", "pid": 1, "state": "run"})
+    assert "fault error injected on wq/0/lease/3" in el(
+        {"event": "fault_inject", "pid": 1, "class": "error",
+         "key": "wq/0/lease/3"})
+    assert "fault slow_io injected" in el(
+        {"event": "fault_slow", "pid": 1, "class": "slow_io"})

@@ -28,6 +28,7 @@ def _cliff_case():
     return encode(cluster, pods)
 
 
+@pytest.mark.slow
 def test_cliff_recovers_under_guard():
     ec, ep = _cliff_case()
     cfg = FrameworkConfig()

@@ -411,6 +411,7 @@ def test_boundary_checkpoint_guards(tmp_path):
         ).run()
 
 
+@pytest.mark.slow
 def test_batch_whatif_kube_matches_single_replay():
     """Round 5 stretch: WhatIfEngine(preemption="kube") — per-scenario
     host mirrors run the exact PostFilter; the unperturbed scenario must

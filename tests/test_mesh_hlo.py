@@ -143,14 +143,16 @@ def _assert_no_collectives(txt: str) -> None:
 # 8 = smoke shape; 1024 = the bench.py headline (8 devices × 128
 # scenarios/device). The partitioner runs at compile time, so this pins
 # the SHIPPED configuration collective-free, not just a toy.
-@pytest.mark.parametrize("S", [8, 1024])
+@pytest.mark.parametrize(
+    "S", [8, pytest.param(1024, marks=pytest.mark.slow)])
 def test_mesh_chunk_program_has_no_collectives(S):
     eng = _mesh_engine(S, with_durations=False)
     args, _ = _chunk_args(eng, with_durations=False)
     _assert_no_collectives(eng._chunk_fn.lower(*args).compile().as_text())
 
 
-@pytest.mark.parametrize("S", [8, 1024])
+@pytest.mark.parametrize(
+    "S", [8, pytest.param(1024, marks=pytest.mark.slow)])
 def test_mesh_chunk_program_no_collectives_with_completions(S):
     """The completions-on shape (the north-star semantics): releases run
     on-device under mesh since round 10, so both the chunk program and

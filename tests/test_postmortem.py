@@ -1,0 +1,342 @@
+"""scripts/fleet_postmortem.py (round 21): black-box reconstruction —
+causal timeline merge, the six-invariant audit, Perfetto export with
+cross-process flow arrows, and tolerance of torn/interleaved inputs
+(truncated final line, missing flight sibling, out-of-order stamps —
+always a partial timeline + warning, never a crash or a false
+violation)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "scripts")
+    ),
+)
+
+import fleet_postmortem as pm  # noqa: E402
+
+from kubernetes_simulator_tpu.parallel import trace  # noqa: E402
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _write_events(run_dir, events, t0=100.0, step=0.25, tail=""):
+    """Stamp the rows through the real trace module and write the
+    events.jsonl mirror exactly as dcn._mirror_event does."""
+    path = os.path.join(run_dir, "events.jsonl")
+    with open(path, "a") as f:
+        for i, ev in enumerate(events):
+            ev = trace.stamp(dict(ev))
+            ev.setdefault("t", t0 + i * step)
+            f.write(json.dumps(ev, sort_keys=True) + "\n")
+        if tail:
+            f.write(tail)
+    return path
+
+
+def _healthy_events():
+    """A fleet story exercising every lifecycle: block 0 stolen after a
+    stale renewal, block 1 resolved by a speculative win, a checkpoint
+    crossing processes, and an injected fault."""
+    return [
+        {"event": "lease", "pid": 0, "block": 0, "gen": 0},
+        {"event": "steal", "pid": 1, "block": 0, "gen": 1, "from": 0,
+         "renew_age_s": 9.5, "threshold_s": 6.0},
+        {"event": "block_done", "pid": 1, "block": 0, "gen": 1,
+         "spec": False},
+        {"event": "dup_discard", "pid": 0, "block": 0, "gen": 0},
+        {"event": "lease", "pid": 2, "block": 1, "gen": 0},
+        {"event": "speculate", "pid": 0, "block": 1, "gen": 0, "from": 2,
+         "renew_age_s": 4.0, "threshold_s": 3.0},
+        {"event": "block_done", "pid": 0, "block": 1, "gen": 0,
+         "spec": True},
+        {"event": "spec_lost", "pid": 2, "block": 1, "gen": 0},
+        {"kind": "ckpt_publish", "pid": 1, "cursor": 3, "block": [4, 8]},
+        {"event": "ckpt_load", "pid": 1, "cursor": 3, "block": [4, 8],
+         "by": 0},
+        {"event": "fault_inject", "pid": 0, "class": "kv_error",
+         "key": "ksim/wq/0/w/lease/0", "op": "set", "n": 1},
+    ]
+
+
+def _run(run_dir, **kw):
+    return pm.run_postmortem(str(run_dir), quiet=True, **kw)
+
+
+# -- healthy reconstruction --------------------------------------------------
+
+
+def test_healthy_run_passes_audit_with_cross_process_flows(tmp_path):
+    _write_events(tmp_path, _healthy_events())
+    (tmp_path / "p0.json").write_text(
+        json.dumps({"pid": 0, "state": "run", "chunk": 3})
+    )
+    out = tmp_path / "trace.json"
+    report = _run(tmp_path, out=str(out))
+    assert report["rc"] == 0
+    assert report["violations"] == []
+    assert report["events_ingested"] == 11
+    assert report["beacons"] == 1
+    assert report["links_resolved"] > 0
+    assert all(v == "ok" for v in report["invariants"].values())
+
+    tr = json.load(open(out))
+    slices = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in slices} == {0, 1, 2}
+    # Fault injections are instant markers, not slices.
+    instants = [e for e in tr["traceEvents"] if e.get("ph") == "i"]
+    assert len(instants) == 1
+    # Flow arrows cross processes: blk:0 threads p0 -> p1, the ckpt
+    # trace threads p1's publish to p0's load.
+    flows = {}
+    for e in tr["traceEvents"]:
+        if e.get("ph") in ("s", "t", "f"):
+            flows.setdefault(e["name"], set()).add(e["pid"])
+    assert flows["blk:0"] == {0, 1}
+    assert flows["ckpt:1:3"] == {0, 1}
+
+
+# -- every invariant trips on its fixture ------------------------------------
+
+
+def test_double_done_winner_trips(tmp_path):
+    _write_events(tmp_path, _healthy_events() + [
+        {"event": "block_done", "pid": 2, "block": 0, "gen": 0,
+         "spec": False},
+    ])
+    report = _run(tmp_path)
+    assert report["rc"] == 1
+    v = report["violations"][0]
+    assert v["invariant"] == "one-done-winner"
+    assert v["trace"] == "blk:0"
+    assert any(e.get("event") == "steal" for e in v["chain"])
+
+
+def test_corrupted_done_ledger_trips_and_names_chain(tmp_path):
+    """The acceptance fixture: a durable done ledger that names a
+    DIFFERENT winner than the done-CAS trail exits nonzero with the
+    invariant named and the block's full event chain printed."""
+    _write_events(tmp_path, _healthy_events())
+    led = tmp_path / "journal" / "wq" / "0" / "w" / "done"
+    led.mkdir(parents=True)
+    (led / "0").write_text(json.dumps({"pid": 2, "gen": 0}))  # lie
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "fleet_postmortem.py"),
+         str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 1
+    assert "VIOLATION one-done-winner [blk:0]" in p.stdout
+    assert "offending event chain" in p.stdout
+    assert '"event": "steal"' in p.stdout  # the chain is printed whole
+
+
+def test_lease_gen_regression_trips(tmp_path):
+    _write_events(tmp_path, [
+        {"event": "lease", "pid": 0, "block": 3, "gen": 0},
+        {"event": "steal", "pid": 1, "block": 3, "gen": 2, "from": 0},
+        {"event": "steal", "pid": 2, "block": 3, "gen": 1, "from": 1},
+    ])
+    report = _run(tmp_path)
+    assert report["invariants"]["lease-gen-monotonic"] == "violated"
+
+
+def test_claim_gen_regression_trips(tmp_path):
+    _write_events(tmp_path, [
+        {"event": "claim", "claimant": 0, "for": 2, "gen": 1},
+        {"event": "claim", "claimant": 1, "for": 2, "gen": 0},
+    ])
+    report = _run(tmp_path)
+    assert report["invariants"]["lease-gen-monotonic"] == "violated"
+
+
+def test_adopt_then_reexecution_trips(tmp_path):
+    _write_events(tmp_path, [
+        {"event": "journal_adopt", "pid": 0, "block": 5, "gen": 0,
+         "from": 1},
+        {"event": "steal", "pid": 2, "block": 5, "gen": 1, "from": 1},
+    ])
+    report = _run(tmp_path)
+    assert report["invariants"]["adopt-no-reexec"] == "violated"
+
+
+def test_resume_cursor_beyond_durable_cap_trips(tmp_path):
+    _write_events(tmp_path, [
+        {"kind": "ckpt_publish", "pid": 1, "cursor": 2, "block": [4, 8]},
+        {"event": "ckpt_load", "pid": 1, "cursor": 6, "block": [4, 8],
+         "by": 0},
+    ])
+    ck = tmp_path / "journal" / "ckpt" / "7" / "1" / "4-8" / "2"
+    ck.mkdir(parents=True)
+    (ck / "manifest.json").write_text('{"n": 1}')
+    report = _run(tmp_path)
+    assert report["invariants"]["resume-cursor-bounded"] == "violated"
+    v = report["violations"][0]
+    assert "6" in v["detail"] and "2" in v["detail"]
+
+
+def test_premature_steal_trips(tmp_path):
+    _write_events(tmp_path, [
+        {"event": "lease", "pid": 0, "block": 2, "gen": 0},
+        {"event": "steal", "pid": 1, "block": 2, "gen": 1, "from": 0,
+         "renew_age_s": 0.5, "threshold_s": 6.0},
+        {"event": "block_done", "pid": 1, "block": 2, "gen": 1,
+         "spec": False},
+    ])
+    report = _run(tmp_path)
+    assert report["invariants"]["steal-after-stale-renewal"] == "violated"
+
+
+def test_dup_without_winner_trips(tmp_path):
+    _write_events(tmp_path, [
+        {"event": "lease", "pid": 0, "block": 9, "gen": 0},
+        {"event": "dup_discard", "pid": 0, "block": 9, "gen": 0},
+    ])
+    report = _run(tmp_path)
+    assert report["invariants"]["dup-has-winner"] == "violated"
+
+
+def test_dup_with_ledger_winner_is_clean(tmp_path):
+    """A winner killed between its done-CAS and the mirror write leaves
+    only the durable ledger as evidence — that must satisfy the audit,
+    not false-violate it."""
+    _write_events(tmp_path, [
+        {"event": "lease", "pid": 0, "block": 9, "gen": 0},
+        {"event": "dup_discard", "pid": 0, "block": 9, "gen": 0},
+    ])
+    led = tmp_path / "journal" / "wq" / "0" / "w" / "done"
+    led.mkdir(parents=True)
+    (led / "9").write_text(json.dumps({"pid": 1, "gen": 0}))
+    report = _run(tmp_path)
+    assert report["rc"] == 0
+
+
+def test_restart_reopens_gen_zero_without_false_violation(tmp_path):
+    """A supervised restart legitimately re-leases a stolen-but-unfinished
+    block at gen 0 in the fresh KV epoch — episode segmentation must not
+    read that as a generation regression."""
+    _write_events(tmp_path, [
+        {"event": "lease", "pid": 0, "block": 1, "gen": 0},
+        {"event": "steal", "pid": 1, "block": 1, "gen": 1, "from": 0},
+        # fleet dies here; supervisor relaunches; fresh epoch:
+        {"event": "lease", "pid": 2, "block": 1, "gen": 0},
+        {"event": "block_done", "pid": 2, "block": 1, "gen": 0,
+         "spec": False},
+    ])
+    report = _run(tmp_path)
+    assert report["rc"] == 0, report["violations"]
+
+
+# -- torn / interleaved inputs (satellite 3) ---------------------------------
+
+
+def test_truncated_final_line_warns_never_crashes(tmp_path):
+    _write_events(tmp_path, _healthy_events(),
+                  tail='{"event": "lease", "pid":')
+    report = _run(tmp_path)
+    assert report["rc"] == 0
+    assert report["events_ingested"] == 11
+    assert any("torn final line" in w for w in report["warnings"])
+
+
+def test_missing_events_file_degrades_to_warning(tmp_path):
+    report = _run(tmp_path)
+    assert report["rc"] == 0
+    assert report["events_ingested"] == 0
+    assert any("events.jsonl: missing" in w for w in report["warnings"])
+
+
+def test_missing_flight_sibling_warns(tmp_path):
+    _write_events(tmp_path, _healthy_events())
+    flight = tmp_path / "flight.jsonl"
+    flight.write_text(
+        json.dumps({
+            "kind": "flight", "schema": 6, "ts": 0.0, "event": "fleet",
+            "fleet_event": "lease", "chunk": -1, "wall_s": 0.0,
+            "pid": 0, "block": 0, "gen": 0,
+        }) + "\n"
+    )
+    report = _run(tmp_path, flight=str(tmp_path / "missing.jsonl"))
+    assert report["rc"] == 0
+    assert any("missing" in w for w in report["warnings"])
+    # And a present stream with a dead sibling still contributes rows.
+    report = _run(tmp_path, flight=str(flight))
+    assert report["rc"] == 0
+
+
+def test_out_of_order_stamps_warn_and_resort(tmp_path):
+    evs = _healthy_events()
+    with open(tmp_path / "events.jsonl", "w") as f:
+        for i, ev in enumerate(evs):
+            ev = trace.stamp(dict(ev))
+            # Process 2's clock runs 50s behind: its stamps interleave
+            # out of order across processes.
+            t = 100.0 + i * 0.25 - (50.0 if ev.get("pid") == 2 else 0.0)
+            ev["t"] = t
+            f.write(json.dumps(ev, sort_keys=True) + "\n")
+    report = _run(tmp_path)
+    assert report["rc"] == 0, report["violations"]
+    assert any("out-of-order" in w for w in report["warnings"])
+
+
+def test_torn_beacon_and_torn_ledger_warn(tmp_path):
+    _write_events(tmp_path, _healthy_events())
+    (tmp_path / "p1.json").write_text('{"pid": 1, "state"')  # torn
+    led = tmp_path / "journal" / "wq" / "0" / "w" / "done"
+    led.mkdir(parents=True)
+    (led / "0").write_text('{"pid"')  # torn ledger record
+    report = _run(tmp_path)
+    assert report["rc"] == 0  # torn evidence is skipped, not violated
+    assert any("torn beacon" in w for w in report["warnings"])
+    assert any("torn ledger" in w for w in report["warnings"])
+
+
+def test_malformed_rows_never_crash_the_audit(tmp_path):
+    with open(tmp_path / "events.jsonl", "w") as f:
+        f.write('{"event": "lease", "pid": "x", "block": "y", "gen": []}\n')
+        f.write('[1, 2, 3]\n')  # non-dict row
+        f.write('{"event": "steal", "pid": 1, "block": 2, "gen": "z", '
+                '"trace": "blk:2"}\n')
+        f.write('{"event": "ckpt_load", "pid": "a", "cursor": "b"}\n')
+        f.write("not json at all\n")
+    report = _run(tmp_path)
+    assert report["rc"] == 0  # degraded evidence, no false violation
+
+
+# -- schema + CLI ------------------------------------------------------------
+
+
+def test_postmortem_jsonl_row_validates_v6(tmp_path, monkeypatch):
+    monkeypatch.setenv("KSIM_DETERMINISTIC_JSONL", "1")
+    _write_events(tmp_path, _healthy_events())
+    out_jsonl = tmp_path / "pm.jsonl"
+    report = _run(tmp_path, jsonl=str(out_jsonl))
+    assert report["rc"] == 0
+    row = json.loads(out_jsonl.read_text().splitlines()[0])
+    assert row["kind"] == "postmortem"
+    assert row["schema"] == 6
+    assert row["ts"] == 0.0 and row["audit_wall_s"] == 0.0
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "check_metrics_schema.py"),
+         str(out_jsonl)],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_rc2_on_missing_dir(tmp_path):
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "fleet_postmortem.py"),
+         str(tmp_path / "nope")],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 2

@@ -227,7 +227,8 @@ def test_completed_pod_not_evicted():
     assert d.preemptions == 0
 
 
-@pytest.mark.parametrize("seed", [2, 3])
+@pytest.mark.parametrize(
+    "seed", [pytest.param(2, marks=pytest.mark.slow), 3])
 def test_preemption_completions_parity_random(seed):
     """Random over-committed workload WITH durations: device preemption ×
     completions must match the anchor exactly. Shape tuned so BOTH
@@ -313,6 +314,7 @@ def test_fused_tier_mix_parity(tiers):
 
 
 @pytest.mark.parametrize("seed", [0])
+@pytest.mark.slow
 def test_fused_matches_prefusion_random(seed):
     """Randomized over-committed traces (gangs, spread, tolerations):
     the fused and pre-fusion device programs must be BIT-identical —

@@ -212,6 +212,7 @@ def test_retry_multi_scenario_counts():
     assert int(res.placed[1]) <= int(res.placed[0])
 
 
+@pytest.mark.slow
 def test_retry_full_plugin_envelope_parity():
     """Round 4 widening: retry works on traces WITH anti/pref count
     planes, multi-topology spread and singleton host rows — the pend

@@ -1,0 +1,328 @@
+"""Round 21 fleet black box: the causal trace identity module
+(``parallel.trace``) — grammar units, the per-kind stamping rules at the
+``dcn._mirror_event`` choke point, and the byte-identity parity bar:
+trace stamping is READ-ONLY telemetry, so checkpoint blobs and the
+coordination-plane bytes are identical with ``KSIM_TRACE`` on and off.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_simulator_tpu.parallel import dcn, trace
+
+
+class _FakeKV:
+    """In-memory stand-in for the jaxlib coordination-service KV client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if not allow_overwrite and key in self.store:
+            raise RuntimeError(f"key exists: {key}")
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        import time
+
+        if key in self.store:
+            return self.store[key]
+        time.sleep(timeout_ms / 1000.0)
+        raise RuntimeError(f"Deadline Exceeded: {key}")
+
+    def key_value_dir_get(self, prefix):
+        return [
+            (k, v) for k, v in sorted(self.store.items())
+            if k.startswith(prefix)
+        ]
+
+
+def _fleet(monkeypatch, nproc=2, pid=1, journal=None):
+    kv = _FakeKV()
+    monkeypatch.setattr(dcn, "process_info", lambda: (nproc, pid))
+    monkeypatch.setattr(dcn, "_client", lambda: kv)
+    monkeypatch.setattr(dcn, "_degraded_exit_armed", [True])
+    monkeypatch.setattr(dcn, "DEGRADED", set())
+    if journal is not None:
+        monkeypatch.setenv("KSIM_DCN_DURABLE_DIR", str(journal))
+    else:
+        monkeypatch.delenv("KSIM_DCN_DURABLE_DIR", raising=False)
+    monkeypatch.delenv("KSIM_DCN_RESUME", raising=False)
+    return kv
+
+
+def _payload(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "cursor": 3,
+        "leaves": {"states": rng.integers(-1, 64, size=(8, 16),
+                                          dtype=np.int32)},
+    }
+
+
+@pytest.fixture(autouse=True)
+def _clean_ctx(monkeypatch):
+    monkeypatch.delenv("KSIM_TRACE", raising=False)
+    monkeypatch.setattr(trace, "CTX", [None])
+    yield
+
+
+# -- grammar -----------------------------------------------------------------
+
+
+def test_trace_id_grammar():
+    assert trace.block_trace(7) == "blk:7"
+    assert trace.static_trace(2) == "blk:s2"
+    assert trace.ckpt_trace(1, 3) == "ckpt:1:3"
+    assert trace.exec_span(7, 0, 1) == "blk:7/exec.g0.p1"
+    assert trace.exec_span(7, 2, 0) == "blk:7/exec.g2.p0"
+    assert trace.spec_span(7, 1, 0) == "blk:7/spec.g1.p0"
+    assert trace.publish_span(1, 3) == "ckpt:1:3/publish.p1"
+
+
+def test_trace_for_key_covers_every_traced_plane():
+    # Checkpoint keys: ksim/ckpt/<epoch>/<pid>/<lo>-<hi>/<cursor>[/leaf]
+    assert trace.trace_for_key("ksim/ckpt/7/1/4-8/3/n") == "ckpt:1:3"
+    assert trace.trace_for_key("ksim/ckpt/7/1/4-8/3/0") == "ckpt:1:3"
+    assert trace.trace_for_key("ksim/ckpt/7/1/4-8/3") == "ckpt:1:3"
+    # Claim keys: ksim/claim/<seq>/<name>/<dead_pid>/<gen>
+    assert trace.trace_for_key("ksim/claim/2/block/1/0") == "blk:s1"
+    # Work-queue keys: ksim/wq/<seq>/<name>/<sub>/<bid>
+    for sub in ("lease", "renew", "done", "spec", "result"):
+        assert trace.trace_for_key(f"ksim/wq/2/q/{sub}/5") == "blk:5"
+    # Untraced planes degrade to None, never an error.
+    assert trace.trace_for_key("ksim/hb/0") is None
+    assert trace.trace_for_key("ksim/wq/2/q/assign/x") is None
+    assert trace.trace_for_key("other/ckpt/7/1/4-8/3") is None
+    assert trace.trace_for_key("") is None
+
+
+# -- per-kind stamping rules -------------------------------------------------
+
+
+def test_stamp_block_lifecycle_chain():
+    lease = trace.stamp({"event": "lease", "pid": 0, "block": 4, "gen": 0})
+    assert lease["trace"] == "blk:4"
+    assert lease["span"] == "blk:4/exec.g0.p0"
+    assert "parent" not in lease
+
+    steal = trace.stamp(
+        {"event": "steal", "pid": 1, "block": 4, "gen": 1, "from": 0}
+    )
+    assert steal["span"] == "blk:4/exec.g1.p1"
+    assert steal["parent"] == "blk:4/exec.g0.p0"
+
+    spec = trace.stamp(
+        {"event": "speculate", "pid": 2, "block": 4, "gen": 1, "from": 1}
+    )
+    assert spec["span"] == "blk:4/spec.g1.p2"
+    assert spec["parent"] == "blk:4/exec.g1.p1"
+
+    done = trace.stamp(
+        {"event": "block_done", "pid": 2, "block": 4, "gen": 1,
+         "spec": True}
+    )
+    assert done["span"] == "blk:4/done.g1.p2"
+    assert done["parent"] == "blk:4/spec.g1.p2"
+
+    done_plain = trace.stamp(
+        {"event": "block_done", "pid": 1, "block": 4, "gen": 1,
+         "spec": False}
+    )
+    assert done_plain["parent"] == "blk:4/exec.g1.p1"
+
+    lost = trace.stamp(
+        {"event": "spec_lost", "pid": 2, "block": 4, "gen": 1}
+    )
+    assert lost["parent"] == "blk:4/spec.g1.p2"
+
+    dup = trace.stamp(
+        {"event": "dup_discard", "pid": 1, "block": 4, "gen": 1}
+    )
+    assert dup["parent"] == "blk:4/exec.g1.p1"
+
+
+def test_stamp_adopt_claims_and_ckpt_hops():
+    adopt = trace.stamp(
+        {"event": "journal_adopt", "pid": 0, "block": 4, "gen": 1,
+         "from": 2}
+    )
+    assert adopt["trace"] == "blk:4"
+    assert adopt["span"] == "blk:4/adopt.p0"
+    assert adopt["parent"] == "blk:4/done.g1.p2"
+
+    claim0 = trace.stamp(
+        {"event": "claim", "claimant": 0, "for": 1, "gen": 0}
+    )
+    assert claim0["trace"] == "blk:s1"
+    assert claim0["span"] == "blk:s1/claim.g0.p0"
+    assert "parent" not in claim0
+
+    claim1 = trace.stamp(
+        {"event": "claim", "claimant": 2, "for": 1, "gen": 1}
+    )
+    assert claim1["parent"] == "blk:s1/claim.g0"  # prefix, pid unknown
+
+    rec = trace.stamp(
+        {"event": "recovered", "claimant": 0, "for": 1, "gen": 0}
+    )
+    assert rec["span"] == "blk:s1/recover.g0.p0"
+    assert rec["parent"] == "blk:s1/claim.g0.p0"
+
+    # ckpt_publish names its kind under "kind" (test_durable pin).
+    pub = trace.stamp({"kind": "ckpt_publish", "pid": 1, "cursor": 3})
+    assert pub["trace"] == "ckpt:1:3"
+    assert pub["span"] == "ckpt:1:3/publish.p1"
+
+    load = trace.stamp(
+        {"event": "ckpt_load", "pid": 1, "cursor": 3, "by": 0}
+    )
+    assert load["span"] == "ckpt:1:3/load.p0"
+    assert load["parent"] == "ckpt:1:3/publish.p1"
+
+
+def test_stamp_ctx_links_ckpt_to_block():
+    trace.CTX[0] = "blk:s1"
+    try:
+        pub = trace.stamp({"kind": "ckpt_publish", "pid": 1, "cursor": 2})
+        assert pub["link"] == "blk:s1"
+        load = trace.stamp(
+            {"event": "ckpt_load", "pid": 1, "cursor": 2, "by": 0}
+        )
+        assert load["link"] == "blk:s1"
+    finally:
+        trace.CTX[0] = None
+
+
+def test_stamp_faults_follow_key_ctx_or_dead_pid():
+    inj = trace.stamp(
+        {"event": "fault_inject", "pid": 0, "class": "kv_error",
+         "key": "ksim/wq/2/q/lease/5", "op": "set", "n": 3}
+    )
+    assert inj["trace"] == "blk:5"
+    assert inj["span"] == "blk:5/fault_inject.kv_error.n3.p0"
+
+    trace.CTX[0] = "blk:7"
+    try:
+        slow = trace.stamp(
+            {"event": "fault_slow", "pid": 1, "class": "slow", "n": 0}
+        )
+        assert slow["trace"] == "blk:7"
+    finally:
+        trace.CTX[0] = None
+
+    # A kill with no block context heads the dead pid's static-recovery
+    # lifecycle — the survivor's claim shares the trace, so the
+    # post-mortem flow arrow runs dead -> claimant.
+    kill = trace.stamp(
+        {"event": "fault_kill", "pid": 2, "class": "kill",
+         "state": "run", "n": 0}
+    )
+    assert kill["trace"] == "blk:s2"
+
+    # An untraceable fault still gets a span (instant marker), no trace.
+    other = trace.stamp(
+        {"event": "fault_inject", "pid": 0, "class": "file",
+         "op": "mirror", "n": 1}
+    )
+    assert "trace" not in other
+    assert other["span"].startswith("fault/")
+
+
+def test_stamp_gate_idempotence_and_malformed_input(monkeypatch):
+    monkeypatch.setenv("KSIM_TRACE", "0")
+    ev = trace.stamp({"event": "lease", "pid": 0, "block": 4, "gen": 0})
+    assert "trace" not in ev and "span" not in ev
+    monkeypatch.delenv("KSIM_TRACE", raising=False)
+
+    pre = {"event": "lease", "pid": 0, "block": 4, "gen": 0,
+           "trace": "blk:99", "span": "blk:99/exec.g0.p0"}
+    assert trace.stamp(dict(pre)) == pre  # pre-stamped: untouched
+
+    # Malformed events degrade to no stamp, never an error.
+    for bad in (
+        {"event": "claim"},                      # no claimant/for
+        {"event": "block_done", "pid": None},    # unstampable fields
+        {"event": "steal", "pid": 0, "block": "x", "gen": "y"},
+        {},
+    ):
+        out = trace.stamp(dict(bad))
+        assert isinstance(out, dict)
+
+
+# -- the choke point ---------------------------------------------------------
+
+
+def test_mirror_event_stamps_every_sink(tmp_path, monkeypatch):
+    """_mirror_event stamps BEFORE fan-out: EVENT_SINKS and the
+    events.jsonl mirror see identical trace identity."""
+    _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.setenv("KSIM_DCN_HB_DIR", str(tmp_path))
+    seen = []
+    monkeypatch.setattr(dcn, "EVENT_SINKS", [seen.append])
+    dcn._mirror_event({"event": "lease", "pid": 0, "block": 9, "gen": 0})
+    assert seen[0]["trace"] == "blk:9"
+    rows = [
+        json.loads(l) for l in
+        (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    assert rows[0]["trace"] == "blk:9"
+    assert rows[0]["span"] == seen[0]["span"] == "blk:9/exec.g0.p0"
+
+
+# -- byte-identity parity bar ------------------------------------------------
+
+
+def test_checkpoint_bytes_identical_with_stamping_on_and_off(
+    tmp_path, monkeypatch
+):
+    """The acceptance pin: trace stamping changes telemetry ONLY. The
+    framed checkpoint chunk bytes on the KV plane and in the durable
+    journal are byte-identical with KSIM_TRACE on and off; the manifest
+    differs ONLY by its ``trace`` key and is the SAME string on both
+    planes in both modes (the round-20 mirror-equality pin holds)."""
+    stores = {}
+    for mode, flag in (("on", "1"), ("off", "0")):
+        monkeypatch.setenv("KSIM_TRACE", flag)
+        journal = tmp_path / mode
+        kv = _fleet(monkeypatch, nproc=2, pid=1, journal=journal)
+        assert dcn.publish_checkpoint(3, _payload(5), (4, 8), epoch=7)
+        stores[mode] = kv.store
+        # KV manifest == journal manifest, byte for byte, in BOTH modes.
+        man_disk = (
+            journal / "ckpt" / "7" / "1" / "4-8" / "3" / "manifest.json"
+        ).read_text()
+        assert man_disk == kv.store["ksim/ckpt/7/1/4-8/3/n"]
+    on, off = stores["on"], stores["off"]
+    assert set(on) == set(off)
+    man_on = json.loads(on["ksim/ckpt/7/1/4-8/3/n"])
+    man_off = json.loads(off["ksim/ckpt/7/1/4-8/3/n"])
+    assert man_on.pop("trace") == "ckpt:1:3"
+    assert "trace" not in man_off
+    assert man_on == man_off  # n / crc / len identical
+    for key in on:
+        if key.endswith("/n"):
+            continue
+        assert on[key] == off[key], f"chunk bytes differ at {key}"
+
+
+def test_heartbeat_beacon_carries_generation_and_restart(
+    tmp_path, monkeypatch
+):
+    """Round-21 beacon extras for dcn_launch --watch: the lease
+    generation + block trace while holding a lease, and the supervised
+    restart count when KSIM_DCN_RESTART_COUNT is exported."""
+    _fleet(monkeypatch, nproc=2, pid=0)
+    monkeypatch.setenv("KSIM_DCN_HB_DIR", str(tmp_path))
+    monkeypatch.setenv("KSIM_DCN_RESTART_COUNT", "2")
+    monkeypatch.setattr(
+        dcn, "_ACTIVE_LEASE", [{"bid": 6, "gen": 1, "key": "k"}]
+    )
+    assert dcn.heartbeat(0, total=4, state="run")
+    beat = json.loads((tmp_path / "p0.json").read_text())
+    assert beat["wq_gen"] == 1
+    assert beat["trace"] == "blk:6"
+    assert beat["restart"] == 2

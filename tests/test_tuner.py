@@ -63,6 +63,7 @@ def test_default_policy_vector_matches_static():
     assert (np.asarray(base.placed) == np.asarray(pol.placed)).all()
 
 
+@pytest.mark.slow
 def test_nondefault_weights_and_strategy_parity():
     """A non-default weight vector + the MostAllocated selector must match
     a static config carrying the same weights and strategy."""
@@ -83,6 +84,7 @@ def test_nondefault_weights_and_strategy_parity():
     assert (static.assignments == traced.assignments).all()
 
 
+@pytest.mark.slow
 def test_per_scenario_policies_differ():
     """Different vectors on different scenarios of ONE batch actually
     produce the per-policy outcomes (the population sweep mechanism)."""
@@ -105,6 +107,7 @@ def test_per_scenario_policies_differ():
     assert (batch.assignments[1] == ref_most.assignments[0]).all()
 
 
+@pytest.mark.slow
 def test_population_sweep_single_compile():
     """set_policies swaps values only — the chunk program must not
     recompile across rounds (the tuner's whole-search pin)."""
@@ -123,6 +126,7 @@ def test_population_sweep_single_compile():
     assert eng._chunk_fn._cache_size() == 1
 
 
+@pytest.mark.slow
 def test_mesh_policy_sweep_matches_vmap():
     ec, ep = small_case(seed=4, n=12, p=64)
     cfg = FrameworkConfig()

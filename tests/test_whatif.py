@@ -95,6 +95,7 @@ def test_mesh_sharded_matches_unsharded():
     assert (plain.placed == sharded.placed).all()
 
 
+@pytest.mark.slow
 def test_node_down_reduces_capacity():
     ec, ep = small_case(seed=1, n=6, p=60)
     scen = [Scenario(), Scenario([Perturbation("node_down", nodes=np.arange(3))])]
@@ -178,6 +179,7 @@ def _force_v2(ec, ep, scen, cfg, **kw):
     return eng
 
 
+@pytest.mark.slow
 def test_labels_dirty_runs_v3_and_matches_v2_and_scratch():
     """Round-3 DynTables: label-perturbation batches stay on the v3 engine
     and must match BOTH the v2 parity engine and a from-scratch replay of
@@ -256,6 +258,7 @@ def test_labels_dirty_runs_v3_and_matches_v2_and_scratch():
         )
 
 
+@pytest.mark.slow
 def test_labels_dirty_mesh_matches_unsharded():
     """DynTables shard over the scenario axis like every other per-scenario
     tensor: the 8-device mesh run must equal the unsharded batch."""
@@ -284,6 +287,7 @@ def test_labels_dirty_mesh_matches_unsharded():
     np.testing.assert_array_equal(res.assignments, res2.assignments)
 
 
+@pytest.mark.slow
 def test_config5_scale_1024_scenarios_mesh():
     """[BASELINE] config #5 at its STATED scenario count: 1024 scenarios
     mesh-sharded over the 8 virtual devices (tiny nodes/pods so the smoke

@@ -36,6 +36,7 @@ def _contended(seed=2, nodes=8, pods_n=400):
     return encode(cluster, pods)
 
 
+@pytest.mark.slow
 def test_unperturbed_matches_anchor_and_single_replay():
     ec, ep = _contended()
     cfg = FrameworkConfig()
