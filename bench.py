@@ -788,6 +788,74 @@ def main():
             }
         }
 
+    # Resident query service (round 22): cold-start wall vs warm query
+    # latency through the pooled-engine serving plane, plus coalesced
+    # defrag throughput at full batch occupancy. The warm/cold ratio is
+    # THE acceptance number — a warm query swaps scenario values against
+    # the resident executable (zero recompilation, compile_counts pins
+    # it), so it must come in >= 10x cheaper than the cold build.
+    # BENCH_SERVICE=0 disables; BENCH_SERVICE_NODES/PODS resize.
+    service_block = {}
+    if int(os.environ.get("BENCH_SERVICE", 1)) and nproc == 1:
+        from kubernetes_simulator_tpu.sim.service import QueryService
+
+        s_nodes = int(os.environ.get("BENCH_SERVICE_NODES", 200))
+        s_pods = int(os.environ.get("BENCH_SERVICE_PODS", 2000))
+        s_rounds = int(os.environ.get("BENCH_SERVICE_ROUNDS", 4))
+        cluster_s = make_cluster(s_nodes, seed=0)
+        pods_s, _ = make_workload(
+            s_pods, seed=0, duration_mean=dur_mean or None
+        )
+        ec_s, ep_s = encode(cluster_s, pods_s)
+        svc = QueryService(ec_s, ep_s, cfg, max_batch=3, chunk_waves=512)
+        rng_s = np.random.default_rng(0)
+        qi = iter(range(10_000))
+
+        def _defrag(i):
+            picks = rng_s.choice(s_nodes, size=2, replace=False)
+            return {"op": "defrag", "tenant": f"team-{i % 3}",
+                    "id": f"q{i}", "nodes": [int(n) for n in picks],
+                    "drainAt": 5.0, "recoverAt": 20.0}
+
+        svc.submit(_defrag(next(qi)))
+        svc.flush()
+        cold_lat = float(svc.poll()[0]["latency_s"])
+        warm_lats = []
+        for _ in range(s_rounds):  # single-query flushes: pure latency
+            svc.submit(_defrag(next(qi)))
+            svc.flush()
+            warm_lats.append(float(svc.poll()[0]["latency_s"]))
+        warm_med = float(np.median(sorted(warm_lats)))
+        t0_s = time.perf_counter()  # full-occupancy coalesced rounds
+        n_coal = 0
+        for _ in range(s_rounds):
+            for _ in range(3):
+                svc.submit(_defrag(next(qi)))  # 3rd submit auto-flushes
+            n_coal += 3
+        svc.poll()
+        coal_wall = time.perf_counter() - t0_s
+        st_s = svc.stats()
+        svc.close()
+        service_block = {
+            "service": {
+                "nodes": s_nodes,
+                "pods": s_pods,
+                "cold_latency_s": round(cold_lat, 3),
+                "warm_latency_median_s": round(warm_med, 4),
+                "warm_speedup": round(
+                    cold_lat / warm_med if warm_med > 0 else 0.0, 1
+                ),
+                "warm_queries_per_sec": round(
+                    n_coal / coal_wall if coal_wall > 0 else 0.0, 2
+                ),
+                "queries": st_s["queries"],
+                "batches": st_s["batches"],
+                "cold_builds": st_s["cold_builds"],
+                "warm_hits": st_s["warm_hits"],
+                "compile_counts": st_s["compile_counts"],
+            }
+        }
+
     # Memory watermarks (round 16): host RSS high-water + the PEAK
     # replicated-residency estimate across every workload this invocation
     # encoded — stamped at the TOP level of every bench JSON so the
@@ -879,6 +947,7 @@ def main():
                     **tune_sweep,
                     **borg_block,
                     **headline_block,
+                    **service_block,
                 },
             }
         )

@@ -4,6 +4,12 @@
     result = sim.run()
     whatif = sim.what_if(scenarios=256, mesh=True)
     tuned = sim.tune(rounds=6, population=16)
+
+    svc = SimulatorService(cluster, pods)            # round 22
+    svc.submit({"op": "defrag", "tenant": "a", "id": "q1",
+                "nodes": [3, 4], "drainAt": 5.0, "recoverAt": 12.0})
+    rows = svc.poll("a")
+    svc.close()
 """
 
 from __future__ import annotations
@@ -81,12 +87,31 @@ class Simulator:
     ):
         """Batched what-if over cluster-state perturbations. Pass explicit
         ``scenarios`` (list of sim.whatif.Scenario) or ``num_scenarios``
-        for the uniform random sampler."""
+        for the uniform random sampler.
+
+        Round 22: repeated same-shape calls reuse ONE resident engine —
+        the scenario stacks swap as traced values against the compiled
+        executable (:meth:`WhatIfEngine.set_scenarios`), closing the
+        compile-per-query hole (compile count stays 1 for N queries,
+        pinned in tests/test_service.py). A batch the resident engine
+        refuses (shape/envelope drift) transparently rebuilds."""
         from .parallel.mesh import make_mesh
         from .sim.whatif import WhatIfEngine, uniform_scenarios
 
         if scenarios is None:
             scenarios = uniform_scenarios(self.ec, num_scenarios, seed=seed)
+        scenarios = list(scenarios)
+        key = (
+            len(scenarios), bool(mesh), bool(collect_assignments),
+            fork_checkpoint, repr(sorted(kw.items())),
+        )
+        cached = getattr(self, "_whatif_cache", None)
+        if cached is not None and cached[0] == key:
+            try:
+                cached[1].set_scenarios(scenarios)
+                return cached[1].run()
+            except ValueError:
+                self._whatif_cache = None
         eng = WhatIfEngine(
             self.ec,
             self.ep,
@@ -97,6 +122,7 @@ class Simulator:
             fork_checkpoint=fork_checkpoint,
             **kw,
         )
+        self._whatif_cache = (key, eng)
         return eng.run()
 
     def tune(
@@ -164,3 +190,72 @@ class Simulator:
             except Exception:
                 pass
         return available_strategies()
+
+
+class SimulatorService:
+    """Resident what-if query service (round 22) — the facade over
+    :class:`~.sim.service.QueryService`. Encodes the cluster/trace once
+    and keeps compiled engines hot between queries: submit what-if
+    queries from many tenants, poll per-tenant results, apply
+    bind/release/evict deltas to the live base state, close when done.
+
+        svc = SimulatorService(cluster, pods, max_batch=3)
+        svc.submit({"op": "defrag", "tenant": "a", "id": "q1",
+                    "nodes": [3], "drainAt": 5.0})
+        rows = svc.poll("a")          # [] until batch-full or deadline
+        rows = svc.flush() and svc.poll("a")   # force the batch now
+        svc.close()
+
+    Every engine/service knob (``max_batch``, ``batch_deadline_s``,
+    ``max_engines``, ``granularity``, ``retry_buffer``, ``wave_width``,
+    ``chunk_waves``, ``writer``, ``flight``) forwards to
+    :class:`QueryService`."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        pods: Sequence[Pod],
+        plugins: Optional[List[dict]] = None,
+        weights: Optional[dict] = None,
+        **service_kw,
+    ):
+        from .sim.service import QueryService
+
+        config = FrameworkConfig(plugins=plugins, weights=weights)
+        ec, ep = encode(cluster, list(pods))
+        self._svc = QueryService(ec, ep, config, **service_kw)
+
+    def submit(self, query: dict):
+        """Admit one query dict; returns ``(tenant, id)``."""
+        return self._svc.submit(query)
+
+    def poll(self, tenant: Optional[str] = None) -> List[dict]:
+        """Drain finished results (one tenant, or all)."""
+        return self._svc.poll(tenant)
+
+    def flush(self) -> int:
+        """Answer every pending query now (ignore the deadline)."""
+        return self._svc.flush()
+
+    def stats(self) -> dict:
+        return self._svc.stats()
+
+    def apply_bind(self, bind_id: str, node, requests) -> None:
+        self._svc.apply_bind(bind_id, node, requests)
+
+    def apply_release(self, bind_id: str) -> None:
+        self._svc.apply_release(bind_id)
+
+    def apply_evict(self, node) -> List[str]:
+        return self._svc.apply_evict(node)
+
+    def close(self) -> List[dict]:
+        """Flush, drop the engine pool, return undelivered results."""
+        return self._svc.close()
+
+    def __enter__(self) -> "SimulatorService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -4,6 +4,7 @@ YAML config.
     python -m kubernetes_simulator_tpu run config.yaml [--strategy jax]
     python -m kubernetes_simulator_tpu what-if config.yaml
     python -m kubernetes_simulator_tpu tune config.yaml
+    python -m kubernetes_simulator_tpu serve config.yaml < queries.ndjson
     python -m kubernetes_simulator_tpu validate config.yaml
 """
 
@@ -263,6 +264,76 @@ def cmd_tune(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Resident query service (round 22): read NDJSON what-if queries
+    from ``service.input`` (a file or named pipe) or stdin, answer them
+    through a pooled-engine :class:`~.sim.service.QueryService`, and
+    stream schema-v7 ``query-result`` rows to the configured output."""
+    from .sim.service import QueryService, serve_lines
+
+    cfg = SimConfig.load(args.config)
+    if args.strategy:
+        cfg.strategy = args.strategy
+    if cfg.service is None:
+        log.error("config has no service: section")
+        return 2
+    errors = validate_config(cfg)
+    if errors:
+        for e in errors:
+            log.error("config: %s", e)
+        return 2
+    sv = cfg.service
+    ec, ep = build_encoded_case(cfg)
+    log.info("encoded %d nodes / %d pods", ec.num_nodes, ep.num_pods)
+    flight = None
+    if cfg.flight_recorder is not None:
+        from .sim.flight import FlightRecorder, FlightRecorderConfig
+
+        flight = FlightRecorder(
+            FlightRecorderConfig(
+                path=cfg.flight_recorder.path,
+                every=cfg.flight_recorder.every,
+            ),
+            meta={"mode": "serve"},
+        )
+    with JsonlWriter(
+        cfg.output, context=_writer_context(cfg, args.config)
+    ) as out:
+        service = QueryService(
+            ec, ep, cfg.framework,
+            max_batch=sv.max_batch,
+            batch_deadline_s=sv.batch_deadline_s,
+            max_engines=sv.max_engines,
+            granularity=sv.granularity,
+            retry_buffer=sv.retry_buffer,
+            writer=out,
+            flight=flight,
+            wave_width=8 if cfg.wave_width == "auto" else cfg.wave_width,
+            chunk_waves=cfg.chunk_waves,
+        )
+        try:
+            with device_trace(args.profile_dir):
+                if sv.input is not None:
+                    # A named pipe blocks here until a producer connects —
+                    # that is the serving contract, not a hang.
+                    with open(sv.input) as f:
+                        stats = serve_lines(service, f, out)
+                else:
+                    stats = serve_lines(service, sys.stdin, out)
+        finally:
+            if flight is not None:
+                flight.close()
+    log.info(
+        "serve: %d queries in %d batches (%d cold build%s, %d warm, "
+        "%d error%s)",
+        stats["queries"], stats["batches"],
+        stats["cold_builds"], "" if stats["cold_builds"] == 1 else "s",
+        stats["warm_hits"],
+        stats["errors"], "" if stats["errors"] == 1 else "s",
+    )
+    return 0
+
+
 def _recovery_errors(cfg) -> list:
     """Actionable refusals for the ``dcn.recovery`` section (round 15).
     Shared by validate_config and the pre-dispatch env export in main():
@@ -494,6 +565,68 @@ def _durable_errors(cfg) -> list:
             f"dcn.durable.dir: {du.dir!r} is not writable ({e}) — the "
             "journal must outlive the fleet, so it is created eagerly"
         )
+    return errors
+
+
+def _service_errors(cfg) -> list:
+    """Actionable refusals for the ``service:`` section (round 22). The
+    resident query service swaps scenario values against ONE compiled
+    executable per pool engine, so every envelope the defrag family
+    rides on — the kube boundary mirror, single-process planes — must
+    hold before the first query is admitted, not fail mid-batch."""
+    sv = getattr(cfg, "service", None)
+    if sv is None:
+        return []
+    errors = []
+    if cfg.strategy != "jax":
+        errors.append(
+            "service: requires strategy: jax (the resident engine pool "
+            "is the compiled what-if plane)"
+        )
+    if cfg.device_preemption != "kube":
+        errors.append(
+            "service: defrag queries drain nodes through chaos eviction, "
+            "which needs devicePreemption: kube (the boundary host "
+            "mirror applies per-scenario timelines)"
+        )
+    if not cfg.whatif.retry_buffer:
+        errors.append(
+            "service: requires whatIf.retryBuffer > 0 — without the "
+            "boundary retry pass a drained node's pods are never "
+            "rescheduled, so every defrag answer degenerates"
+        )
+    if cfg.node_shards > 1:
+        errors.append(
+            "service: nodeShards > 1 is not supported — the query batch "
+            "spends the device on the scenario axis, and set_scenarios "
+            "refuses sliced engines"
+        )
+    if cfg.whatif.mesh:
+        errors.append(
+            "service: whatIf.mesh is not supported (resident engines "
+            "are single-process; set_scenarios refuses meshed engines)"
+        )
+    if sv.max_batch < 1:
+        errors.append("service.maxBatch: must be >= 1")
+    if sv.batch_deadline_s <= 0:
+        errors.append(
+            "service.batchDeadlineS: must be > 0 (the admission queue "
+            "needs a flush deadline; use maxBatch: 1 for per-query "
+            "dispatch)"
+        )
+    if sv.max_engines < 1:
+        errors.append("service.maxEngines: must be >= 1")
+    if sv.retry_buffer < 1:
+        errors.append("service.retryBuffer: must be >= 1")
+    from .sim.telemetry import _LEVELS as _tel_levels
+
+    if sv.granularity not in _tel_levels:
+        errors.append(
+            f"service.granularity: must be one of "
+            f"{', '.join(_tel_levels)}, got {sv.granularity!r}"
+        )
+    if sv.input is not None and not os.path.exists(sv.input):
+        errors.append(f"service.input: file not found: {sv.input}")
     return errors
 
 
@@ -754,6 +887,7 @@ def validate_config(cfg) -> list:
     errors.extend(_faultline_errors(cfg))
     errors.extend(_overlap_errors(cfg))
     errors.extend(_durable_errors(cfg))
+    errors.extend(_service_errors(cfg))
     return errors
 
 
@@ -783,7 +917,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubernetes_simulator_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
     for name, fn in (("run", cmd_run), ("what-if", cmd_whatif),
-                     ("tune", cmd_tune), ("validate", cmd_validate)):
+                     ("tune", cmd_tune), ("serve", cmd_serve),
+                     ("validate", cmd_validate)):
         p = sub.add_parser(name)
         p.add_argument("config")
         p.add_argument("--strategy", choices=["cpu", "jax"])

@@ -63,6 +63,10 @@ FLIGHT_WALL_FIELDS = (
     "exchange_est_s",
     "ckpt_wall_s",
     "rss_peak_mib",
+    # Round 22: serving-plane query rows carry the batch's wall latency
+    # (cold-vs-warm evidence). Queue depth / occupancy / warm flag are
+    # structural and stay.
+    "latency_s",
 )
 
 # Rolling placements/sec window: events, not seconds — chunk cadence is
@@ -306,6 +310,34 @@ class FlightRecorder:
                 "event": "boundary_fold",
                 "chunk": int(ci),
                 "stall_s": round(float(wall_s), 6),
+                "wall_s": round(time.perf_counter() - self._t0, 6),
+            }
+        )
+
+    def query(
+        self,
+        batch: int,
+        queued: int,
+        occupancy: float,
+        warm: bool,
+        latency_s: float,
+        engines: int,
+    ) -> None:
+        """One serving-plane batch resolved (round 22, sim.service): how
+        many queries coalesced, the scenario-axis occupancy, whether the
+        pool answered warm (value swap against a resident executable) or
+        cold (fresh compile), and the batch wall. Everything but
+        ``latency_s`` is deterministic for a fixed query sequence."""
+        self._emit(
+            {
+                "event": "query",
+                "chunk": -1,
+                "batch": int(batch),
+                "queue_depth": int(queued),
+                "batch_occupancy": round(float(occupancy), 4),
+                "warm": bool(warm),
+                "engines": int(engines),
+                "latency_s": round(float(latency_s), 6),
                 "wall_s": round(time.perf_counter() - self._t0, 6),
             }
         )

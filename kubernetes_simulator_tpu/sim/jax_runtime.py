@@ -936,6 +936,17 @@ def restore_carriers(tree, host_leaves):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def compiled_cache_size(fn) -> Optional[int]:
+    """Number of compiled executables a jitted callable holds, or None
+    where the jaxlib in play doesn't expose ``_cache_size`` (same guard
+    the round-9 tuner uses). The serving plane pins this at 1 per pool
+    engine — a warm query must never recompile."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
 def rep_slots_for(static3, pods: EncodedPods):
     """(tol_reps, na_reps) PodSlot batches of class representatives. Empty
     gathers when the class path is off — keeps unused (possibly huge)

@@ -217,6 +217,22 @@ class ReplayTelemetry:
             out["timeline_events"] = len(self.events)
         return out
 
+    def query_view(self) -> dict:
+        """JSON-ready per-scenario view for serving-plane query-result
+        rows (round 22, sim.service): :meth:`summary` plus the raw
+        virtual-time series. Phase timers are dropped — the wall clocks
+        of a shared batch replay belong to the batch, not to any one
+        tenant's query. Series values are virtual-time-deterministic,
+        so a batched query's view bit-matches its sequential oracle's
+        (the round-15 batch-composition-independence bar)."""
+        out = self.summary()
+        out.pop("phases", None)
+        if self.series is not None:
+            out["series"] = {
+                k: [float(v) for v in vs] for k, vs in self.series.items()
+            }
+        return out
+
     @classmethod
     def merge(
         cls,

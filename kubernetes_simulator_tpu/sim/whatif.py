@@ -878,6 +878,7 @@ class WhatIfEngine:
             raise ValueError(
                 "what-if preemption does not support pre-bound pods"
             )
+        self._scales_pods = False
         if self.engine == "v3":
             from ..ops import tpu3 as V3
             from .jax_runtime import rep_slots_for
@@ -889,6 +890,9 @@ class WhatIfEngine:
                 for sc in scenarios
                 for pt in sc.perturbations
             )
+            # Remembered so set_scenarios can refuse a swapped-in batch
+            # that needs the f32 host plane this engine was built without.
+            self._scales_pods = scales_pods
             self.static3 = V3.V3Static.build(
                 ec, pods, self.spec, preemption=preemption,
                 allow_bf16_host=not scales_pods,
@@ -1177,6 +1181,106 @@ class WhatIfEngine:
                 "shape-specialized)"
             )
         self._policies = pol
+
+    def set_scenarios(self, scenarios) -> None:
+        """Swap the scenario BATCH without rebuilding the engine.
+
+        The compiled chunk program takes the scenario cluster stacks as
+        traced ``[S, ...]`` inputs, so a same-shape batch reuses the
+        executable exactly like ``set_policies`` reuses it for policy
+        vectors — this is what lets a resident ``SimulatorService``
+        answer warm queries with zero recompilation. Everything
+        per-batch that ``run()`` reads is rebuilt here (``ScenarioSet``
+        stacks + chaos timelines); everything baked into the compile
+        (shapes, dtypes, engine mode, domain capacity) is checked and
+        REFUSED on mismatch rather than silently recompiled.
+        """
+        if self._dcn_sliced or self._dcn_recovery is not None:
+            raise ValueError(
+                "set_scenarios is single-process only: a DCN-sliced "
+                "engine owns a contiguous block of a global batch and "
+                "cannot swap scenarios underneath the slice bookkeeping"
+            )
+        if self.engine != "v3":
+            raise ValueError(
+                "set_scenarios requires the v3 engine (the v2 parity "
+                "fallback rebuilds per-batch state at trace time)"
+            )
+        if self.sset.labels_dirty:
+            raise ValueError(
+                "set_scenarios does not support engines built with "
+                "label perturbations (DynTables are baked per batch) — "
+                "rebuild the engine instead"
+            )
+        scenarios = list(scenarios)
+        if len(scenarios) != self.S:
+            raise ValueError(
+                f"scenario count ({len(scenarios)}) must match the "
+                f"engine's ({self.S}) — the compiled program is "
+                "shape-specialized"
+            )
+        timelines = [
+            list(getattr(sc, "events", None) or []) for sc in scenarios
+        ]
+        if any(timelines):
+            if not self.kube:
+                raise ValueError(
+                    "per-scenario timed event timelines (Scenario."
+                    "events) require preemption='kube' with "
+                    "retry_buffer > 0"
+                )
+            from .runtime import validate_node_events
+
+            for si, tl in enumerate(timelines):
+                try:
+                    validate_node_events(tl, self.ec.num_nodes)
+                except ValueError as e:
+                    raise ValueError(f"scenario {si}: {e}") from None
+        sset = ScenarioSet(self.ec, scenarios, keep_host_stacks=self.kube)
+        if sset.labels_dirty:
+            raise ValueError(
+                "set_scenarios does not support label perturbations "
+                "(the swapped batch would need fresh DynTables) — "
+                "rebuild the engine instead"
+            )
+        if max(sset.max_domains, 1) != self.D:
+            raise ValueError(
+                f"scenario batch needs domain capacity "
+                f"{max(sset.max_domains, 1)} but the engine compiled "
+                f"with {self.D}"
+            )
+        if sset.injected_prefer_taint and not self.spec.taint_score:
+            raise ValueError(
+                "scenario batch injects prefer-taints but the engine "
+                "compiled without taint scoring — rebuild the engine"
+            )
+        if not self._scales_pods and any(
+            pt.op == "scale_capacity"
+            and pt.resource == "pods"
+            and pt.factor > 1
+            for sc in scenarios
+            for pt in sc.perturbations
+        ):
+            raise ValueError(
+                "scenario batch scales the 'pods' capacity up but the "
+                "engine compiled on the bf16 host plane — rebuild the "
+                "engine with such a scenario present"
+            )
+
+        def _sig(dc):
+            return [
+                (tuple(x.shape), str(x.dtype))
+                for x in jax.tree_util.tree_leaves(dc)
+            ]
+
+        if _sig(sset.dc) != _sig(self.sset.dc):
+            raise ValueError(
+                "scenario batch changes the compiled array shapes/"
+                "dtypes — the executable is shape-specialized; rebuild "
+                "the engine for this batch"
+            )
+        self.sset = sset
+        self._timelines = timelines
 
     def _build_chunk_fn(self):
         collect = self._need_choices

@@ -274,6 +274,31 @@ class OverlapSpec:
 
 
 @dataclass
+class ServiceSpec:
+    """Resident query service (``service:`` YAML section, round 22 —
+    sim.service / the ``serve`` CLI subcommand). ``maxBatch`` is the
+    number of query slots coalesced onto the scenario axis (the device
+    batch is maxBatch + 1 — slot 0 is the clean baseline);
+    ``batchDeadlineS`` is the admission-queue flush deadline;
+    ``maxEngines`` caps the LRU engine pool (the
+    ``KSIM_SERVICE_MAX_ENGINES`` env wins over this value);
+    ``granularity`` is the default telemetry level of query results
+    (queries may override per-request); ``retryBuffer`` sizes the kube
+    boundary retry pass defrag drains evict through; ``input`` is an
+    NDJSON query source (a file or named pipe; null = stdin). Results
+    stream to the top-level ``output`` (null = stdout). Requires
+    ``strategy: jax``, ``devicePreemption: kube`` and no
+    ``nodeShards`` — validate_config refuses anything else."""
+
+    max_batch: int = 3
+    batch_deadline_s: float = 0.05
+    max_engines: int = 4
+    granularity: str = "summary"
+    retry_buffer: int = 64
+    input: Optional[str] = None
+
+
+@dataclass
 class TelemetrySpec:
     """Telemetry layer (``telemetry:`` YAML section, SURVEY.md §5).
 
@@ -336,6 +361,9 @@ class SimConfig:
     # Overlap plane (round 19): the three stall-hiding gates. None = all
     # engine defaults (on).
     overlap: Optional[OverlapSpec] = None
+    # Resident query service (round 22, `serve` subcommand only). None =
+    # the config is not a service config.
+    service: Optional[ServiceSpec] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "SimConfig":
@@ -537,6 +565,18 @@ class SimConfig:
                 pager_thread=_tristate("pagerThread"),
                 background_publisher=_tristate("backgroundPublisher"),
                 two_phase_exchange=_tristate("twoPhaseExchange"),
+            )
+        sv = d.get("service")
+        if sv is not None:
+            if not isinstance(sv, dict):
+                sv = {}
+            cfg.service = ServiceSpec(
+                max_batch=int(sv.get("maxBatch", 3)),
+                batch_deadline_s=float(sv.get("batchDeadlineS", 0.05)),
+                max_engines=int(sv.get("maxEngines", 4)),
+                granularity=str(sv.get("granularity", "summary")),
+                retry_buffer=int(sv.get("retryBuffer", 64)),
+                input=sv.get("input"),
             )
         return cfg
 

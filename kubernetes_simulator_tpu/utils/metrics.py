@@ -64,7 +64,18 @@ if not log.handlers:
 #        (scripts/fleet_postmortem.py audit summary: events ingested,
 #        links resolved, invariant verdicts, audit wall). Non-flight
 #        rows keep the v4 rules; v1–v5 files validate byte-unchanged.
-SCHEMA_VERSION = 6
+#   v7 — simulator-as-a-service (round 22, sim.service): three new row
+#        kinds on the serving plane — "query" (admission: tenant /
+#        query id / family / queue depth), "query-result" (per-tenant
+#        demux of a coalesced batch: slot, occupancy, warm flag, batch
+#        latency, eviction cost + fragmentation benefit vs the baseline
+#        slot) and "query-error" (a malformed serve line, structured —
+#        the service keeps serving). Flight streams gain a "query"
+#        event (queue depth, batch occupancy, cold-vs-warm latency).
+#        KSIM_DETERMINISTIC_JSONL zeroes the new wall-derived fields
+#        ("latency_s" / "queue_wait_s"). v1–v6 files validate
+#        byte-unchanged.
+SCHEMA_VERSION = 7
 TUNE_SCHEMA_VERSION = 3
 
 
@@ -299,7 +310,10 @@ def _scrub_timing(row: dict) -> dict:
     """Zero wall-clock-derived fields under KSIM_DETERMINISTIC_JSONL
     (fields stay present as numbers — schema v2 requires them)."""
     if deterministic_jsonl():
-        for k in ("wall_clock_s", "placements_per_sec"):
+        for k in (
+            "wall_clock_s", "placements_per_sec", "latency_s",
+            "queue_wait_s",
+        ):
             if k in row:
                 row[k] = 0.0
     return row

@@ -488,6 +488,55 @@ def compare_pair(
                 notes.append(
                     f"postmortem {key}: {ga} -> {gb} (informational)"
                 )
+
+    # Resident query service (round 22): warm throughput through the
+    # pooled-engine serving plane gates like a headline pps — a drop
+    # beyond the threshold at the SAME shape means warm queries started
+    # recompiling or the batch coalescing broke. Cold-start wall and the
+    # warm/cold speedup are informational (cold is paid once per pool
+    # entry and moves with compiler versions, not with this repo).
+    sva, svb = da.get("service"), db.get("service")
+    if isinstance(svb, dict) and not isinstance(sva, dict):
+        notes.append(
+            f"service: first appearance ({svb.get('nodes')} nodes x "
+            f"{svb.get('pods')} pods, warm qps="
+            f"{svb.get('warm_queries_per_sec')}, "
+            f"warm speedup {svb.get('warm_speedup')}x cold)"
+        )
+    elif isinstance(sva, dict) and isinstance(svb, dict):
+        same_shape = all(
+            sva.get(k) == svb.get(k) for k in ("nodes", "pods")
+        )
+        qa = sva.get("warm_queries_per_sec")
+        qb = svb.get("warm_queries_per_sec")
+        if not same_shape:
+            notes.append(
+                "service: shape changed "
+                f"({sva.get('nodes')}x{sva.get('pods')} -> "
+                f"{svb.get('nodes')}x{svb.get('pods')}) — "
+                "warm qps not compared"
+            )
+        elif (
+            isinstance(qa, (int, float))
+            and isinstance(qb, (int, float))
+            and qa > 0
+        ):
+            delta = (qb - qa) / qa
+            line = (
+                f"service warm queries/sec: {qa:.2f} -> {qb:.2f} "
+                f"({delta:+.1%})"
+            )
+            if qb < qa * (1.0 - threshold):
+                regressions.append(line + "  REGRESSION")
+            else:
+                notes.append(line)
+        for key in ("cold_latency_s", "warm_latency_median_s",
+                    "warm_speedup"):
+            ga, gb = sva.get(key), svb.get(key)
+            if isinstance(ga, (int, float)) and isinstance(gb, (int, float)):
+                notes.append(
+                    f"service {key}: {ga} -> {gb} (informational)"
+                )
     return regressions, notes
 
 

@@ -170,6 +170,86 @@ _POSTMORTEM_REQUIRED = {
     "invariants": dict,
 }
 
+# v7 (simulator-as-a-service, round 22 — sim.service): three new row
+# kinds on the serving plane. "query" (admission) and "query-result"
+# (per-tenant demux of a coalesced batch) carry a RELAXED base like
+# flight rows — API-driven services write without CLI context — but the
+# serve CLI stamps the full v2 context, so those keys stay optional
+# typed, never required. "query-error" is a structured malformed-line
+# report (the service keeps serving). Flight streams gain a "query"
+# event. v1–v6 files validate byte-unchanged — the dispatch arms below
+# only widen for schema == 7.
+_QUERY_REQUIRED = {
+    "tenant": str,
+    "query": str,
+    "family": str,
+    "queue_depth": int,
+}
+_QUERY_RESULT_REQUIRED = {
+    "tenant": str,
+    "query": str,
+    "family": str,
+    "batch": int,
+    "slot": int,
+    "warm": bool,
+    "latency_s": _NUM,
+    "placed": int,
+    "unschedulable": int,
+}
+_QUERY_ERROR_REQUIRED = {
+    "error": str,
+}
+_OPTIONAL_QUERY = {
+    "batch_occupancy": _NUM,
+    "queue_wait_s": _NUM,
+    "placed_delta": int,
+    "evictions": (*_NUM, type(None)),
+    "evict_rescheduled": (*_NUM, type(None)),
+    "evict_stranded": (*_NUM, type(None)),
+    "evict_latency_mean": (*_NUM, type(None)),
+    "stranded_cpu": (*_NUM, type(None)),
+    "frag_index_cpu": (*_NUM, type(None)),
+    "packing_efficiency": (*_NUM, type(None)),
+    "baseline_stranded_cpu": (*_NUM, type(None)),
+    "baseline_frag_index_cpu": (*_NUM, type(None)),
+    "baseline_packing_efficiency": (*_NUM, type(None)),
+    "telemetry": dict,
+    "raw": str,
+    # Serve-CLI context stamp (optional here — API writers omit it).
+    "seed": int,
+    "engine": str,
+    "config_hash": str,
+    "process_id": int,
+    "process_count": int,
+}
+_FLIGHT_EVENTS_V7 = _FLIGHT_EVENTS_V6 + ("query",)
+_OPTIONAL_FLIGHT_V7 = {
+    **_OPTIONAL_FLIGHT_V6,
+    "batch": int,
+    "queue_depth": int,
+    "batch_occupancy": _NUM,
+    "warm": bool,
+    "engines": int,
+    "latency_s": _NUM,
+}
+
+
+def _validate_query(row: dict, required: dict) -> List[str]:
+    errs = []
+    if not isinstance(row.get("ts"), _NUM):
+        errs.append(f"ts: expected a number, got {row.get('ts')!r}")
+    for k, t in required.items():
+        v = row.get(k)
+        if not isinstance(v, t) or (isinstance(v, bool) and t is not bool):
+            errs.append(f"{k}: expected {t}, got {v!r}")
+    for k, t in _OPTIONAL_QUERY.items():
+        if k in row and (
+            not isinstance(row[k], t)
+            or (isinstance(row[k], bool) and t is not bool)
+        ):
+            errs.append(f"{k}: expected {t}, got {row[k]!r}")
+    return errs
+
 
 def _validate_flight(
     row: dict, events=_FLIGHT_EVENTS, optional=_OPTIONAL_FLIGHT
@@ -334,13 +414,23 @@ def validate_row(row: dict) -> List[str]:
         return _validate_flight(
             row, events=_FLIGHT_EVENTS_V6, optional=_OPTIONAL_FLIGHT_V6
         )
-    if schema == 6 and row.get("kind") == "postmortem":
+    if schema == 7 and row.get("kind") == "flight":
+        return _validate_flight(
+            row, events=_FLIGHT_EVENTS_V7, optional=_OPTIONAL_FLIGHT_V7
+        )
+    if schema in (6, 7) and row.get("kind") == "postmortem":
         return _validate_postmortem(row)
-    if schema in (4, 5, 6):
+    if schema == 7 and row.get("kind") == "query":
+        return _validate_query(row, _QUERY_REQUIRED)
+    if schema == 7 and row.get("kind") == "query-result":
+        return _validate_query(row, _QUERY_RESULT_REQUIRED)
+    if schema == 7 and row.get("kind") == "query-error":
+        return _validate_query(row, _QUERY_ERROR_REQUIRED)
+    if schema in (4, 5, 6, 7):
         for k, t in _OPTIONAL_V4.items():
             if k in row and not isinstance(row[k], t):
                 errs.append(f"{k}: expected {t}, got {row[k]!r}")
-        if schema == 6:
+        if schema in (6, 7):
             for k, t in _OPTIONAL_TRACE.items():
                 if k in row and not isinstance(row[k], t):
                     errs.append(f"{k}: expected {t}, got {row[k]!r}")
@@ -411,7 +501,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not all_errs:
         print(
             f"ok: {len(argv)} file(s) validate against schema "
-            f"v2/v3/v4/v5/v6"
+            f"v2/v3/v4/v5/v6/v7"
         )
     return 1 if all_errs else 0
 

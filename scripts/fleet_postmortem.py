@@ -761,7 +761,7 @@ def run_postmortem(
     if jsonl:
         row = {
             "ts": time.time(),
-            "schema": 6,
+            "schema": 7,  # rides the current JSONL rev (v7, round 22)
             "kind": "postmortem",
             "events_ingested": report["events_ingested"],
             "links_resolved": report["links_resolved"],
@@ -828,7 +828,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument("--supervisor-log", help="dcn_launch --supervise output")
     ap.add_argument(
-        "--jsonl", help="append a schema-v6 'postmortem' summary row here"
+        "--jsonl", help="append a schema-v7 'postmortem' summary row here"
     )
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)

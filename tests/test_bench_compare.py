@@ -209,6 +209,40 @@ def test_postmortem_block_is_informational_only():
     assert any("postmortem: first appearance" in n for n in notes)
 
 
+def _service(qps, nodes=200, pods=2000, cold=3.0, warm=0.1):
+    return {"nodes": nodes, "pods": pods, "warm_queries_per_sec": qps,
+            "cold_latency_s": cold, "warm_latency_median_s": warm,
+            "warm_speedup": round(cold / warm, 1)}
+
+
+def test_service_block_gates_warm_qps():
+    """Round 22: warm queries/sec through the serving plane gates like a
+    headline pps at the same shape; cold start stays informational."""
+    a = _bench(100.0)
+    a["detail"]["service"] = _service(30.0)
+    b = _bench(100.0)
+    b["detail"]["service"] = _service(20.0)
+    reg, _ = compare_pair("a", a, "b", b, 0.10)
+    assert len(reg) == 1 and "service warm queries/sec" in reg[0]
+    # Within threshold: note, and the latency fields ride as notes too.
+    c = _bench(100.0)
+    c["detail"]["service"] = _service(28.5, cold=2.0)
+    reg, notes = compare_pair("a", a, "b", c, 0.10)
+    assert reg == []
+    assert any("service warm queries/sec" in n for n in notes)
+    assert any("cold_latency_s" in n and "informational" in n
+               for n in notes)
+    # First appearance: informational.
+    reg, notes = compare_pair("a", _bench(100.0), "b", b, 0.10)
+    assert reg == [] and any(
+        "service: first appearance" in n for n in notes)
+    # Shape changed: warm qps not compared.
+    d = _bench(100.0)
+    d["detail"]["service"] = _service(1.0, nodes=400)
+    reg, notes = compare_pair("a", a, "b", d, 0.10)
+    assert reg == [] and any("service: shape changed" in n for n in notes)
+
+
 def test_main_exit_codes(tmp_path, capsys):
     ok_a = _write(tmp_path, "a.json", _bench(100.0), wrap=True)
     ok_b = _write(tmp_path, "b.json", _bench(101.0))

@@ -181,7 +181,7 @@ def test_flight_stream_validates_against_schema_v6(case, tmp_path):
     ).replay()
     assert validate_file(path) == []
     rows = read_stream(path)
-    assert all(r["schema"] == 6 for r in rows)
+    assert all(r["schema"] == 7 for r in rows)
     # The sharded run's chunk rows carry the exchange attribution.
     cks = [r for r in rows if r["event"] == "chunk"]
     assert cks and all("exchange_est_s" in r for r in cks)

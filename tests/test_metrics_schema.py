@@ -34,7 +34,7 @@ def run_jsonl(tmp_path):
 def test_cli_run_emits_valid_schema(run_jsonl):
     assert validate_file(run_jsonl) == []
     rows = [json.loads(l) for l in open(run_jsonl)]
-    assert rows and rows[0]["schema"] == 6  # round 21: fleet black box
+    assert rows and rows[0]["schema"] == 7  # round 22: query service
     assert {"seed", "engine", "config_hash", "telemetry"} <= rows[0].keys()
     assert "fragmentation" in rows[0]
     assert main([run_jsonl]) == 0

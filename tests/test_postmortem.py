@@ -321,7 +321,7 @@ def test_postmortem_jsonl_row_validates_v6(tmp_path, monkeypatch):
     assert report["rc"] == 0
     row = json.loads(out_jsonl.read_text().splitlines()[0])
     assert row["kind"] == "postmortem"
-    assert row["schema"] == 6
+    assert row["schema"] == 7
     assert row["ts"] == 0.0 and row["audit_wall_s"] == 0.0
     p = subprocess.run(
         [sys.executable,

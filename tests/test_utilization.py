@@ -362,7 +362,7 @@ def test_replay_row_schema_v4(tmp_path):
         w.write(replay_row("replay-cpu", res))
     assert validate_file(str(out)) == []
     row = json.loads(out.read_text())
-    assert row["schema"] == 6
+    assert row["schema"] == 7
     assert set(row["fragmentation"]) == {
         "stranded", "stranded_frac", "frag_index", "packing_efficiency",
         "nodes_active", "nodes_ideal", "pending",
